@@ -1,0 +1,13 @@
+"""analytics_zoo_tpu — a TPU-native unified analytics + AI framework.
+
+Brand-new JAX/XLA/pallas/pjit implementation of the Analytics Zoo capability
+surface: sharded host data pipelines feeding an on-device data-parallel
+synchronous-SGD loop, Keras-style and capture-style training APIs, a pooled
+inference engine, serving, and a model zoo. See SURVEY.md for the layer map
+this follows.
+"""
+
+__version__ = "0.1.0"
+
+from .common.context import init_tpu_context, get_context, ZooTpuContext  # noqa: F401
+from .common.config import global_config  # noqa: F401

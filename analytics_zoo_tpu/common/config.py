@@ -1,0 +1,122 @@
+"""Layered configuration registry.
+
+The reference scatters configuration across six mechanisms (packaged
+``spark-analytics-zoo.conf`` defaults, SparkConf keys, MKL env vars, Java system
+properties, per-service YAML, build-info properties — see
+``pyzoo/zoo/common/nncontext.py:148-200`` and ``zoo/.../common/NNContext.scala:35-78``
+in the reference). This module centralizes the same capability into a single
+layered registry: registered defaults < config file < environment variables <
+programmatic overrides.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+_ENV_PREFIX = "ZOO_TPU_"
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    parser: Callable[[str], Any]
+    help: str = ""
+
+
+def _parse_bool(s: str) -> bool:
+    return str(s).strip().lower() in ("1", "true", "yes", "on")
+
+
+class Config:
+    """A single process-wide layered flag registry.
+
+    Precedence (lowest to highest):
+      1. registered defaults (``register``)
+      2. values loaded from a JSON config file (``load_file``)
+      3. environment variables ``ZOO_TPU_<UPPER_NAME>``
+      4. programmatic ``set`` overrides
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._flags: Dict[str, _Flag] = {}
+        self._file_values: Dict[str, Any] = {}
+        self._overrides: Dict[str, Any] = {}
+
+    def register(self, name: str, default: Any, help: str = "",
+                 parser: Optional[Callable[[str], Any]] = None) -> None:
+        with self._lock:
+            if parser is None:
+                if isinstance(default, bool):
+                    parser = _parse_bool
+                elif isinstance(default, int):
+                    parser = int
+                elif isinstance(default, float):
+                    parser = float
+                else:
+                    parser = str
+            self._flags[name] = _Flag(name, default, parser, help)
+
+    def load_file(self, path: str) -> None:
+        with open(path) as f:
+            values = json.load(f)
+        with self._lock:
+            self._file_values.update(values)
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            self._overrides[name] = value
+
+    def unset(self, name: str) -> None:
+        with self._lock:
+            self._overrides.pop(name, None)
+
+    def get(self, name: str, default: Any = None) -> Any:
+        with self._lock:
+            flag = self._flags.get(name)
+            if name in self._overrides:
+                return self._overrides[name]
+            env_key = _ENV_PREFIX + name.upper().replace(".", "_").replace("-", "_")
+            if env_key in os.environ:
+                raw = os.environ[env_key]
+                return flag.parser(raw) if flag else raw
+            if name in self._file_values:
+                return self._file_values[name]
+            if flag is not None:
+                return flag.default
+            return default
+
+    def as_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {name: self.get(name) for name in self._flags}
+            for name in self._file_values:
+                out.setdefault(name, self.get(name))
+            for name in self._overrides:
+                out[name] = self._overrides[name]
+            return out
+
+
+_global_config = Config()
+
+
+def global_config() -> Config:
+    return _global_config
+
+
+# Core defaults (mirrors the knobs the reference exposes via SparkConf / sysprops).
+_global_config.register("failure.retry_times", 5,
+                        "Max training retries from checkpoint within a retry window "
+                        "(reference: bigdl.failure.retryTimes).")
+_global_config.register("failure.retry_interval_s", 120.0,
+                        "Window seconds for retry budget reset "
+                        "(reference: bigdl.failure.retryTimeInterval).")
+_global_config.register("version_check", False,
+                        "Warn on jax/libtpu version mismatches at context init "
+                        "(reference: spark.analytics.zoo.versionCheck).")
+_global_config.register("data.prefetch", 2, "Device-feed prefetch depth.")
+_global_config.register("mesh.data_axis", "data", "Default data-parallel mesh axis name.")
+_global_config.register("mesh.model_axis", "model", "Default model-parallel mesh axis name.")

@@ -1,0 +1,146 @@
+"""TPU context initialization — the ``init_nncontext`` equivalent.
+
+The reference boots a SparkContext + BigDL engine (``NNContext.initNNContext``,
+``zoo/.../common/NNContext.scala:133``; Python ``pyzoo/zoo/common/nncontext.py:109``).
+On TPU there is no JVM and no Spark: "context" means the JAX runtime, the device
+mesh (ICI topology within a slice, DCN across slices), process/host identity, and
+a deterministic RNG root. ``init_tpu_context()`` discovers all of that once and
+caches it process-wide, exactly as ``init_nncontext`` memoizes the SparkContext.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .config import global_config
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+
+@dataclass
+class ZooTpuContext:
+    """Process-wide runtime context (the NNContext equivalent)."""
+
+    mesh: Mesh
+    devices: Sequence[jax.Device]
+    process_index: int
+    process_count: int
+    platform: str
+    config: Dict[str, object] = field(default_factory=dict)
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+    @property
+    def data_axis(self) -> str:
+        return self.mesh.axis_names[0]
+
+    @property
+    def model_axis(self) -> Optional[str]:
+        return self.mesh.axis_names[1] if len(self.mesh.axis_names) > 1 else None
+
+    def local_batch(self, global_batch: int) -> int:
+        """Per-process share of a global batch (reference: global batch =
+        nodes x cores x per-core batch, ``Topology.scala:1110-1119``)."""
+        if global_batch % self.process_count != 0:
+            raise ValueError(
+                f"global batch {global_batch} not divisible by process count "
+                f"{self.process_count}")
+        return global_batch // self.process_count
+
+
+_context_lock = threading.Lock()
+_context: Optional[ZooTpuContext] = None
+
+
+def _build_mesh(devices: Sequence[jax.Device],
+                mesh_shape: Optional[Tuple[int, ...]] = None,
+                axis_names: Optional[Tuple[str, ...]] = None) -> Mesh:
+    cfg = global_config()
+    if axis_names is None:
+        if mesh_shape is None or len(mesh_shape) == 1:
+            axis_names = (cfg.get("mesh.data_axis"),)
+        else:
+            axis_names = tuple(
+                [cfg.get("mesh.data_axis"), cfg.get("mesh.model_axis")]
+                + [f"axis{i}" for i in range(2, len(mesh_shape))])
+    if mesh_shape is None:
+        mesh_shape = (len(devices),)
+    n = int(np.prod(mesh_shape))
+    if n != len(devices):
+        raise ValueError(f"mesh shape {mesh_shape} needs {n} devices, "
+                         f"have {len(devices)}")
+    dev_array = np.asarray(devices).reshape(mesh_shape)
+    return Mesh(dev_array, axis_names)
+
+
+def init_tpu_context(mesh_shape: Optional[Tuple[int, ...]] = None,
+                     axis_names: Optional[Tuple[str, ...]] = None,
+                     conf: Optional[Dict[str, object]] = None,
+                     force_reinit: bool = False) -> ZooTpuContext:
+    """Initialize (or fetch the cached) runtime context.
+
+    Args:
+      mesh_shape: optional logical mesh shape over all addressable devices,
+        e.g. ``(8,)`` for pure DP or ``(4, 2)`` for DP x MP. Defaults to a 1-D
+        data-parallel mesh over every device.
+      axis_names: names for the mesh axes; default ``("data",)`` /
+        ``("data", "model", ...)``.
+      conf: programmatic config overrides applied to the global registry
+        (the ``init_spark_conf`` analogue).
+      force_reinit: rebuild even if a context exists (tests only).
+    """
+    global _context
+    with _context_lock:
+        if _context is not None and not force_reinit:
+            if mesh_shape is not None and tuple(_context.mesh.devices.shape) != tuple(mesh_shape):
+                raise ValueError(
+                    f"context already initialized with mesh shape "
+                    f"{tuple(_context.mesh.devices.shape)}; requested {tuple(mesh_shape)}. "
+                    f"Pass force_reinit=True to rebuild.")
+            if conf:
+                cfg = global_config()
+                for k, v in conf.items():
+                    cfg.set(k, v)
+                _context.config = cfg.as_dict()
+            return _context
+        cfg = global_config()
+        if conf:
+            for k, v in conf.items():
+                cfg.set(k, v)
+        devices = jax.devices()
+        mesh = _build_mesh(devices, mesh_shape, axis_names)
+        ctx = ZooTpuContext(
+            mesh=mesh,
+            devices=devices,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            platform=devices[0].platform,
+            config=cfg.as_dict(),
+        )
+        logger.info(
+            "init_tpu_context: platform=%s devices=%d mesh=%s process=%d/%d",
+            ctx.platform, ctx.num_devices, dict(zip(mesh.axis_names, mesh.devices.shape)),
+            ctx.process_index, ctx.process_count)
+        _context = ctx
+        return ctx
+
+
+def get_context() -> ZooTpuContext:
+    if _context is None:
+        return init_tpu_context()
+    return _context
+
+
+def reset_context() -> None:
+    """Drop the cached context (tests only)."""
+    global _context
+    with _context_lock:
+        _context = None

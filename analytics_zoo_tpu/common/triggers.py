@@ -1,0 +1,119 @@
+"""Composable training triggers — the ``ZooTrigger`` algebra.
+
+Mirrors the semantics of the reference's trigger system
+(``zoo/.../common/ZooTrigger.scala:43-154``): a trigger is a predicate over the
+training state, fired by the training loop to decide when to validate,
+checkpoint, or stop. Triggers compose with ``And``/``Or``. The "zoo state"
+extension (sub-epoch slice counters for huge epochs, ``numOfSlice``) is carried
+in :class:`TrainingState`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class TrainingState:
+    """Loop state visible to triggers (the BigDL ``Table`` state equivalent)."""
+
+    epoch: int = 1                 # 1-based current epoch
+    iteration: int = 0             # global step counter
+    loss: Optional[float] = None   # last train loss
+    score: Optional[float] = None  # last validation score
+    record_count: int = 0          # samples consumed in current epoch
+    epoch_finished: bool = False   # set by the loop at epoch boundary
+    # Zoo-state extras (sub-epoch slicing, ZooTrigger.setZooState equivalent):
+    num_slices: int = 1
+    slice_index: int = 0           # current sub-epoch slice
+    extras: Dict[str, float] = field(default_factory=dict)
+
+
+class Trigger:
+    def __call__(self, state: TrainingState) -> bool:
+        raise NotImplementedError
+
+    def and_(self, other: "Trigger") -> "Trigger":
+        return And(self, other)
+
+    def or_(self, other: "Trigger") -> "Trigger":
+        return Or(self, other)
+
+
+class EveryEpoch(Trigger):
+    """Fires once per full epoch.
+
+    Under sub-epoch slicing the loop marks ``epoch_finished`` at every slice
+    boundary; like the reference (``ZooTrigger.scala:43-68``, fires when
+    ``currentSlice % numSlice == 0``) this only fires when the finished slice
+    closes a full epoch.
+    """
+
+    def __call__(self, state: TrainingState) -> bool:
+        if not state.epoch_finished:
+            return False
+        if state.num_slices <= 1:
+            return True
+        return state.slice_index % state.num_slices == 0
+
+
+class SeveralIteration(Trigger):
+    def __init__(self, interval: int):
+        if interval <= 0:
+            raise ValueError("interval must be positive")
+        self.interval = interval
+
+    def __call__(self, state: TrainingState) -> bool:
+        return state.iteration > 0 and state.iteration % self.interval == 0
+
+
+class MaxEpoch(Trigger):
+    def __init__(self, max_epoch: int):
+        self.max_epoch = max_epoch
+
+    def __call__(self, state: TrainingState) -> bool:
+        return state.epoch > self.max_epoch
+
+
+class MaxIteration(Trigger):
+    def __init__(self, max_iteration: int):
+        self.max_iteration = max_iteration
+
+    def __call__(self, state: TrainingState) -> bool:
+        return state.iteration >= self.max_iteration
+
+
+class MaxScore(Trigger):
+    """Stop once validation score exceeds a bar."""
+
+    def __init__(self, max_score: float):
+        self.max_score = max_score
+
+    def __call__(self, state: TrainingState) -> bool:
+        return state.score is not None and state.score > self.max_score
+
+
+class MinLoss(Trigger):
+    """Stop once training loss drops below a bar."""
+
+    def __init__(self, min_loss: float):
+        self.min_loss = min_loss
+
+    def __call__(self, state: TrainingState) -> bool:
+        return state.loss is not None and state.loss < self.min_loss
+
+
+class And(Trigger):
+    def __init__(self, *triggers: Trigger):
+        self.triggers = triggers
+
+    def __call__(self, state: TrainingState) -> bool:
+        return all(t(state) for t in self.triggers)
+
+
+class Or(Trigger):
+    def __init__(self, *triggers: Trigger):
+        self.triggers = triggers
+
+    def __call__(self, state: TrainingState) -> bool:
+        return any(t(state) for t in self.triggers)

@@ -1,0 +1,70 @@
+"""Small runtime utilities: micro-profiler, tree helpers, file IO.
+
+``time_it`` mirrors the reference's ``Utils.timeIt`` wall-time micro-profiler
+(``zoo/.../common/Utils.scala``) used around every hot call
+(``tfpark/GraphRunner.scala:112,132``); here it also aggregates per-name stats so
+the Estimator can report phase timings the way BigDL's ``Metrics`` does.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Tuple
+
+import jax
+import numpy as np
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+
+class _TimerRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._totals: Dict[str, float] = defaultdict(float)
+        self._counts: Dict[str, int] = defaultdict(int)
+
+    def add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._totals[name] += seconds
+            self._counts[name] += 1
+
+    def stats(self) -> Dict[str, Tuple[float, int]]:
+        with self._lock:
+            return {k: (self._totals[k], self._counts[k]) for k in self._totals}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._totals.clear()
+            self._counts.clear()
+
+
+timers = _TimerRegistry()
+
+
+@contextlib.contextmanager
+def time_it(name: str, log: bool = False) -> Iterator[None]:
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        elapsed = time.perf_counter() - start
+        timers.add(name, elapsed)
+        if log:
+            logger.info("%s: %.3fms", name, elapsed * 1e3)
+
+
+def tree_size_bytes(tree) -> int:
+    """Total byte size of all array leaves in a pytree."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return int(sum(np.prod(l.shape) * l.dtype.itemsize
+                   for l in leaves if hasattr(l, "shape")))
+
+
+def tree_num_params(tree) -> int:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return int(sum(np.prod(l.shape) for l in leaves if hasattr(l, "shape")))
+
+

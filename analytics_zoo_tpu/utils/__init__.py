@@ -1,0 +1,1 @@
+from .tensorboard import SummaryWriter, read_scalars  # noqa: F401

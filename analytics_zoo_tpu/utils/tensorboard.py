@@ -1,0 +1,278 @@
+"""Self-contained TensorBoard event-file writer/reader.
+
+The reference implements its own TF-event stack on the JVM —
+``tensorboard/EventWriter.scala``, ``RecordWriter.scala`` (CRC-masked TFRecord
+framing), ``FileWriter.scala``, ``Summary.scala``, and ``FileReader.scala`` for
+read-back (~553 LoC total). This is the same capability without a TensorFlow
+dependency: a minimal protobuf wire-format encoder for ``Event``/``Summary``
+scalar messages, masked-CRC32C TFRecord framing, an async file writer, and a
+reader used by ``get_train_summary`` equivalents and tests.
+
+TFRecord frame layout:
+  uint64 length | uint32 masked_crc32c(length) | bytes data | uint32 masked_crc32c(data)
+"""
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# CRC32C (Castagnoli), table-driven, pure python.
+# ---------------------------------------------------------------------------
+
+_CRC_TABLE: List[int] = []
+
+
+def _make_table() -> None:
+    poly = 0x82F63B78
+    for n in range(256):
+        crc = n
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        _CRC_TABLE.append(crc)
+
+
+_make_table()
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def masked_crc32c(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF)
+
+
+# ---------------------------------------------------------------------------
+# Minimal protobuf wire-format encoding for tensorboard Event messages.
+#
+# Event     { double wall_time = 1; int64 step = 2; string file_version = 3;
+#             Summary summary = 5; }
+# Summary   { repeated Value value = 1; }
+# Value     { string tag = 1; float simple_value = 2; }
+# ---------------------------------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= 0xFFFFFFFFFFFFFFFF
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire_type: int) -> bytes:
+    return _varint((field << 3) | wire_type)
+
+
+def _f64(field: int, value: float) -> bytes:
+    return _tag(field, 1) + struct.pack("<d", value)
+
+
+def _f32(field: int, value: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", value)
+
+
+def _i64(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(value)
+
+
+def _bytes_field(field: int, value: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(value)) + value
+
+
+def encode_scalar_event(tag: str, value: float, step: int,
+                        wall_time: Optional[float] = None) -> bytes:
+    if wall_time is None:
+        wall_time = time.time()
+    value_msg = _bytes_field(1, tag.encode("utf-8")) + _f32(2, float(value))
+    summary_msg = _bytes_field(1, value_msg)
+    return _f64(1, wall_time) + _i64(2, step) + _bytes_field(5, summary_msg)
+
+
+def encode_file_version_event(wall_time: Optional[float] = None) -> bytes:
+    if wall_time is None:
+        wall_time = time.time()
+    return _f64(1, wall_time) + _bytes_field(3, b"brain.Event:2")
+
+
+def frame_record(data: bytes) -> bytes:
+    header = struct.pack("<Q", len(data))
+    return (header + struct.pack("<I", masked_crc32c(header))
+            + data + struct.pack("<I", masked_crc32c(data)))
+
+
+# ---------------------------------------------------------------------------
+# Decoding (FileReader.scala equivalent) — enough to read scalars back.
+# ---------------------------------------------------------------------------
+
+
+def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _iter_fields(data: bytes) -> Iterator[Tuple[int, int, object]]:
+    pos = 0
+    while pos < len(data):
+        key, pos = _read_varint(data, pos)
+        field, wire = key >> 3, key & 0x7
+        if wire == 0:
+            val, pos = _read_varint(data, pos)
+        elif wire == 1:
+            val = struct.unpack("<d", data[pos:pos + 8])[0]
+            pos += 8
+        elif wire == 2:
+            ln, pos = _read_varint(data, pos)
+            val = data[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = struct.unpack("<f", data[pos:pos + 4])[0]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def decode_event(data: bytes) -> Dict[str, object]:
+    event: Dict[str, object] = {"scalars": []}
+    for field, wire, val in _iter_fields(data):
+        if field == 1 and wire == 1:
+            event["wall_time"] = val
+        elif field == 2 and wire == 0:
+            event["step"] = val
+        elif field == 3 and wire == 2:
+            event["file_version"] = val.decode("utf-8")
+        elif field == 5 and wire == 2:
+            for f2, w2, v2 in _iter_fields(val):
+                if f2 == 1 and w2 == 2:
+                    tag, simple = None, None
+                    for f3, w3, v3 in _iter_fields(v2):
+                        if f3 == 1 and w3 == 2:
+                            tag = v3.decode("utf-8")
+                        elif f3 == 2 and w3 == 5:
+                            simple = v3
+                    if tag is not None:
+                        event["scalars"].append((tag, simple))
+    return event
+
+
+def read_events(path: str) -> List[Dict[str, object]]:
+    events = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                break
+            (length,) = struct.unpack("<Q", header)
+            hcrc = f.read(4)
+            data = f.read(length)
+            dcrc = f.read(4)
+            if len(hcrc) < 4 or len(data) < length or len(dcrc) < 4:
+                break  # truncated tail of a file still being written = EOF
+            if struct.unpack("<I", hcrc)[0] != masked_crc32c(header):
+                raise ValueError("corrupt tfrecord header crc")
+            if struct.unpack("<I", dcrc)[0] != masked_crc32c(data):
+                raise ValueError("corrupt tfrecord data crc")
+            events.append(decode_event(data))
+    return events
+
+
+# ---------------------------------------------------------------------------
+# FileWriter — async, the EventWriter.scala queue-and-thread design.
+# ---------------------------------------------------------------------------
+
+
+class SummaryWriter:
+    """Writes TensorBoard scalar summaries to ``logdir``.
+
+    Equivalent of the reference's ``FileWriter``+``EventWriter`` pair: events
+    are queued and flushed by a daemon thread, files are named
+    ``events.out.tfevents.<ts>.<hostname>``.
+    """
+
+    def __init__(self, logdir: str, flush_secs: float = 2.0):
+        os.makedirs(logdir, exist_ok=True)
+        self.logdir = logdir
+        fname = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
+        self.path = os.path.join(logdir, fname)
+        self._queue: "queue.Queue[Optional[bytes]]" = queue.Queue()
+        self._file = open(self.path, "ab")
+        self._file.write(frame_record(encode_file_version_event()))
+        self._file.flush()
+        self._flush_secs = flush_secs
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        if self._closed:
+            raise RuntimeError("writer closed")
+        self._queue.put(frame_record(encode_scalar_event(tag, value, step)))
+
+    def _run(self) -> None:
+        while True:
+            try:
+                item = self._queue.get(timeout=self._flush_secs)
+            except queue.Empty:
+                self._file.flush()
+                continue
+            try:
+                if item is None:
+                    self._file.flush()
+                    return
+                self._file.write(item)
+            finally:
+                self._queue.task_done()
+
+    def flush(self) -> None:
+        self._queue.join()
+        self._file.flush()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(None)
+        self._thread.join(timeout=5)
+        self._file.close()
+
+    def __enter__(self) -> "SummaryWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_scalars(logdir: str, tag: str) -> List[Tuple[int, float]]:
+    """Read back all (step, value) pairs for ``tag`` — ``getTrainSummary``."""
+    out: List[Tuple[int, float]] = []
+    for fname in sorted(os.listdir(logdir)):
+        if not fname.startswith("events.out.tfevents"):
+            continue
+        for event in read_events(os.path.join(logdir, fname)):
+            for t, v in event.get("scalars", []):
+                if t == tag:
+                    out.append((int(event.get("step", 0)), v))
+    return sorted(out)

@@ -1,0 +1,29 @@
+"""Test harness: simulate an 8-device TPU mesh on CPU.
+
+The reference tests distributed semantics on a single host with multi-partition
+``local[n]`` Spark masters (SURVEY.md §4). The TPU equivalent is an 8-device
+virtual CPU mesh via ``xla_force_host_platform_device_count``, set before jax
+initializes.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def ctx():
+    from analytics_zoo_tpu.common.context import init_tpu_context, reset_context
+    reset_context()
+    context = init_tpu_context(force_reinit=True)
+    yield context
+    reset_context()

@@ -1,0 +1,103 @@
+"""Tests for the core runtime: config registry, context/mesh, triggers."""
+import os
+
+import pytest
+
+from analytics_zoo_tpu.common.config import Config
+from analytics_zoo_tpu.common.context import init_tpu_context, reset_context
+from analytics_zoo_tpu.common.triggers import (
+    And, EveryEpoch, MaxEpoch, MaxIteration, MaxScore, MinLoss, Or,
+    SeveralIteration, TrainingState)
+
+
+class TestConfig:
+    def test_default_and_override(self):
+        cfg = Config()
+        cfg.register("foo.bar", 3, "test flag")
+        assert cfg.get("foo.bar") == 3
+        cfg.set("foo.bar", 7)
+        assert cfg.get("foo.bar") == 7
+        cfg.unset("foo.bar")
+        assert cfg.get("foo.bar") == 3
+
+    def test_env_layer(self, monkeypatch):
+        cfg = Config()
+        cfg.register("retry.times", 5)
+        monkeypatch.setenv("ZOO_TPU_RETRY_TIMES", "9")
+        assert cfg.get("retry.times") == 9
+        # programmatic override beats env
+        cfg.set("retry.times", 2)
+        assert cfg.get("retry.times") == 2
+
+    def test_bool_parsing(self, monkeypatch):
+        cfg = Config()
+        cfg.register("flagb", False)
+        monkeypatch.setenv("ZOO_TPU_FLAGB", "true")
+        assert cfg.get("flagb") is True
+
+    def test_file_layer(self, tmp_path):
+        cfg = Config()
+        cfg.register("a", 1)
+        p = tmp_path / "conf.json"
+        p.write_text('{"a": 42, "extra": "x"}')
+        cfg.load_file(str(p))
+        assert cfg.get("a") == 42
+        assert cfg.get("extra") == "x"
+
+
+class TestContext:
+    def test_mesh_discovery(self, ctx):
+        assert ctx.num_devices == 8
+        assert ctx.mesh.axis_names == ("data",)
+        assert ctx.local_batch(64) == 64  # single process
+
+    def test_2d_mesh(self):
+        reset_context()
+        c = init_tpu_context(mesh_shape=(4, 2), force_reinit=True)
+        assert c.mesh.devices.shape == (4, 2)
+        assert c.mesh.axis_names == ("data", "model")
+        reset_context()
+
+    def test_bad_mesh_shape(self):
+        reset_context()
+        with pytest.raises(ValueError):
+            init_tpu_context(mesh_shape=(3,), force_reinit=True)
+        reset_context()
+
+
+class TestTriggers:
+    def test_every_epoch(self):
+        t = EveryEpoch()
+        assert not t(TrainingState(epoch=1, epoch_finished=False))
+        assert t(TrainingState(epoch=1, epoch_finished=True))
+
+    def test_several_iteration(self):
+        t = SeveralIteration(3)
+        fired = [i for i in range(1, 10) if t(TrainingState(iteration=i))]
+        assert fired == [3, 6, 9]
+
+    def test_max_epoch_iteration(self):
+        assert MaxEpoch(2)(TrainingState(epoch=3))
+        assert not MaxEpoch(2)(TrainingState(epoch=2))
+        assert MaxIteration(5)(TrainingState(iteration=5))
+
+    def test_score_loss(self):
+        assert MaxScore(0.9)(TrainingState(score=0.95))
+        assert not MaxScore(0.9)(TrainingState(score=None))
+        assert MinLoss(0.1)(TrainingState(loss=0.05))
+
+    def test_compose(self):
+        t = And(SeveralIteration(2), MinLoss(0.5))
+        assert t(TrainingState(iteration=4, loss=0.4))
+        assert not t(TrainingState(iteration=3, loss=0.4))
+        t2 = Or(MaxEpoch(1), MaxIteration(100))
+        assert t2(TrainingState(epoch=2, iteration=0))
+
+
+class TestTriggersSliced:
+    def test_every_epoch_with_slices(self):
+        t = EveryEpoch()
+        # 4 slices per epoch: fires only when the finished slice closes the epoch
+        fired = [s for s in range(1, 9)
+                 if t(TrainingState(num_slices=4, slice_index=s, epoch_finished=True))]
+        assert fired == [4, 8]

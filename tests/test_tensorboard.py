@@ -1,0 +1,60 @@
+"""Tests for the self-contained TensorBoard event writer/reader."""
+import struct
+
+from analytics_zoo_tpu.utils.tensorboard import (
+    SummaryWriter, crc32c, decode_event, encode_scalar_event, frame_record,
+    masked_crc32c, read_events, read_scalars)
+
+
+def test_crc32c_known_vectors():
+    # Known CRC32C test vectors (RFC 3720 / iSCSI)
+    assert crc32c(b"") == 0
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+def test_masked_crc_roundtrip():
+    data = b"hello tensorboard"
+    framed = frame_record(data)
+    length = struct.unpack("<Q", framed[:8])[0]
+    assert length == len(data)
+    assert struct.unpack("<I", framed[8:12])[0] == masked_crc32c(framed[:8])
+    assert framed[12:12 + length] == data
+
+
+def test_event_encode_decode():
+    raw = encode_scalar_event("Loss", 0.25, step=7, wall_time=123.5)
+    event = decode_event(raw)
+    assert event["step"] == 7
+    assert abs(event["wall_time"] - 123.5) < 1e-9
+    assert event["scalars"] == [("Loss", 0.25)]
+
+
+def test_writer_reader_roundtrip(tmp_path):
+    logdir = str(tmp_path / "train")
+    with SummaryWriter(logdir) as w:
+        for step in range(5):
+            w.add_scalar("Loss", 1.0 / (step + 1), step)
+            w.add_scalar("Throughput", 100.0 + step, step)
+        w.flush()
+    losses = read_scalars(logdir, "Loss")
+    assert [s for s, _ in losses] == [0, 1, 2, 3, 4]
+    assert abs(losses[2][1] - 1.0 / 3) < 1e-6
+    tp = read_scalars(logdir, "Throughput")
+    assert len(tp) == 5
+    # file_version header present
+    fname = [f for f in (tmp_path / "train").iterdir()][0]
+    events = read_events(str(fname))
+    assert events[0].get("file_version") == "brain.Event:2"
+
+
+def test_truncated_tail_is_eof(tmp_path):
+    logdir = str(tmp_path / "t")
+    with SummaryWriter(logdir) as w:
+        w.add_scalar("Loss", 1.0, 0)
+        w.flush()
+    fname = str(next((tmp_path / "t").iterdir()))
+    with open(fname, "ab") as f:
+        f.write(b"\x10\x00\x00")  # partial frame at tail (file still being written)
+    scalars = read_scalars(logdir, "Loss")
+    assert scalars == [(0, 1.0)]
