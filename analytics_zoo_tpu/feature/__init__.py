@@ -1,0 +1,5 @@
+from .featureset import FeatureSet, MemoryType  # noqa: F401
+from .device_feed import DeviceFeed  # noqa: F401
+from .preprocessing import (  # noqa: F401
+    ArrayToTensor, ChainedPreprocessing, FeatureLabelPreprocessing, Lambda,
+    Preprocessing, stack_records)

@@ -1,0 +1,42 @@
+"""Double-buffered host→device feed.
+
+The reference hides host→engine latency behind cached-RDD iterators and
+per-core replica threads; on TPU the equivalent is overlapping ``device_put``
+(async dispatch) with the previous step's compute. ``DeviceFeed`` keeps
+``prefetch`` batches in flight, each already sharded over the mesh's data
+axis, so the TPU never waits on the host (SURVEY.md §7 hard part (c)).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Any, Iterator, Optional
+
+from jax.sharding import Mesh
+
+from ..common.config import global_config
+from .preprocessing import Preprocessing
+from ..parallel.mesh import shard_batch
+
+
+class DeviceFeed:
+    def __init__(self, host_iterator: Iterator[Any], mesh: Mesh,
+                 prefetch: Optional[int] = None):
+        self._it = host_iterator
+        self._mesh = mesh
+        depth = prefetch if prefetch is not None else global_config().get("data.prefetch")
+        self._depth = max(1, int(depth))
+        self._buffer: collections.deque = collections.deque()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while len(self._buffer) < self._depth:
+            try:
+                batch = next(self._it)
+            except StopIteration:
+                break
+            self._buffer.append(shard_batch(self._mesh, batch))
+        if not self._buffer:
+            raise StopIteration
+        return self._buffer.popleft()

@@ -1,0 +1,227 @@
+"""FeatureSet — the train/eval dataset abstraction.
+
+Re-imagines the reference's ``FeatureSet`` (``zoo/.../feature/FeatureSet.scala:655``)
+for a TPU host: instead of cached Spark RDD partitions feeding JVM model
+replicas, a FeatureSet owns host-resident (or disk-spilled) arrays, shards them
+per process (multi-host) and yields numpy minibatches — endless + reshuffled
+per epoch for training, bounded for evaluation, exactly the
+``CachedDistributedFeatureSet`` iterator contract. Cache tiers mirror the
+reference's ``DRAM`` / ``DISK_n`` / ``PMEM`` memory types (``FeatureSet.scala:564,643``):
+``DRAM`` keeps arrays in host RAM, ``DISK`` spills to ``np.memmap``.
+Sub-epoch slicing (``numOfSlice``, ``DistributedFeatureSet.numOfSlice`` at
+``FeatureSet.scala:110``) lets huge epochs checkpoint/validate mid-epoch.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+from enum import Enum
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..common.context import get_context
+from .preprocessing import Preprocessing
+
+ArrayTree = Union[np.ndarray, Tuple[np.ndarray, ...], Dict[str, np.ndarray]]
+
+
+class MemoryType(Enum):
+    DRAM = "dram"
+    DISK = "disk"
+
+
+def _tree_map(fn, tree: ArrayTree) -> ArrayTree:
+    if isinstance(tree, tuple):
+        return tuple(fn(t) for t in tree)
+    if isinstance(tree, dict):
+        return {k: fn(v) for k, v in tree.items()}
+    return fn(tree)
+
+
+def _tree_leaves(tree: ArrayTree):
+    if isinstance(tree, tuple):
+        return list(tree)
+    if isinstance(tree, dict):
+        return list(tree.values())
+    return [tree]
+
+
+def _spill_to_disk(arr: np.ndarray, directory: str, name: str) -> np.ndarray:
+    path = os.path.join(directory, f"{name}.mmap")
+    mm = np.memmap(path, dtype=arr.dtype, mode="w+", shape=arr.shape)
+    mm[:] = arr[:]
+    mm.flush()
+    return np.memmap(path, dtype=arr.dtype, mode="r", shape=arr.shape)
+
+
+class FeatureSet:
+    """In-memory / disk-cached dataset of (features, labels) array trees.
+
+    ``features`` and ``labels`` are ndarrays or tuples/dicts of ndarrays whose
+    leading dimension is the record axis. ``labels`` may be None (inference).
+    """
+
+    def __init__(self,
+                 features: ArrayTree,
+                 labels: Optional[ArrayTree] = None,
+                 memory_type: MemoryType = MemoryType.DRAM,
+                 shuffle: bool = True,
+                 num_slices: int = 1,
+                 cache_dir: Optional[str] = None,
+                 shard: bool = True,
+                 seed: int = 0):
+        n = _tree_leaves(features)[0].shape[0]
+        for leaf in _tree_leaves(features) + (
+                _tree_leaves(labels) if labels is not None else []):
+            if leaf.shape[0] != n:
+                raise ValueError("all arrays must share the leading record axis")
+        ctx = get_context()
+        if shard and ctx.process_count > 1:
+            # Per-host shard (the TFDataFeatureSet shard_index contract,
+            # reference tfpark/TFDataFeatureSet.scala:120-160).
+            idx = np.arange(ctx.process_index, n, ctx.process_count)
+            features = _tree_map(lambda a: a[idx], features)
+            if labels is not None:
+                labels = _tree_map(lambda a: a[idx], labels)
+            n = len(idx)
+        if memory_type == MemoryType.DISK:
+            directory = cache_dir or tempfile.mkdtemp(prefix="zoo_featureset_")
+            os.makedirs(directory, exist_ok=True)
+            counter = [0]
+
+            def spill(a):
+                counter[0] += 1
+                return _spill_to_disk(np.asarray(a), directory, f"arr{counter[0]}")
+
+            features = _tree_map(spill, features)
+            if labels is not None:
+                labels = _tree_map(spill, labels)
+        self.features = features
+        self.labels = labels
+        self.size = n
+        self.memory_type = memory_type
+        self.shuffle = shuffle
+        self.num_slices = max(1, num_slices)
+        self._rng = np.random.default_rng(seed)
+
+    # -- constructors (reference TFDataset.from_* family) ---------------------
+
+    @classmethod
+    def from_ndarrays(cls, features: ArrayTree, labels: Optional[ArrayTree] = None,
+                      **kwargs) -> "FeatureSet":
+        to_np = lambda a: np.asarray(a)
+        features = _tree_map(to_np, features)
+        if labels is not None:
+            labels = _tree_map(to_np, labels)
+        return cls(features, labels, **kwargs)
+
+    @classmethod
+    def from_dataframe(cls, df, feature_cols: Sequence[str],
+                       label_cols: Optional[Sequence[str]] = None,
+                       **kwargs) -> "FeatureSet":
+        """Build from a pandas DataFrame (the NNFrames/DataFrameDataset path)."""
+        feats = tuple(np.asarray(df[c].to_numpy()) for c in feature_cols)
+        if len(feats) == 1:
+            feats = feats[0]
+        labels = None
+        if label_cols:
+            labels = tuple(np.asarray(df[c].to_numpy()) for c in label_cols)
+            if len(labels) == 1:
+                labels = labels[0]
+        return cls(feats, labels, **kwargs)
+
+    @classmethod
+    def from_generator(cls, gen: Callable[[], Iterator[Any]], size_hint: int,
+                       transform: Optional[Preprocessing] = None,
+                       **kwargs) -> "FeatureSet":
+        """Materialize a record generator (the PythonLoaderFeatureSet role:
+        arbitrary user loaders become cached host arrays)."""
+        from .preprocessing import stack_records
+        records = []
+        for i, r in enumerate(gen()):
+            if transform is not None:
+                r = transform.apply(r)
+            records.append(r)
+            if i + 1 >= size_hint:
+                break
+        if not records:
+            raise ValueError("generator yielded no records")
+        if isinstance(records[0], tuple) and len(records[0]) == 2:
+            xs = stack_records([r[0] for r in records])
+            ys = stack_records([r[1] for r in records])
+            return cls(xs, ys, **kwargs)
+        return cls(stack_records(records), None, **kwargs)
+
+    # -- transforms -----------------------------------------------------------
+
+    def transform(self, preprocessing: Preprocessing) -> "FeatureSet":
+        """Eagerly apply a record transform to features (reference
+        ``FeatureSet.transform``)."""
+        feats = _tree_map(lambda a: a, self.features)
+        records = [preprocessing.apply(_index_tree(feats, i)) for i in range(self.size)]
+        from .preprocessing import stack_records
+        fs = FeatureSet.__new__(FeatureSet)
+        fs.features = stack_records(records)
+        fs.labels = self.labels
+        fs.size = self.size
+        fs.memory_type = self.memory_type
+        fs.shuffle = self.shuffle
+        fs.num_slices = self.num_slices
+        fs._rng = self._rng
+        return fs
+
+    # -- iterators (the FeatureSet contract) ----------------------------------
+
+    def num_batches(self, batch_size: int, drop_remainder: bool = True) -> int:
+        if drop_remainder:
+            return self.size // batch_size
+        return (self.size + batch_size - 1) // batch_size
+
+    def _gather(self, idx: np.ndarray) -> Tuple[ArrayTree, Optional[ArrayTree]]:
+        x = _tree_map(lambda a: np.asarray(a[idx]), self.features)
+        y = (_tree_map(lambda a: np.asarray(a[idx]), self.labels)
+             if self.labels is not None else None)
+        return x, y
+
+    def train_iterator(self, batch_size: int) -> Iterator[Tuple[ArrayTree, Optional[ArrayTree]]]:
+        """Endless iterator; reshuffles every epoch; drops the remainder so
+        every step sees a full, static-shaped batch (XLA-friendly)."""
+        while True:
+            order = (self._rng.permutation(self.size) if self.shuffle
+                     else np.arange(self.size))
+            for start in range(0, self.size - batch_size + 1, batch_size):
+                yield self._gather(order[start:start + batch_size])
+
+    def eval_iterator(self, batch_size: int, pad_remainder: bool = False
+                      ) -> Iterator[Tuple[ArrayTree, Optional[ArrayTree], int]]:
+        """Bounded iterator; yields ``(x, y, valid_count)``. With
+        ``pad_remainder`` the tail batch is padded to full size (static shapes)
+        and ``valid_count`` marks the real records."""
+        for start in range(0, self.size, batch_size):
+            idx = np.arange(start, min(start + batch_size, self.size))
+            valid = len(idx)
+            if valid < batch_size:
+                if not pad_remainder:
+                    x, y = self._gather(idx)
+                    yield x, y, valid
+                    continue
+                idx = np.concatenate([idx, np.full(batch_size - valid, idx[-1])])
+            x, y = self._gather(idx)
+            yield x, y, valid
+
+    def slice_boundaries(self, batch_size: int) -> Sequence[int]:
+        """Iteration counts at which each sub-epoch slice ends (numOfSlice)."""
+        per_epoch = self.num_batches(batch_size)
+        per_slice = max(1, per_epoch // self.num_slices)
+        bounds = [per_slice * i for i in range(1, self.num_slices)]
+        bounds.append(per_epoch)
+        return bounds
+
+
+def _index_tree(tree: ArrayTree, i: int):
+    if isinstance(tree, tuple):
+        return tuple(t[i] for t in tree)
+    if isinstance(tree, dict):
+        return {k: v[i] for k, v in tree.items()}
+    return tree[i]

@@ -1,0 +1,88 @@
+"""Composable preprocessing pipeline.
+
+The reference's ``Preprocessing[A, B]`` transformers chain with ``->``
+(``zoo/.../feature/common/*.scala``) and adapt raw records into model inputs
+(``ArrayToTensor``, ``SeqToTensor``, ``TensorToSample``...). Here a
+``Preprocessing`` is a pure record transform, chained with ``>>``; the batch
+assembly path stacks transformed records into numpy minibatches (the
+``MTSampleToMiniBatch`` role) that the device feed shards onto the mesh.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+import numpy as np
+
+
+class Preprocessing:
+    """A record-level transform; chain with ``>>`` (reference: ``->``)."""
+
+    def apply(self, record: Any) -> Any:
+        raise NotImplementedError
+
+    def __rshift__(self, other: "Preprocessing") -> "ChainedPreprocessing":
+        return ChainedPreprocessing(self, other)
+
+    def __call__(self, records: Iterable[Any]) -> Iterator[Any]:
+        return (self.apply(r) for r in records)
+
+
+class ChainedPreprocessing(Preprocessing):
+    def __init__(self, *stages: Preprocessing):
+        flat = []
+        for s in stages:
+            if isinstance(s, ChainedPreprocessing):
+                flat.extend(s.stages)
+            else:
+                flat.append(s)
+        self.stages = tuple(flat)
+
+    def apply(self, record: Any) -> Any:
+        for s in self.stages:
+            record = s.apply(record)
+        return record
+
+
+class Lambda(Preprocessing):
+    def __init__(self, fn: Callable[[Any], Any]):
+        self.fn = fn
+
+    def apply(self, record: Any) -> Any:
+        return self.fn(record)
+
+
+class ArrayToTensor(Preprocessing):
+    """Coerce (nested) python/numpy data to float32 ndarrays
+    (reference ``ArrayToTensor``/``SeqToTensor``)."""
+
+    def __init__(self, dtype=np.float32):
+        self.dtype = dtype
+
+    def apply(self, record: Any) -> Any:
+        if isinstance(record, tuple):
+            return tuple(np.asarray(r, dtype=self.dtype) for r in record)
+        return np.asarray(record, dtype=self.dtype)
+
+
+class FeatureLabelPreprocessing(Preprocessing):
+    """Apply separate transforms to the feature and label of a (x, y) record
+    (reference ``FeatureLabelPreprocessing``)."""
+
+    def __init__(self, feature: Preprocessing, label: Preprocessing):
+        self.feature = feature
+        self.label = label
+
+    def apply(self, record: Any) -> Any:
+        x, y = record
+        return self.feature.apply(x), self.label.apply(y)
+
+
+def stack_records(records: Sequence[Any]) -> Any:
+    """Stack a list of records (arrays, or tuples/dicts of arrays) into one
+    batched record — the ``SampleToMiniBatch`` role."""
+    first = records[0]
+    if isinstance(first, tuple):
+        return tuple(np.stack([r[i] for r in records]) for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: np.stack([r[k] for r in records]) for k in first}
+    return np.stack(records)

@@ -1,0 +1,3 @@
+from .mesh import (  # noqa: F401
+    DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, SEQ_AXIS, data_sharding,
+    global_batch_shapes, param_sharding, replicated, shard_batch)

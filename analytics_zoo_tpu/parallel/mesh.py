@@ -1,0 +1,66 @@
+"""Mesh and sharding helpers — the distributed-communication layer.
+
+The reference's comm backend is BigDL's ``AllReduceParameter`` over Spark's
+BlockManager (reduce-scatter + allgather of gradient slices over TCP,
+``docs/docs/wp-bigdl.md:140-160``). On TPU none of that machinery exists as
+user code: shardings are *declared* here and XLA inserts the collectives
+(psum/reduce-scatter/allgather over ICI). This module owns the naming
+conventions and PartitionSpec construction the rest of the framework uses.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+
+
+def data_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Shard the leading (batch) axis over the data axis; replicate the rest."""
+    spec = P(DATA_AXIS, *([None] * (ndim - 1))) if ndim > 0 else P()
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch: Any) -> Any:
+    """Device-put a host batch pytree with the batch axis sharded over
+    ``data``. This is the host→device edge of the input pipeline (the
+    reference's FeatureSet-iterator → model-replica feed)."""
+    def put(x):
+        arr = np.asarray(x)
+        return jax.device_put(arr, data_sharding(mesh, arr.ndim))
+    return jax.tree_util.tree_map(put, batch)
+
+
+def param_sharding(mesh: Mesh, params: Any,
+                   rules: Optional[Sequence] = None) -> Any:
+    """Sharding pytree for parameters. Default: fully replicated (pure DP).
+    ``rules`` is a list of ``(predicate(path, leaf) -> PartitionSpec|None)``
+    applied in order — the hook tensor/expert parallel layouts plug into."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    specs = []
+    for path, leaf in flat:
+        spec = None
+        if rules:
+            for rule in rules:
+                spec = rule(path, leaf)
+                if spec is not None:
+                    break
+        specs.append(NamedSharding(mesh, spec if spec is not None else P()))
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def global_batch_shapes(batch: Any) -> Any:
+    """ShapeDtypeStruct pytree for a host batch (for AOT lowering)."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.asarray(x).shape, np.asarray(x).dtype),
+        batch)

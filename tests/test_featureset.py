@@ -1,0 +1,114 @@
+"""Data plane tests: FeatureSet contract, preprocessing, device feed."""
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature import (
+    ArrayToTensor, DeviceFeed, FeatureSet, FeatureLabelPreprocessing, Lambda,
+    MemoryType, Preprocessing)
+
+
+def make_fs(n=100, shuffle=True, **kw):
+    x = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+    y = np.arange(n, dtype=np.float32)
+    return FeatureSet.from_ndarrays(x, y, shuffle=shuffle, **kw)
+
+
+class TestFeatureSet:
+    def test_train_iterator_endless_and_reshuffles(self, ctx):
+        fs = make_fs(10, shuffle=True)
+        it = fs.train_iterator(batch_size=5)
+        epoch1 = [next(it) for _ in range(2)]
+        epoch2 = [next(it) for _ in range(2)]  # endless: keeps yielding
+        labels1 = np.concatenate([b[1] for b in epoch1])
+        labels2 = np.concatenate([b[1] for b in epoch2])
+        assert sorted(labels1) == list(range(10))
+        assert sorted(labels2) == list(range(10))
+        assert not np.array_equal(labels1, labels2)  # reshuffled (w.h.p.)
+
+    def test_train_iterator_drops_remainder(self, ctx):
+        fs = make_fs(10, shuffle=False)
+        it = fs.train_iterator(batch_size=4)
+        for _ in range(4):
+            x, y = next(it)
+            assert x.shape == (4, 4)  # static shape every step
+
+    def test_eval_iterator_bounded_with_tail(self, ctx):
+        fs = make_fs(10, shuffle=False)
+        batches = list(fs.eval_iterator(batch_size=4))
+        assert [b[2] for b in batches] == [4, 4, 2]
+        assert batches[-1][0].shape[0] == 2
+
+    def test_eval_iterator_pad_remainder(self, ctx):
+        fs = make_fs(10, shuffle=False)
+        batches = list(fs.eval_iterator(batch_size=4, pad_remainder=True))
+        assert [b[2] for b in batches] == [4, 4, 2]
+        assert all(b[0].shape[0] == 4 for b in batches)  # padded static shape
+
+    def test_disk_tier(self, ctx, tmp_path):
+        fs = make_fs(20, memory_type=MemoryType.DISK, cache_dir=str(tmp_path))
+        assert isinstance(fs.features, np.memmap)
+        x, y = next(fs.train_iterator(batch_size=10))
+        assert x.shape == (10, 4)
+        assert not isinstance(x, np.memmap)  # gathered to RAM per batch
+
+    def test_slice_boundaries(self, ctx):
+        fs = make_fs(100, num_slices=4)
+        assert list(fs.slice_boundaries(batch_size=10)) == [2, 4, 6, 10]
+
+    def test_mismatched_leading_axis(self, ctx):
+        with pytest.raises(ValueError):
+            FeatureSet(np.zeros((5, 2)), np.zeros(4))
+
+    def test_tuple_features(self, ctx):
+        fs = FeatureSet.from_ndarrays(
+            (np.zeros((8, 2)), np.ones((8, 3))), np.zeros(8))
+        x, y = next(fs.train_iterator(4))
+        assert x[0].shape == (4, 2) and x[1].shape == (4, 3)
+
+    def test_from_dataframe(self, ctx):
+        pd = pytest.importorskip("pandas")
+        df = pd.DataFrame({"a": [1.0, 2, 3, 4], "b": [0, 1, 0, 1]})
+        fs = FeatureSet.from_dataframe(df, feature_cols=["a"], label_cols=["b"])
+        assert fs.size == 4
+
+    def test_from_generator_with_transform(self, ctx):
+        def gen():
+            for i in range(6):
+                yield ([i, i], i % 2)
+        tr = FeatureLabelPreprocessing(ArrayToTensor(), ArrayToTensor())
+        fs = FeatureSet.from_generator(gen, size_hint=6, transform=tr)
+        assert fs.size == 6
+        assert fs.features.dtype == np.float32
+
+
+class TestPreprocessing:
+    def test_chain(self):
+        p = Lambda(lambda r: r + 1) >> Lambda(lambda r: r * 2)
+        assert p.apply(3) == 8
+        chained = p >> Lambda(lambda r: r - 1)
+        assert len(chained.stages) == 3
+        assert chained.apply(3) == 7
+
+
+class TestDeviceFeed:
+    def test_sharded_batches(self, ctx):
+        fs = make_fs(64, shuffle=False)
+        feed = DeviceFeed(fs.train_iterator(16), ctx.mesh, prefetch=2)
+        batch = next(feed)
+        x, y = batch
+        assert x.shape == (16, 4)
+        # batch axis sharded over the 8-device data axis
+        assert len(x.sharding.device_set) == 8
+
+    def test_bounded_feed_stops(self, ctx):
+        fs = make_fs(16, shuffle=False)
+        feed = DeviceFeed((b for b in fs.eval_iterator(8)), ctx.mesh)
+        assert len(list(feed)) == 2
+        with pytest.raises(StopIteration):
+            next(feed)
+
+    def test_indivisible_batch_raises(self, ctx):
+        fs = make_fs(8, shuffle=False)
+        feed = DeviceFeed(fs.train_iterator(4), ctx.mesh)  # 4 % 8 != 0
+        with pytest.raises(ValueError):
+            next(feed)
