@@ -1,0 +1,3 @@
+from .engine import (  # noqa: F401
+    Input, InputLayer, Layer, Model, Sequential, SymbolicTensor, init_model)
+from . import initializers, layers, metrics, objectives, optimizers  # noqa: F401

@@ -1,0 +1,413 @@
+"""Keras-style model engine, TPU-native.
+
+Re-designs the reference's Keras-1 DSL (``zoo/.../pipeline/api/keras/models/
+Topology.scala:65`` ``Sequential``/``Model`` compiled to BigDL graphs; Python
+mirror ``pyzoo/zoo/pipeline/api/keras/engine/topology.py:31``) as a functional
+JAX layer system:
+
+- a :class:`Layer` is a stateless *config*; parameters and mutable state
+  (e.g. BatchNorm running stats) live in external pytrees, created by
+  ``build`` and consumed by ``call`` — so the whole model is a pure function
+  XLA can trace, jit, and shard.
+- :class:`Sequential` chains layers; :class:`Model` is the functional graph
+  built by calling layers on symbolic tensors (``Input``). Operator
+  overloading on symbolic tensors gives the reference's autograd ``Variable``
+  algebra (``pipeline/api/autograd/math.scala:378``) for free.
+- ``compile/fit/evaluate/predict`` delegate to the Estimator's on-device
+  pjit'd train loop.
+
+Shapes follow Keras convention: ``(None, d1, d2, ...)`` with a ``None`` batch.
+"""
+from __future__ import annotations
+
+import itertools
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Shape = Tuple[Optional[int], ...]
+_name_counters: Dict[str, "itertools.count"] = defaultdict(lambda: itertools.count(1))
+
+
+def _auto_name(cls_name: str) -> str:
+    return f"{cls_name.lower()}_{next(_name_counters[cls_name])}"
+
+
+def reset_name_counters() -> None:
+    _name_counters.clear()
+
+
+class Layer:
+    """Base layer: ``build`` makes (params, state) pytrees, ``call`` is pure."""
+
+    def __init__(self, name: Optional[str] = None):
+        self._auto_named = name is None
+        self.name = name or _auto_name(type(self).__name__)
+        self.built_shape: Optional[Any] = None
+
+    # -- to override ----------------------------------------------------------
+
+    def build(self, rng: jax.Array, input_shape) -> Tuple[Any, Any]:
+        """Return ``(params, state)`` for ``input_shape``. Default: stateless."""
+        return {}, {}
+
+    def call(self, params: Any, state: Any, inputs: Any, *,
+             training: bool = False, rng: Optional[jax.Array] = None
+             ) -> Tuple[Any, Any]:
+        """Pure forward: return ``(outputs, new_state)``."""
+        raise NotImplementedError
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+    # -- graph building -------------------------------------------------------
+
+    def __call__(self, inputs):
+        """Called on symbolic tensor(s): record a graph node."""
+        syms = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        if not all(isinstance(s, SymbolicTensor) for s in syms):
+            raise TypeError(
+                f"{self.name} called on non-symbolic input; use "
+                f"layer.call(params, state, x) for concrete arrays")
+        in_shapes = [s.shape for s in syms]
+        shape_arg = in_shapes if isinstance(inputs, (list, tuple)) else in_shapes[0]
+        out_shape = self.compute_output_shape(shape_arg)
+        node = Node(self, list(syms))
+        if isinstance(out_shape, list):
+            outs = [SymbolicTensor(tuple(s), node, i) for i, s in enumerate(out_shape)]
+            node.n_outputs = len(outs)
+            return outs
+        return SymbolicTensor(tuple(out_shape), node, 0)
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name}>"
+
+
+def _scope_names(layers: Sequence["Layer"]) -> None:
+    """Deterministically rename auto-named layers by position within a
+    container, so two structurally identical models share parameter keys
+    (checkpoints stay loadable across model instances/processes)."""
+    counters: Dict[str, int] = defaultdict(int)
+    seen = set()
+    for layer in layers:
+        if id(layer) in seen:
+            continue
+        seen.add(id(layer))
+        cls = type(layer).__name__.lower()
+        counters[cls] += 1
+        if layer._auto_named:
+            layer.name = f"{cls}_{counters[cls]}"
+
+
+class Node:
+    """One application of a layer to symbolic inputs (supports shared layers)."""
+
+    def __init__(self, layer: Layer, inputs: List["SymbolicTensor"]):
+        self.layer = layer
+        self.inputs = inputs
+        self.n_outputs = 1
+
+
+class SymbolicTensor:
+    """Placeholder tensor in the functional graph (the autograd ``Variable``)."""
+
+    def __init__(self, shape: Shape, node: Optional[Node], index: int = 0,
+                 dtype=jnp.float32):
+        self.shape = shape
+        self.node = node
+        self.index = index
+        self.dtype = dtype
+
+    # autograd Variable operator algebra (reference api/autograd/math.scala)
+    def _binop(self, other, fn, symbol):
+        from .layers.core import ElementwiseOp
+        if isinstance(other, SymbolicTensor):
+            return ElementwiseOp.binary(fn, symbol)([self, other])
+        return ElementwiseOp.with_scalar(fn, symbol, other)(self)
+
+    def __add__(self, other):
+        return self._binop(other, jnp.add, "add")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, jnp.subtract, "sub")
+
+    def __rsub__(self, other):
+        from .layers.core import ElementwiseOp
+        return ElementwiseOp.with_scalar(lambda x, s: s - x, "rsub", other)(self)
+
+    def __mul__(self, other):
+        return self._binop(other, jnp.multiply, "mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, jnp.divide, "div")
+
+    def __neg__(self):
+        from .layers.core import ElementwiseOp
+        return ElementwiseOp.with_scalar(lambda x, s: -x, "neg", 0.0)(self)
+
+    def __pow__(self, p):
+        from .layers.core import ElementwiseOp
+        return ElementwiseOp.with_scalar(jnp.power, "pow", p)(self)
+
+    def __repr__(self):
+        return f"<SymbolicTensor {self.shape}>"
+
+
+class InputLayer(Layer):
+    def __init__(self, shape: Shape, name: Optional[str] = None):
+        super().__init__(name)
+        self.shape = (None,) + tuple(shape)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return inputs, state
+
+    def compute_output_shape(self, input_shape):
+        return self.shape
+
+
+def Input(shape: Shape, name: Optional[str] = None) -> SymbolicTensor:
+    layer = InputLayer(shape, name)
+    node = Node(layer, [])
+    return SymbolicTensor(layer.shape, node, 0)
+
+
+# ---------------------------------------------------------------------------
+# Containers
+# ---------------------------------------------------------------------------
+
+
+class _TrainableMixin:
+    """compile/fit/evaluate/predict surface shared by Sequential and Model
+    (the reference ``KerasNet`` contract, Topology.scala:65-260)."""
+
+    def compile(self, optimizer, loss, metrics: Optional[List] = None):
+        from . import objectives, optimizers as opt_mod
+        from ..estimator.estimator import Estimator
+        self.loss_fn = objectives.get(loss)
+        self.optimizer = opt_mod.get(optimizer)
+        self.metric_specs = [m for m in (metrics or [])]
+        self._estimator: Optional["Estimator"] = None
+
+    def _require_compiled(self):
+        if not hasattr(self, "loss_fn"):
+            raise RuntimeError("call compile(optimizer, loss) before fit/evaluate")
+
+    def get_estimator(self):
+        from ..estimator.estimator import Estimator
+        self._require_compiled()
+        if self._estimator is None:
+            self._estimator = Estimator(
+                model=self, loss_fn=self.loss_fn, optimizer=self.optimizer,
+                metrics=self.metric_specs)
+        return self._estimator
+
+    def set_tensorboard(self, log_dir: str, app_name: str) -> None:
+        self._tb = (log_dir, app_name)
+
+    def set_checkpoint(self, path: str, trigger=None) -> None:
+        self._ckpt = (path, trigger)
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm: float) -> None:
+        self._clip = ("l2", clip_norm)
+
+    def set_constant_gradient_clipping(self, min_value: float, max_value: float) -> None:
+        self._clip = ("const", (min_value, max_value))
+
+    def fit(self, x, y=None, batch_size=32, nb_epoch=1, validation_data=None,
+            featureset=None, **kwargs):
+        est = self.get_estimator()
+        for attr, setter in (("_tb", "set_tensorboard"), ("_ckpt", "set_checkpoint"),
+                             ("_clip", "set_gradient_clipping")):
+            if hasattr(self, attr):
+                getattr(est, setter)(*getattr(self, attr)) if attr != "_clip" \
+                    else est.set_gradient_clipping(getattr(self, attr))
+        from ..feature import FeatureSet
+        if featureset is None:
+            featureset = FeatureSet.from_ndarrays(x, y)
+        if validation_data is not None and not isinstance(validation_data, FeatureSet):
+            validation_data = FeatureSet.from_ndarrays(*validation_data)
+        return est.train(featureset, batch_size=batch_size, epochs=nb_epoch,
+                         validation_set=validation_data, **kwargs)
+
+    def evaluate(self, x, y=None, batch_size=32, featureset=None):
+        est = self.get_estimator()
+        from ..feature import FeatureSet
+        if featureset is None:
+            featureset = FeatureSet.from_ndarrays(x, y)
+        return est.evaluate(featureset, batch_size=batch_size)
+
+    def predict(self, x, batch_size=32, distributed: bool = True):
+        est = self.get_estimator()
+        return est.predict(x, batch_size=batch_size)
+
+    def get_weights(self):
+        est = self.get_estimator()
+        return est.get_params()
+
+    def set_weights(self, params):
+        est = self.get_estimator()
+        est.set_params(params)
+
+    def save_model(self, path: str) -> None:
+        self.get_estimator().save_checkpoint(path)
+
+    def load_weights(self, path: str) -> None:
+        self.get_estimator().load_checkpoint(path)
+
+
+class Sequential(Layer, _TrainableMixin):
+    """Linear stack of layers (reference ``Sequential``, Topology.scala:464)."""
+
+    def __init__(self, layers: Optional[Sequence[Layer]] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.layers: List[Layer] = []
+        for l in (layers or []):
+            self.add(l)
+
+    def add(self, layer: Layer) -> "Sequential":
+        self.layers.append(layer)
+        _scope_names(self.layers)
+        return self
+
+    def build(self, rng, input_shape):
+        params, state = {}, {}
+        shape = input_shape
+        for layer in self.layers:
+            rng, sub = jax.random.split(rng)
+            p, s = layer.build(sub, shape)
+            if p:
+                params[layer.name] = p
+            if s:
+                state[layer.name] = s
+            layer.built_shape = shape
+            shape = layer.compute_output_shape(shape)
+        self.built_shape = input_shape
+        self._output_shape = shape
+        return params, state
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        x = inputs
+        new_state = dict(state)
+        for layer in self.layers:
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            x, s = layer.call(params.get(layer.name, {}),
+                              state.get(layer.name, {}), x,
+                              training=training, rng=sub)
+            if s:
+                new_state[layer.name] = s
+        return x, new_state
+
+    def compute_output_shape(self, input_shape):
+        shape = input_shape
+        for layer in self.layers:
+            shape = layer.compute_output_shape(shape)
+        return shape
+
+
+class Model(Layer, _TrainableMixin):
+    """Functional graph model (reference ``Model``, Topology.scala:678)."""
+
+    def __init__(self, inputs, outputs, name: Optional[str] = None):
+        super().__init__(name)
+        self.inputs: List[SymbolicTensor] = (
+            list(inputs) if isinstance(inputs, (list, tuple)) else [inputs])
+        self.outputs: List[SymbolicTensor] = (
+            list(outputs) if isinstance(outputs, (list, tuple)) else [outputs])
+        self._single_output = not isinstance(outputs, (list, tuple))
+        self._nodes = self._topo_sort()
+        _scope_names([n.layer for n in self._nodes])
+
+    def _topo_sort(self) -> List[Node]:
+        order: List[Node] = []
+        seen = set()
+
+        def visit(node: Node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for sym in node.inputs:
+                if sym.node is not None:
+                    visit(sym.node)
+            order.append(node)
+
+        for out in self.outputs:
+            visit(out.node)
+        return order
+
+    def build(self, rng, input_shape=None):
+        params, state = {}, {}
+        built = set()
+        for node in self._nodes:
+            layer = node.layer
+            if layer.name in built or isinstance(layer, InputLayer):
+                continue
+            in_shapes = [s.shape for s in node.inputs]
+            shape_arg = in_shapes[0] if len(in_shapes) == 1 else in_shapes
+            rng, sub = jax.random.split(rng)
+            p, s = layer.build(sub, shape_arg)
+            if p:
+                params[layer.name] = p
+            if s:
+                state[layer.name] = s
+            layer.built_shape = shape_arg
+            built.add(layer.name)
+        self.built_shape = input_shape
+        return params, state
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        if len(xs) != len(self.inputs):
+            raise ValueError(f"model expects {len(self.inputs)} inputs, got {len(xs)}")
+        values: Dict[int, Any] = {}
+        for sym, x in zip(self.inputs, xs):
+            values[id(sym.node)] = (x,)
+        new_state = dict(state)
+        for node in self._nodes:
+            if id(node) in values:
+                continue
+            layer = node.layer
+            args = [values[id(s.node)][s.index] for s in node.inputs]
+            arg = args[0] if len(args) == 1 else args
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            out, s = layer.call(params.get(layer.name, {}),
+                                state.get(layer.name, {}), arg,
+                                training=training, rng=sub)
+            if s:
+                new_state[layer.name] = s
+            values[id(node)] = tuple(out) if isinstance(out, (list, tuple)) else (out,)
+        outs = [values[id(o.node)][o.index] for o in self.outputs]
+        return (outs[0] if self._single_output else outs), new_state
+
+    def compute_output_shape(self, input_shape):
+        shapes = [o.shape for o in self.outputs]
+        return shapes[0] if self._single_output else shapes
+
+
+def init_model(model: Layer, rng: jax.Array, sample_input) -> Tuple[Any, Any]:
+    """Build params/state from a concrete sample input (shape inference)."""
+    def shape_of(x):
+        a = np.asarray(x)
+        return (None,) + a.shape[1:]
+    if isinstance(sample_input, (list, tuple)):
+        shape = [shape_of(x) for x in sample_input]
+        if len(shape) == 1:
+            shape = shape[0]
+    elif isinstance(sample_input, dict):
+        shape = {k: shape_of(v) for k, v in sample_input.items()}
+    else:
+        shape = shape_of(sample_input)
+    return model.build(rng, shape)

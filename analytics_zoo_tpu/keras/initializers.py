@@ -1,0 +1,88 @@
+"""Weight initializers (reference: BigDL InitializationMethod family used by
+the Keras layers' ``init`` argument — glorot_uniform default)."""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Initializer = Callable[[jax.Array, Sequence[int], jnp.dtype], jax.Array]
+
+
+def _fans(shape: Sequence[int]) -> tuple:
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    receptive = int(np.prod(shape[:-2])) if len(shape) > 2 else 1
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def glorot_uniform(rng, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    limit = float(np.sqrt(6.0 / (fan_in + fan_out)))
+    return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+
+def glorot_normal(rng, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    std = float(np.sqrt(2.0 / (fan_in + fan_out)))
+    return std * jax.random.normal(rng, shape, dtype)
+
+
+def he_normal(rng, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    return float(np.sqrt(2.0 / fan_in)) * jax.random.normal(rng, shape, dtype)
+
+
+def he_uniform(rng, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    limit = float(np.sqrt(6.0 / fan_in))
+    return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+
+def lecun_uniform(rng, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    limit = float(np.sqrt(3.0 / fan_in))
+    return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+
+def uniform(rng, shape, dtype=jnp.float32, scale=0.05):
+    return jax.random.uniform(rng, shape, dtype, -scale, scale)
+
+
+def normal(rng, shape, dtype=jnp.float32, stddev=0.05):
+    return stddev * jax.random.normal(rng, shape, dtype)
+
+
+def zeros(rng, shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def ones(rng, shape, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+_REGISTRY = {
+    "glorot_uniform": glorot_uniform,
+    "glorot_normal": glorot_normal,
+    "he_normal": he_normal,
+    "he_uniform": he_uniform,
+    "lecun_uniform": lecun_uniform,
+    "uniform": uniform,
+    "normal": normal,
+    "zero": zeros,
+    "zeros": zeros,
+    "one": ones,
+    "ones": ones,
+}
+
+
+def get(init: Union[str, Initializer]) -> Initializer:
+    if callable(init):
+        return init
+    if init not in _REGISTRY:
+        raise ValueError(f"unknown initializer '{init}'; have {sorted(_REGISTRY)}")
+    return _REGISTRY[init]

@@ -1,0 +1,10 @@
+from .core import (  # noqa: F401
+    Activation, Dense, Dropout, ElementwiseOp, Flatten, Lambda, Merge, Permute,
+    RepeatVector, Reshape, Select, Squeeze, get_activation, merge)
+from .embedding import Embedding, WordEmbedding  # noqa: F401
+from .norm import BatchNormalization, LayerNormalization  # noqa: F401
+from .recurrent import GRU, LSTM, Bidirectional, SimpleRNN  # noqa: F401
+from .conv import (  # noqa: F401
+    AveragePooling2D, Conv1D, Conv2D, Convolution1D, Convolution2D,
+    GlobalAveragePooling1D, GlobalAveragePooling2D, GlobalMaxPooling1D,
+    GlobalMaxPooling2D, MaxPooling1D, MaxPooling2D, ZeroPadding2D)

@@ -1,0 +1,219 @@
+"""Convolution and pooling layers (reference ``Convolution{1,2}D.scala``,
+``MaxPooling*.scala``, ``AveragePooling*.scala``, ``GlobalAveragePooling*``).
+
+TPU design: NHWC layout (XLA's preferred TPU conv layout), channels-last
+kernels ``[kh, kw, cin, cout]``, ``lax.conv_general_dilated`` so XLA tiles
+directly onto the MXU. The reference's Theano/TF "th" channel-first mode is
+not reproduced — NHWC is the native layout and converters handle imports.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import initializers
+from ..engine import Layer
+from .core import get_activation
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v, v)
+
+
+def _conv_out(size, k, stride, padding):
+    if size is None:
+        return None
+    if padding == "SAME":
+        return -(-size // stride)
+    return (size - k) // stride + 1
+
+
+class Convolution2D(Layer):
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample=(1, 1), border_mode="valid",
+                 init="glorot_uniform", bias: bool = True,
+                 dilation=(1, 1), groups: int = 1,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = nb_filter
+        self.kernel_size = (nb_row, nb_col)
+        self.strides = _pair(subsample)
+        self.padding = "SAME" if border_mode == "same" else "VALID"
+        self.activation = get_activation(activation)
+        self.init = initializers.get(init)
+        self.use_bias = bias
+        self.dilation = _pair(dilation)
+        self.groups = groups
+
+    def build(self, rng, input_shape):
+        cin = input_shape[-1]
+        kh, kw = self.kernel_size
+        params = {"kernel": self.init(rng, (kh, kw, cin // self.groups, self.filters))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,))
+        return params, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        y = lax.conv_general_dilated(
+            inputs, params["kernel"].astype(inputs.dtype),
+            window_strides=self.strides, padding=self.padding,
+            rhs_dilation=self.dilation, feature_group_count=self.groups,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        n, h, w, _ = input_shape
+        kh, kw = self.kernel_size
+        sh, sw = self.strides
+        return (n, _conv_out(h, kh, sh, self.padding),
+                _conv_out(w, kw, sw, self.padding), self.filters)
+
+
+Conv2D = Convolution2D
+
+
+class Convolution1D(Layer):
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 subsample_length: int = 1, border_mode="valid",
+                 init="glorot_uniform", bias: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = nb_filter
+        self.kernel_size = filter_length
+        self.stride = subsample_length
+        self.padding = "SAME" if border_mode == "same" else "VALID"
+        self.activation = get_activation(activation)
+        self.init = initializers.get(init)
+        self.use_bias = bias
+
+    def build(self, rng, input_shape):
+        cin = input_shape[-1]
+        params = {"kernel": self.init(rng, (self.kernel_size, cin, self.filters))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,))
+        return params, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        y = lax.conv_general_dilated(
+            inputs, params["kernel"].astype(inputs.dtype),
+            window_strides=(self.stride,), padding=self.padding,
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        n, l, _ = input_shape
+        return (n, _conv_out(l, self.kernel_size, self.stride, self.padding),
+                self.filters)
+
+
+Conv1D = Convolution1D
+
+
+class _Pool2D(Layer):
+    def __init__(self, pool_size=(2, 2), strides=None, border_mode="valid",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.pool_size = _pair(pool_size)
+        self.strides = _pair(strides) if strides is not None else self.pool_size
+        self.padding = "SAME" if border_mode == "same" else "VALID"
+
+    def compute_output_shape(self, input_shape):
+        n, h, w, c = input_shape
+        ph, pw = self.pool_size
+        sh, sw = self.strides
+        return (n, _conv_out(h, ph, sh, self.padding),
+                _conv_out(w, pw, sw, self.padding), c)
+
+    def _reduce(self, inputs, init, op):
+        ph, pw = self.pool_size
+        sh, sw = self.strides
+        return lax.reduce_window(inputs, init, op, (1, ph, pw, 1),
+                                 (1, sh, sw, 1), self.padding)
+
+
+class MaxPooling2D(_Pool2D):
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return self._reduce(inputs, -jnp.inf, lax.max), state
+
+
+class AveragePooling2D(_Pool2D):
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        ph, pw = self.pool_size
+        summed = self._reduce(inputs, 0.0, lax.add)
+        return summed / (ph * pw), state
+
+
+class MaxPooling1D(Layer):
+    def __init__(self, pool_length=2, stride=None, border_mode="valid",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.pool = pool_length
+        self.stride = stride or pool_length
+        self.padding = "SAME" if border_mode == "same" else "VALID"
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        y = lax.reduce_window(inputs, -jnp.inf, lax.max, (1, self.pool, 1),
+                              (1, self.stride, 1), self.padding)
+        return y, state
+
+    def compute_output_shape(self, input_shape):
+        n, l, c = input_shape
+        return (n, _conv_out(l, self.pool, self.stride, self.padding), c)
+
+
+class GlobalMaxPooling2D(Layer):
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return jnp.max(inputs, axis=(1, 2)), state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], input_shape[3])
+
+
+class GlobalAveragePooling2D(Layer):
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return jnp.mean(inputs, axis=(1, 2)), state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], input_shape[3])
+
+
+class GlobalMaxPooling1D(Layer):
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return jnp.max(inputs, axis=1), state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], input_shape[2])
+
+
+class GlobalAveragePooling1D(Layer):
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return jnp.mean(inputs, axis=1), state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], input_shape[2])
+
+
+class ZeroPadding2D(Layer):
+    def __init__(self, padding=(1, 1), name: Optional[str] = None):
+        super().__init__(name)
+        self.pad = _pair(padding)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        ph, pw = self.pad
+        return jnp.pad(inputs, ((0, 0), (ph, ph), (pw, pw), (0, 0))), state
+
+    def compute_output_shape(self, input_shape):
+        n, h, w, c = input_shape
+        ph, pw = self.pad
+        return (n, None if h is None else h + 2 * ph,
+                None if w is None else w + 2 * pw, c)

@@ -1,0 +1,281 @@
+"""Core layers (reference: ``pipeline/api/keras/layers/{Dense,Dropout,Flatten,
+Reshape,Permute,RepeatVector,Merge,...}.scala`` and python mirror
+``pyzoo/zoo/pipeline/api/keras/layers/core.py``)."""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import initializers
+from ..engine import Layer, Shape
+
+# -- activations -------------------------------------------------------------
+
+_ACTIVATIONS = {
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "hard_sigmoid": jax.nn.hard_sigmoid,
+    "softmax": jax.nn.softmax,
+    "log_softmax": jax.nn.log_softmax,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "elu": jax.nn.elu,
+    "gelu": jax.nn.gelu,
+    "swish": jax.nn.swish,
+    "silu": jax.nn.silu,
+    "linear": lambda x: x,
+    None: lambda x: x,
+}
+
+
+def get_activation(act: Union[str, Callable, None]) -> Callable:
+    if callable(act):
+        return act
+    if act not in _ACTIVATIONS:
+        raise ValueError(f"unknown activation '{act}'")
+    return _ACTIVATIONS[act]
+
+
+class Activation(Layer):
+    def __init__(self, activation, name: Optional[str] = None):
+        super().__init__(name)
+        self.fn = get_activation(activation)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return self.fn(inputs), state
+
+
+class Dense(Layer):
+    """Fully connected layer (reference ``Dense.scala``). bf16-friendly: the
+    matmul runs in the input dtype so the MXU sees bfloat16 when the pipeline
+    casts activations."""
+
+    def __init__(self, output_dim: int, activation=None, init="glorot_uniform",
+                 bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.output_dim = output_dim
+        self.activation = get_activation(activation)
+        self.init = initializers.get(init)
+        self.use_bias = bias
+
+    def build(self, rng, input_shape):
+        in_dim = input_shape[-1]
+        k1, _ = jax.random.split(rng)
+        params = {"kernel": self.init(k1, (in_dim, self.output_dim))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.output_dim,))
+        return params, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        y = inputs @ params["kernel"].astype(inputs.dtype)
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
+class Dropout(Layer):
+    def __init__(self, p: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.rate = p
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        if not training or self.rate <= 0.0:
+            return inputs, state
+        if rng is None:
+            raise ValueError(f"{self.name}: dropout in training mode needs rng")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, inputs.shape)
+        return jnp.where(mask, inputs / keep, 0.0).astype(inputs.dtype), state
+
+
+class Flatten(Layer):
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return inputs.reshape(inputs.shape[0], -1), state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], int(np.prod(input_shape[1:])))
+
+
+class Reshape(Layer):
+    def __init__(self, target_shape: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.target_shape = tuple(target_shape)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return inputs.reshape((inputs.shape[0],) + self.target_shape), state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + self.target_shape
+
+
+class Permute(Layer):
+    def __init__(self, dims: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.dims = tuple(dims)  # 1-based over non-batch axes (Keras convention)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return jnp.transpose(inputs, (0,) + self.dims), state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + tuple(input_shape[d] for d in self.dims)
+
+
+class RepeatVector(Layer):
+    def __init__(self, n: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.n = n
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return jnp.repeat(inputs[:, None, :], self.n, axis=1), state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], self.n, input_shape[1])
+
+
+class Lambda(Layer):
+    """Arbitrary jax function as a layer (reference autograd ``Lambda.scala:95``)."""
+
+    def __init__(self, fn: Callable, output_shape_fn: Optional[Callable] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.fn = fn
+        self.output_shape_fn = output_shape_fn
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return self.fn(inputs), state
+
+    def compute_output_shape(self, input_shape):
+        if self.output_shape_fn is not None:
+            return self.output_shape_fn(input_shape)
+        # infer via abstract evaluation on the non-batch shape
+        def dummy(shape):
+            return jnp.zeros(tuple(1 if d is None else d for d in shape))
+        if isinstance(input_shape, list):
+            args = [dummy(s) for s in input_shape]
+            out = jax.eval_shape(self.fn, args)
+        else:
+            out = jax.eval_shape(self.fn, dummy(input_shape))
+        return (None,) + out.shape[1:]
+
+
+class ElementwiseOp(Layer):
+    """Elementwise binary/scalar op layer backing SymbolicTensor operators."""
+
+    def __init__(self, fn: Callable, symbol: str, scalar=None, binary=False,
+                 name: Optional[str] = None):
+        super().__init__(name or f"{symbol}_{id(fn) % 10000}")
+        self.fn = fn
+        self.scalar = scalar
+        self.binary = binary
+
+    @classmethod
+    def binary(cls, fn, symbol):
+        return cls(fn, symbol, binary=True)
+
+    @classmethod
+    def with_scalar(cls, fn, symbol, scalar):
+        return cls(fn, symbol, scalar=scalar)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        if self.binary:
+            a, b = inputs
+            return self.fn(a, b), state
+        return self.fn(inputs, self.scalar), state
+
+    def compute_output_shape(self, input_shape):
+        if self.binary:
+            return input_shape[0]
+        return input_shape
+
+
+class Merge(Layer):
+    """Merge a list of inputs (reference ``Merge.scala``): sum/mul/max/ave/
+    concat/dot/cosine."""
+
+    def __init__(self, mode: str = "sum", concat_axis: int = -1,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        if mode not in ("sum", "mul", "max", "ave", "min", "concat", "dot", "cosine"):
+            raise ValueError(f"unknown merge mode '{mode}'")
+        self.mode = mode
+        self.concat_axis = concat_axis
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        xs = list(inputs)
+        if self.mode == "sum":
+            out = sum(xs[1:], xs[0])
+        elif self.mode == "mul":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out * x
+        elif self.mode == "max":
+            out = jnp.stack(xs).max(axis=0)
+        elif self.mode == "min":
+            out = jnp.stack(xs).min(axis=0)
+        elif self.mode == "ave":
+            out = jnp.stack(xs).mean(axis=0)
+        elif self.mode == "concat":
+            out = jnp.concatenate(xs, axis=self.concat_axis)
+        elif self.mode == "dot":
+            out = jnp.sum(xs[0] * xs[1], axis=-1, keepdims=True)
+        else:  # cosine
+            a, b = xs[0], xs[1]
+            na = a / (jnp.linalg.norm(a, axis=-1, keepdims=True) + 1e-8)
+            nb = b / (jnp.linalg.norm(b, axis=-1, keepdims=True) + 1e-8)
+            out = jnp.sum(na * nb, axis=-1, keepdims=True)
+        return out, state
+
+    def compute_output_shape(self, input_shape):
+        shapes = input_shape
+        if self.mode in ("dot", "cosine"):
+            return (shapes[0][0], 1)
+        if self.mode == "concat":
+            ax = self.concat_axis
+            out = list(shapes[0])
+            dims = [s[ax] for s in shapes]
+            out[ax] = None if any(d is None for d in dims) else sum(dims)
+            return tuple(out)
+        return shapes[0]
+
+
+def merge(inputs, mode="sum", concat_axis=-1, name=None):
+    return Merge(mode, concat_axis, name)(inputs)
+
+
+class Select(Layer):
+    """Select index along a dim (reference ``Select.scala``)."""
+
+    def __init__(self, dim: int, index: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+        self.index = index
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return jnp.take(inputs, self.index, axis=self.dim), state
+
+    def compute_output_shape(self, input_shape):
+        s = list(input_shape)
+        del s[self.dim]
+        return tuple(s)
+
+
+class Squeeze(Layer):
+    def __init__(self, dim: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return jnp.squeeze(inputs, axis=self.dim), state
+
+    def compute_output_shape(self, input_shape):
+        s = list(input_shape)
+        del s[self.dim]
+        return tuple(s)

@@ -1,0 +1,63 @@
+"""Embedding layers (reference ``Embedding.scala``/``SparseEmbedding``/
+``WordEmbedding.scala``).
+
+TPU note (SURVEY.md §7 hard part (b)): the reference densifies sparse embedding
+grads through BigDL's allreduce; here gradients of ``jnp.take`` are naturally
+scatter-adds that XLA executes on-device, and under pure DP the psum of the
+dense grad table is the allreduce-stress case benchmarked by Wide&Deep. For
+giant tables, shard the vocab axis over the model axis via
+``parallel.mesh.param_sharding`` rules.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import initializers
+from ..engine import Layer
+
+
+class Embedding(Layer):
+    def __init__(self, input_dim: int, output_dim: int, init="uniform",
+                 input_length: Optional[int] = None, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+        self.init = initializers.get(init)
+        self.input_length = input_length
+
+    def build(self, rng, input_shape):
+        return {"embeddings": self.init(rng, (self.input_dim, self.output_dim))}, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        idx = inputs.astype(jnp.int32)
+        return jnp.take(params["embeddings"], idx, axis=0), state
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape) + (self.output_dim,)
+
+
+class WordEmbedding(Layer):
+    """Frozen pretrained word vectors (reference ``WordEmbedding.scala``):
+    the table lives in state (non-trainable), not params."""
+
+    def __init__(self, weights: np.ndarray, trainable: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.weights = jnp.asarray(weights)
+        self.trainable = trainable
+
+    def build(self, rng, input_shape):
+        if self.trainable:
+            return {"embeddings": self.weights}, {}
+        return {}, {"embeddings": self.weights}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        table = params.get("embeddings", state.get("embeddings"))
+        return jnp.take(table, inputs.astype(jnp.int32), axis=0), state
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape) + (self.weights.shape[1],)

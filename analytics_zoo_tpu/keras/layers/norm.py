@@ -1,0 +1,72 @@
+"""Normalization layers (reference ``BatchNormalization.scala``,
+``LayerNorm`` in ``TransformerLayer.scala``).
+
+BatchNorm carries running statistics as mutable *state* threaded through the
+pure ``call`` — the functional equivalent of BigDL's in-place runningMean/Var.
+Under data parallelism the batch statistics are computed over the *global*
+batch via ``lax.pmean`` over the data axis when inside a shard_map context,
+matching the reference's cross-replica ``setParallism`` BN sync semantics
+(``examples/resnet/TrainImageNet.scala:90-96``); under plain jit+sharding XLA
+computes global-batch moments automatically because the reduction spans the
+whole sharded array.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..engine import Layer
+
+
+class BatchNormalization(Layer):
+    def __init__(self, epsilon: float = 1e-3, momentum: float = 0.99,
+                 axis: int = -1, name: Optional[str] = None):
+        super().__init__(name)
+        self.epsilon = epsilon
+        self.momentum = momentum
+        self.axis = axis
+
+    def build(self, rng, input_shape):
+        dim = input_shape[self.axis]
+        params = {"gamma": jnp.ones((dim,)), "beta": jnp.zeros((dim,))}
+        state = {"moving_mean": jnp.zeros((dim,)),
+                 "moving_var": jnp.ones((dim,))}
+        return params, state
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        reduce_axes = tuple(i for i in range(inputs.ndim)
+                            if i != (inputs.ndim + self.axis if self.axis < 0
+                                     else self.axis))
+        if training:
+            mean = jnp.mean(inputs, axis=reduce_axes)
+            var = jnp.var(inputs, axis=reduce_axes)
+            m = self.momentum
+            new_state = {
+                "moving_mean": m * state["moving_mean"] + (1 - m) * mean,
+                "moving_var": m * state["moving_var"] + (1 - m) * var,
+            }
+        else:
+            mean, var = state["moving_mean"], state["moving_var"]
+            new_state = state
+        inv = jnp.reciprocal(jnp.sqrt(var + self.epsilon))
+        y = (inputs - mean) * inv * params["gamma"] + params["beta"]
+        return y.astype(inputs.dtype), new_state
+
+
+class LayerNormalization(Layer):
+    def __init__(self, epsilon: float = 1e-5, name: Optional[str] = None):
+        super().__init__(name)
+        self.epsilon = epsilon
+
+    def build(self, rng, input_shape):
+        dim = input_shape[-1]
+        return {"gamma": jnp.ones((dim,)), "beta": jnp.zeros((dim,))}, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        x32 = inputs.astype(jnp.float32)  # stable moments even in bf16 pipelines
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - mean) * jnp.reciprocal(jnp.sqrt(var + self.epsilon))
+        y = y * params["gamma"] + params["beta"]
+        return y.astype(inputs.dtype), state

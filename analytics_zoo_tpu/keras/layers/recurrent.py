@@ -1,0 +1,187 @@
+"""Recurrent layers (reference ``LSTM.scala``/``GRU.scala``/``SimpleRNN``/
+``Bidirectional.scala``).
+
+TPU design: the time loop is a single ``lax.scan`` whose body is one fused
+cell step — all four LSTM gates come from ONE ``[B, in+hidden] @ [in+hidden,
+4*units]`` matmul so the MXU sees a large tile per step instead of eight small
+ones (XLA cannot re-fuse gate-by-gate matmuls across a scan boundary). Static
+sequence length, no per-step Python.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import initializers
+from ..engine import Layer
+from .core import get_activation
+
+
+class _RNNBase(Layer):
+    def __init__(self, output_dim: int, return_sequences: bool = False,
+                 go_backwards: bool = False, init="glorot_uniform",
+                 inner_init="orthogonal", name: Optional[str] = None):
+        super().__init__(name)
+        self.output_dim = output_dim
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+        self.init = initializers.get(init if init != "orthogonal" else "glorot_uniform")
+        self.inner_init = self._orthogonal if inner_init == "orthogonal" \
+            else initializers.get(inner_init)
+
+    @staticmethod
+    def _orthogonal(rng, shape, dtype=jnp.float32):
+        rows, cols = shape
+        a = jax.random.normal(rng, (max(rows, cols), min(rows, cols)), dtype)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))[None, :]
+        if rows < cols:
+            q = q.T
+        return q[:rows, :cols]
+
+    def compute_output_shape(self, input_shape):
+        if self.return_sequences:
+            return (input_shape[0], input_shape[1], self.output_dim)
+        return (input_shape[0], self.output_dim)
+
+    def _run_scan(self, step, carry0, inputs):
+        xs = jnp.swapaxes(inputs, 0, 1)  # [T, B, D] scan layout
+        if self.go_backwards:
+            xs = xs[::-1]
+        carry, ys = jax.lax.scan(step, carry0, xs)
+        if self.go_backwards:
+            ys = ys[::-1]
+        return carry, jnp.swapaxes(ys, 0, 1)
+
+
+class LSTM(_RNNBase):
+    def build(self, rng, input_shape):
+        in_dim = input_shape[-1]
+        u = self.output_dim
+        k1, k2 = jax.random.split(rng)
+        # fused gate kernel: [in+hidden, 4u] (i, f, g, o)
+        kernel = jnp.concatenate(
+            [self.init(k1, (in_dim, 4 * u)), self.inner_init(k2, (u, 4 * u))], axis=0)
+        bias = jnp.zeros((4 * u,)).at[u:2 * u].set(1.0)  # forget-gate bias 1
+        return {"kernel": kernel, "bias": bias}, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        u = self.output_dim
+        kernel, bias = params["kernel"], params["bias"]
+        B = inputs.shape[0]
+        dtype = inputs.dtype
+
+        def step(carry, x_t):
+            h, c = carry
+            z = jnp.concatenate([x_t, h], axis=-1) @ kernel.astype(dtype) + bias.astype(dtype)
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+
+        carry0 = (jnp.zeros((B, u), dtype), jnp.zeros((B, u), dtype))
+        (h, _), ys = self._run_scan(step, carry0, inputs)
+        return (ys if self.return_sequences else h), state
+
+
+class GRU(_RNNBase):
+    def build(self, rng, input_shape):
+        in_dim = input_shape[-1]
+        u = self.output_dim
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        gates = jnp.concatenate(
+            [self.init(k1, (in_dim, 2 * u)), self.inner_init(k2, (u, 2 * u))], axis=0)
+        cand = jnp.concatenate(
+            [self.init(k3, (in_dim, u)), self.inner_init(k4, (u, u))], axis=0)
+        return {"gates": gates, "candidate": cand,
+                "gate_bias": jnp.zeros((2 * u,)), "cand_bias": jnp.zeros((u,))}, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        u = self.output_dim
+        B = inputs.shape[0]
+        dtype = inputs.dtype
+        gates_k = params["gates"].astype(dtype)
+        cand_k = params["candidate"].astype(dtype)
+        gb, cb = params["gate_bias"].astype(dtype), params["cand_bias"].astype(dtype)
+
+        def step(h, x_t):
+            zr = jnp.concatenate([x_t, h], axis=-1) @ gates_k + gb
+            z, r = jnp.split(jax.nn.sigmoid(zr), 2, axis=-1)
+            hh = jnp.tanh(jnp.concatenate([x_t, r * h], axis=-1) @ cand_k + cb)
+            h_new = z * h + (1 - z) * hh
+            return h_new, h_new
+
+        h0 = jnp.zeros((B, u), dtype)
+        h, ys = self._run_scan(step, h0, inputs)
+        return (ys if self.return_sequences else h), state
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, output_dim: int, activation="tanh", **kwargs):
+        super().__init__(output_dim, **kwargs)
+        self.activation = get_activation(activation)
+
+    def build(self, rng, input_shape):
+        in_dim = input_shape[-1]
+        u = self.output_dim
+        k1, k2 = jax.random.split(rng)
+        kernel = jnp.concatenate(
+            [self.init(k1, (in_dim, u)), self.inner_init(k2, (u, u))], axis=0)
+        return {"kernel": kernel, "bias": jnp.zeros((u,))}, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        u = self.output_dim
+        B = inputs.shape[0]
+        dtype = inputs.dtype
+        kernel = params["kernel"].astype(dtype)
+        bias = params["bias"].astype(dtype)
+
+        def step(h, x_t):
+            h_new = self.activation(jnp.concatenate([x_t, h], axis=-1) @ kernel + bias)
+            return h_new, h_new
+
+        h0 = jnp.zeros((B, u), dtype)
+        h, ys = self._run_scan(step, h0, inputs)
+        return (ys if self.return_sequences else h), state
+
+
+class Bidirectional(Layer):
+    """Wraps a recurrent layer; runs forward + backward and merges
+    (reference ``Bidirectional.scala``)."""
+
+    def __init__(self, layer: _RNNBase, merge_mode: str = "concat",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        import copy
+        self.forward = layer
+        self.backward = copy.copy(layer)
+        self.backward.name = layer.name + "_bwd"
+        self.backward.go_backwards = not layer.go_backwards
+        self.merge_mode = merge_mode
+
+    def build(self, rng, input_shape):
+        k1, k2 = jax.random.split(rng)
+        fp, _ = self.forward.build(k1, input_shape)
+        bp, _ = self.backward.build(k2, input_shape)
+        return {"forward": fp, "backward": bp}, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        yf, _ = self.forward.call(params["forward"], {}, inputs, training=training)
+        yb, _ = self.backward.call(params["backward"], {}, inputs, training=training)
+        if self.merge_mode == "concat":
+            return jnp.concatenate([yf, yb], axis=-1), state
+        if self.merge_mode == "sum":
+            return yf + yb, state
+        if self.merge_mode == "mul":
+            return yf * yb, state
+        if self.merge_mode == "ave":
+            return (yf + yb) / 2, state
+        raise ValueError(f"unknown merge_mode {self.merge_mode}")
+
+    def compute_output_shape(self, input_shape):
+        shape = self.forward.compute_output_shape(input_shape)
+        if self.merge_mode == "concat":
+            return tuple(shape[:-1]) + (shape[-1] * 2,)
+        return shape

@@ -1,0 +1,153 @@
+"""Validation metrics (reference ``pipeline/api/keras/metrics/`` — Accuracy,
+Top5Accuracy, AUC, MAE, plus BigDL's Loss metric).
+
+Streaming design: each metric is a pure accumulator — ``init_state()`` makes a
+zeros pytree, ``update(state, y_true, y_pred, mask)`` folds one (possibly
+padded) batch in on-device, ``compute(state)`` finalizes on host. This lets the
+Estimator run evaluation as one jitted scan over sharded batches with no
+host sync per batch; ``mask`` marks the valid rows of padded tail batches.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def _masked_mean_update(state, per_example, mask):
+    per_example = per_example.reshape(mask.shape[0], -1).mean(axis=-1)
+    return {"sum": state["sum"] + jnp.sum(per_example * mask),
+            "count": state["count"] + jnp.sum(mask)}
+
+
+class Metric:
+    name = "metric"
+
+    def init_state(self):
+        return {"sum": jnp.zeros(()), "count": jnp.zeros(())}
+
+    def update(self, state, y_true, y_pred, mask):
+        raise NotImplementedError
+
+    def compute(self, state):
+        return float(state["sum"] / jnp.maximum(state["count"], 1))
+
+
+class Accuracy(Metric):
+    """Binary (threshold 0.5) or categorical accuracy, auto-detected from the
+    prediction rank (reference zoo ``Accuracy.scala`` does the same)."""
+
+    name = "accuracy"
+
+    def update(self, state, y_true, y_pred, mask):
+        if y_pred.ndim > 1 and y_pred.shape[-1] > 1:
+            pred = jnp.argmax(y_pred, axis=-1)
+            if y_true.ndim == y_pred.ndim:
+                true = jnp.argmax(y_true, axis=-1)
+            else:
+                true = y_true.astype(jnp.int32)
+            correct = (pred == true).astype(jnp.float32)
+        else:
+            p = y_pred.reshape(y_pred.shape[0], -1)[:, 0]
+            t = y_true.reshape(y_true.shape[0], -1)[:, 0]
+            correct = ((p > 0.5) == (t > 0.5)).astype(jnp.float32)
+        return _masked_mean_update(state, correct, mask)
+
+
+class TopK(Metric):
+    def __init__(self, k: int = 5):
+        self.k = k
+        self.name = f"top{k}_accuracy"
+
+    def update(self, state, y_true, y_pred, mask):
+        true = (jnp.argmax(y_true, axis=-1) if y_true.ndim == y_pred.ndim
+                else y_true.astype(jnp.int32))
+        _, topk = jax.lax.top_k(y_pred, self.k)
+        correct = jnp.any(topk == true[..., None], axis=-1).astype(jnp.float32)
+        return _masked_mean_update(state, correct, mask)
+
+
+class MAE(Metric):
+    name = "mae"
+
+    def update(self, state, y_true, y_pred, mask):
+        err = jnp.abs(y_pred - y_true)
+        return _masked_mean_update(state, err, mask)
+
+
+class MSE(Metric):
+    name = "mse"
+
+    def update(self, state, y_true, y_pred, mask):
+        err = jnp.square(y_pred - y_true)
+        return _masked_mean_update(state, err, mask)
+
+
+class Loss(Metric):
+    """Streams the compiled loss function as a metric (BigDL ``Loss``)."""
+
+    name = "loss"
+
+    def __init__(self, loss_fn: Callable):
+        self.loss_fn = loss_fn
+
+    def update(self, state, y_true, y_pred, mask):
+        # per-batch loss weighted by valid count (loss fns reduce internally)
+        value = self.loss_fn(y_true, y_pred)
+        n = jnp.sum(mask)
+        return {"sum": state["sum"] + value * n, "count": state["count"] + n}
+
+
+class AUC(Metric):
+    """Streaming ROC-AUC via fixed threshold bins (jit-safe, like TF's AUC;
+    the reference wraps TF's metric in ``keras/metrics``)."""
+
+    name = "auc"
+
+    def __init__(self, num_thresholds: int = 200):
+        self.num_thresholds = num_thresholds
+
+    def init_state(self):
+        n = self.num_thresholds
+        return {"tp": jnp.zeros((n,)), "fp": jnp.zeros((n,)),
+                "tn": jnp.zeros((n,)), "fn": jnp.zeros((n,))}
+
+    def update(self, state, y_true, y_pred, mask):
+        p = y_pred.reshape(y_pred.shape[0], -1)[:, 0]
+        t = (y_true.reshape(y_true.shape[0], -1)[:, 0] > 0.5).astype(jnp.float32)
+        thresholds = jnp.linspace(0.0, 1.0, self.num_thresholds)
+        pred_pos = (p[None, :] >= thresholds[:, None]).astype(jnp.float32) * mask[None, :]
+        actual_pos = t[None, :] * mask[None, :]
+        actual_neg = (1 - t)[None, :] * mask[None, :]
+        return {
+            "tp": state["tp"] + jnp.sum(pred_pos * actual_pos, axis=1),
+            "fp": state["fp"] + jnp.sum(pred_pos * actual_neg, axis=1),
+            "fn": state["fn"] + jnp.sum((mask[None, :] - pred_pos) * actual_pos, axis=1),
+            "tn": state["tn"] + jnp.sum((mask[None, :] - pred_pos) * actual_neg, axis=1),
+        }
+
+    def compute(self, state):
+        tpr = state["tp"] / jnp.maximum(state["tp"] + state["fn"], 1e-7)
+        fpr = state["fp"] / jnp.maximum(state["fp"] + state["tn"], 1e-7)
+        # trapezoidal area over decreasing fpr
+        return float(jnp.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) / 2.0))
+
+
+_REGISTRY: Dict[str, Callable[[], Metric]] = {
+    "accuracy": Accuracy,
+    "acc": Accuracy,
+    "top5": lambda: TopK(5),
+    "top5_accuracy": lambda: TopK(5),
+    "mae": MAE,
+    "mse": MSE,
+    "auc": AUC,
+}
+
+
+def get(metric: Union[str, Metric]) -> Metric:
+    if isinstance(metric, Metric):
+        return metric
+    if metric not in _REGISTRY:
+        raise ValueError(f"unknown metric '{metric}'; have {sorted(_REGISTRY)}")
+    return _REGISTRY[metric]()

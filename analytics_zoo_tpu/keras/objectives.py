@@ -1,0 +1,147 @@
+"""Loss objectives (reference: ``pipeline/api/keras/objectives/`` — 16 losses).
+
+Every loss is ``fn(y_true, y_pred) -> scalar`` (mean over the batch), pure and
+jit-safe. Classification losses operate on probabilities by default (matching
+the reference's Keras-1 contract) with ``from_logits`` variants where numeric
+stability on TPU wants the fused log-softmax form.
+"""
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+def _clip(p):
+    return jnp.clip(p, _EPS, 1.0 - _EPS)
+
+
+def mean_squared_error(y_true, y_pred):
+    return jnp.mean(jnp.square(y_pred - y_true))
+
+
+def mean_absolute_error(y_true, y_pred):
+    return jnp.mean(jnp.abs(y_pred - y_true))
+
+
+def mean_absolute_percentage_error(y_true, y_pred):
+    diff = jnp.abs((y_true - y_pred) / jnp.clip(jnp.abs(y_true), _EPS, None))
+    return 100.0 * jnp.mean(diff)
+
+
+def mean_squared_logarithmic_error(y_true, y_pred):
+    a = jnp.log(jnp.clip(y_pred, _EPS, None) + 1.0)
+    b = jnp.log(jnp.clip(y_true, _EPS, None) + 1.0)
+    return jnp.mean(jnp.square(a - b))
+
+
+def binary_crossentropy(y_true, y_pred):
+    p = _clip(y_pred)
+    return -jnp.mean(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log(1.0 - p))
+
+
+def binary_crossentropy_from_logits(y_true, y_pred):
+    return jnp.mean(jnp.maximum(y_pred, 0) - y_pred * y_true
+                    + jnp.log1p(jnp.exp(-jnp.abs(y_pred))))
+
+
+def categorical_crossentropy(y_true, y_pred):
+    return -jnp.mean(jnp.sum(y_true * jnp.log(_clip(y_pred)), axis=-1))
+
+
+def categorical_crossentropy_from_logits(y_true, y_pred):
+    return -jnp.mean(jnp.sum(y_true * jax.nn.log_softmax(y_pred, axis=-1), axis=-1))
+
+
+def sparse_categorical_crossentropy(y_true, y_pred):
+    idx = y_true.astype(jnp.int32)
+    logp = jnp.log(_clip(y_pred))
+    return -jnp.mean(jnp.take_along_axis(logp, idx[..., None], axis=-1))
+
+
+def sparse_categorical_crossentropy_from_logits(y_true, y_pred):
+    idx = y_true.astype(jnp.int32)
+    logp = jax.nn.log_softmax(y_pred, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, idx[..., None], axis=-1))
+
+
+def kullback_leibler_divergence(y_true, y_pred):
+    p = _clip(y_true)
+    q = _clip(y_pred)
+    return jnp.mean(jnp.sum(p * jnp.log(p / q), axis=-1))
+
+
+def poisson(y_true, y_pred):
+    return jnp.mean(y_pred - y_true * jnp.log(y_pred + _EPS))
+
+
+def cosine_proximity(y_true, y_pred):
+    a = y_true / (jnp.linalg.norm(y_true, axis=-1, keepdims=True) + _EPS)
+    b = y_pred / (jnp.linalg.norm(y_pred, axis=-1, keepdims=True) + _EPS)
+    return -jnp.mean(jnp.sum(a * b, axis=-1))
+
+
+def hinge(y_true, y_pred):
+    return jnp.mean(jnp.maximum(1.0 - y_true * y_pred, 0.0))
+
+
+def squared_hinge(y_true, y_pred):
+    return jnp.mean(jnp.square(jnp.maximum(1.0 - y_true * y_pred, 0.0)))
+
+
+def rank_hinge(y_true, y_pred, margin: float = 1.0):
+    """Pairwise ranking hinge for text matching (reference ``RankHinge.scala``):
+    consecutive (positive, negative) pairs along the batch axis."""
+    pos = y_pred[0::2]
+    neg = y_pred[1::2]
+    return jnp.mean(jnp.maximum(margin - pos + neg, 0.0))
+
+
+def log_cosh(y_true, y_pred):
+    d = y_pred - y_true
+    return jnp.mean(d + jax.nn.softplus(-2.0 * d) - jnp.log(2.0))
+
+
+def huber(y_true, y_pred, delta: float = 1.0):
+    d = jnp.abs(y_pred - y_true)
+    quad = jnp.minimum(d, delta)
+    return jnp.mean(0.5 * quad ** 2 + delta * (d - quad))
+
+
+_REGISTRY = {
+    "mse": mean_squared_error,
+    "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error,
+    "mean_absolute_error": mean_absolute_error,
+    "mape": mean_absolute_percentage_error,
+    "mean_absolute_percentage_error": mean_absolute_percentage_error,
+    "msle": mean_squared_logarithmic_error,
+    "mean_squared_logarithmic_error": mean_squared_logarithmic_error,
+    "binary_crossentropy": binary_crossentropy,
+    "binary_crossentropy_from_logits": binary_crossentropy_from_logits,
+    "categorical_crossentropy": categorical_crossentropy,
+    "categorical_crossentropy_from_logits": categorical_crossentropy_from_logits,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "sparse_categorical_crossentropy_from_logits":
+        sparse_categorical_crossentropy_from_logits,
+    "kld": kullback_leibler_divergence,
+    "kullback_leibler_divergence": kullback_leibler_divergence,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "rank_hinge": rank_hinge,
+    "log_cosh": log_cosh,
+    "huber": huber,
+}
+
+
+def get(loss: Union[str, Callable]) -> Callable:
+    if callable(loss):
+        return loss
+    if loss not in _REGISTRY:
+        raise ValueError(f"unknown loss '{loss}'; have {sorted(_REGISTRY)}")
+    return _REGISTRY[loss]

@@ -1,0 +1,145 @@
+"""Optimizers and LR schedules (reference: BigDL ``OptimMethod`` family plus
+zoo extras ``keras/optimizers/`` — ``AdamWeightDecay`` with warmup/linear decay
+as used for BERT — and the ``Fixed`` schedule in ``common/Optim.scala:23``).
+
+Backed by optax: each wrapper produces an ``optax.GradientTransformation`` so
+the optimizer update runs inside the jitted train step on device — the
+reference applies its optimizer on parameter-slice owners between Spark jobs;
+here it's fused into the same XLA program as the backward pass.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import optax
+
+Schedule = Union[float, Callable[[int], float]]
+
+
+def fixed(lr: float) -> Callable[[int], float]:
+    """Constant LR (reference ``Fixed``)."""
+    return lambda step: lr
+
+
+def poly(lr: float, power: float, max_steps: int) -> Callable[[int], float]:
+    return optax.polynomial_schedule(lr, 0.0, power, max_steps)
+
+
+def warmup_linear_decay(lr: float, warmup_steps: int, total_steps: int
+                        ) -> Callable[[int], float]:
+    """Linear warmup then linear decay to 0 (the BERT ``AdamWeightDecay``
+    schedule, reference ``keras/optimizers/AdamWeightDecay``)."""
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, lr, warmup_steps),
+         optax.linear_schedule(lr, 0.0, max(1, total_steps - warmup_steps))],
+        [warmup_steps])
+
+
+def warmup_cosine_decay(lr: float, warmup_steps: int, total_steps: int
+                        ) -> Callable[[int], float]:
+    return optax.warmup_cosine_decay_schedule(
+        0.0, lr, warmup_steps, total_steps)
+
+
+class Optimizer:
+    """Named wrapper so models can introspect/serialize their optimizer."""
+
+    def __init__(self, name: str, tx: optax.GradientTransformation,
+                 learning_rate: Schedule):
+        self.name = name
+        self.tx = tx
+        self.learning_rate = learning_rate
+
+    def init(self, params):
+        return self.tx.init(params)
+
+    def update(self, grads, opt_state, params=None):
+        return self.tx.update(grads, opt_state, params)
+
+
+def SGD(learningrate: float = 0.01, momentum: float = 0.0, dampening: float = 0.0,
+        nesterov: bool = False, weightdecay: float = 0.0,
+        learningrate_schedule: Optional[Schedule] = None) -> Optimizer:
+    lr = learningrate_schedule if learningrate_schedule is not None else learningrate
+    parts = []
+    if weightdecay > 0:
+        parts.append(optax.add_decayed_weights(weightdecay))
+    parts.append(optax.sgd(lr, momentum=momentum or None, nesterov=nesterov))
+    return Optimizer("sgd", optax.chain(*parts), lr)
+
+
+def Adam(learningrate: float = 1e-3, beta1: float = 0.9, beta2: float = 0.999,
+         epsilon: float = 1e-8,
+         learningrate_schedule: Optional[Schedule] = None) -> Optimizer:
+    lr = learningrate_schedule if learningrate_schedule is not None else learningrate
+    return Optimizer("adam", optax.adam(lr, b1=beta1, b2=beta2, eps=epsilon), lr)
+
+
+def AdamWeightDecay(learningrate: float = 1e-4, warmup_portion: float = -1.0,
+                    total: int = -1, schedule: str = "linear",
+                    beta1: float = 0.9, beta2: float = 0.999,
+                    epsilon: float = 1e-6, weight_decay: float = 0.01
+                    ) -> Optimizer:
+    """BERT-style AdamW with warmup (reference ``AdamWeightDecay``)."""
+    if total > 0 and warmup_portion > 0:
+        warmup = int(total * warmup_portion)
+        lr = (warmup_linear_decay(learningrate, warmup, total)
+              if schedule == "linear"
+              else warmup_cosine_decay(learningrate, warmup, total))
+    else:
+        lr = learningrate
+    return Optimizer(
+        "adam_weight_decay",
+        optax.adamw(lr, b1=beta1, b2=beta2, eps=epsilon, weight_decay=weight_decay),
+        lr)
+
+
+def RMSprop(learningrate: float = 1e-3, decayrate: float = 0.9,
+            epsilon: float = 1e-8) -> Optimizer:
+    return Optimizer("rmsprop",
+                     optax.rmsprop(learningrate, decay=decayrate, eps=epsilon),
+                     learningrate)
+
+
+def Adagrad(learningrate: float = 1e-2, weightdecay: float = 0.0) -> Optimizer:
+    parts = []
+    if weightdecay > 0:
+        parts.append(optax.add_decayed_weights(weightdecay))
+    parts.append(optax.adagrad(learningrate))
+    return Optimizer("adagrad", optax.chain(*parts), learningrate)
+
+
+def Adadelta(decayrate: float = 0.9, epsilon: float = 1e-10) -> Optimizer:
+    return Optimizer("adadelta", optax.adadelta(rho=decayrate, eps=epsilon), 1.0)
+
+
+def LARS(learningrate: float = 0.1, momentum: float = 0.9,
+         weightdecay: float = 1e-4,
+         learningrate_schedule: Optional[Schedule] = None) -> Optimizer:
+    """Layer-wise adaptive rate scaling for large-batch ResNet training."""
+    lr = learningrate_schedule if learningrate_schedule is not None else learningrate
+    return Optimizer("lars",
+                     optax.lars(lr, weight_decay=weightdecay, momentum=momentum),
+                     lr)
+
+
+_FACTORIES = {
+    "sgd": SGD,
+    "adam": Adam,
+    "adamw": AdamWeightDecay,
+    "adam_weight_decay": AdamWeightDecay,
+    "rmsprop": RMSprop,
+    "adagrad": Adagrad,
+    "adadelta": Adadelta,
+    "lars": LARS,
+}
+
+
+def get(optimizer: Union[str, Optimizer]) -> Optimizer:
+    if isinstance(optimizer, Optimizer):
+        return optimizer
+    if isinstance(optimizer, optax.GradientTransformation):
+        return Optimizer("custom", optimizer, 0.0)
+    if optimizer not in _FACTORIES:
+        raise ValueError(f"unknown optimizer '{optimizer}'; have {sorted(_FACTORIES)}")
+    return _FACTORIES[optimizer]()

@@ -1,0 +1,150 @@
+"""Estimator end-to-end tests on the 8-device CPU mesh (reference strategy:
+distributed-loop semantics on a simulated multi-node local master)."""
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.triggers import MaxIteration, SeveralIteration
+from analytics_zoo_tpu.estimator import Estimator
+from analytics_zoo_tpu.feature import FeatureSet
+from analytics_zoo_tpu.keras import Sequential, objectives, optimizers
+from analytics_zoo_tpu.keras.layers import Dense
+from analytics_zoo_tpu.utils.tensorboard import read_scalars
+
+
+def make_regression(n=256, d=4, seed=0):
+    rs = np.random.RandomState(seed)
+    w = rs.randn(d, 1).astype(np.float32)
+    x = rs.randn(n, d).astype(np.float32)
+    y = x @ w + 0.01 * rs.randn(n, 1).astype(np.float32)
+    return x, y
+
+
+def make_estimator(metrics=None):
+    model = Sequential([Dense(8, activation="tanh"), Dense(1)])
+    return Estimator(model=model, loss_fn=objectives.get("mse"),
+                     optimizer=optimizers.Adam(1e-2), metrics=metrics or [])
+
+
+class TestTraining:
+    def test_loss_decreases(self, ctx):
+        x, y = make_regression()
+        est = make_estimator()
+        fs = FeatureSet.from_ndarrays(x, y, seed=1)
+        result = est.train(fs, batch_size=64, epochs=10)
+        h = result["loss_history"]
+        assert h[-1] < h[0] * 0.5
+        assert result["iterations"] == 10 * (256 // 64)
+
+    def test_end_trigger_max_iteration(self, ctx):
+        x, y = make_regression()
+        est = make_estimator()
+        fs = FeatureSet.from_ndarrays(x, y)
+        result = est.train(fs, batch_size=64, end_trigger=MaxIteration(7))
+        assert result["iterations"] == 7
+
+    def test_evaluate_and_predict(self, ctx):
+        x, y = make_regression(n=100)
+        est = make_estimator(metrics=["mae", "mse"])
+        fs = FeatureSet.from_ndarrays(x, y)
+        est.train(fs, batch_size=32, epochs=40)
+        scores = est.evaluate(FeatureSet.from_ndarrays(x, y, shuffle=False),
+                              batch_size=32)
+        assert set(scores) == {"mae", "mse"}
+        assert scores["mse"] < 0.5
+        preds = est.predict(x, batch_size=32)
+        assert preds.shape == (100, 1)  # remainder rows preserved
+        np.testing.assert_allclose(
+            np.mean((preds - y) ** 2), scores["mse"], rtol=0.2, atol=0.05)
+
+    def test_gradient_clipping(self, ctx):
+        x, y = make_regression()
+        est = make_estimator()
+        est.set_gradient_clipping(("l2", 0.1))
+        fs = FeatureSet.from_ndarrays(x, y)
+        result = est.train(fs, batch_size=64, epochs=2)
+        assert result["loss_history"][-1] < result["loss_history"][0]
+
+    def test_validation_during_training(self, ctx):
+        x, y = make_regression()
+        est = make_estimator(metrics=["mae"])
+        fs = FeatureSet.from_ndarrays(x, y)
+        val = FeatureSet.from_ndarrays(x[:64], y[:64], shuffle=False)
+        est.train(fs, batch_size=64, epochs=2, validation_set=val)
+
+    def test_tensorboard_scalars(self, ctx, tmp_path):
+        x, y = make_regression()
+        est = make_estimator()
+        est.set_tensorboard(str(tmp_path), "app")
+        fs = FeatureSet.from_ndarrays(x, y)
+        est.train(fs, batch_size=64, epochs=2)
+        losses = read_scalars(os.path.join(str(tmp_path), "app", "train"), "Loss")
+        assert len(losses) == 8
+        lrs = read_scalars(os.path.join(str(tmp_path), "app", "train"),
+                           "LearningRate")
+        assert lrs[0][1] == pytest.approx(1e-2)
+
+
+class TestCheckpoint:
+    def test_save_load_roundtrip(self, ctx, tmp_path):
+        x, y = make_regression()
+        est = make_estimator()
+        fs = FeatureSet.from_ndarrays(x, y)
+        est.train(fs, batch_size=64, epochs=2)
+        preds1 = est.predict(x[:64])
+        path = str(tmp_path / "ckpt")
+        est.save_checkpoint(path)
+
+        est2 = make_estimator()
+        est2.load_checkpoint(path)
+        preds2 = est2.predict(x[:64])
+        np.testing.assert_allclose(preds1, preds2, rtol=1e-5)
+        assert est2.global_step == est.global_step
+
+    def test_resume_training(self, ctx, tmp_path):
+        x, y = make_regression()
+        est = make_estimator()
+        fs = FeatureSet.from_ndarrays(x, y)
+        est.train(fs, batch_size=64, epochs=1)
+        path = str(tmp_path / "ckpt")
+        est.save_checkpoint(path)
+        est2 = make_estimator()
+        est2.load_checkpoint(path)
+        r = est2.train(fs, batch_size=64, epochs=2)  # continues to epoch 2
+        assert est2.global_step > est.global_step
+
+    def test_periodic_snapshots(self, ctx, tmp_path):
+        x, y = make_regression()
+        est = make_estimator()
+        est.set_checkpoint(str(tmp_path), SeveralIteration(2))
+        fs = FeatureSet.from_ndarrays(x, y)
+        est.train(fs, batch_size=64, epochs=1)  # 4 iterations
+        snaps = [d for d in os.listdir(tmp_path) if d.startswith("snapshot-")]
+        assert len(snaps) == 2  # at iterations 2 and 4
+
+
+class TestKerasFacade:
+    def test_compile_fit_evaluate(self, ctx):
+        x, y = make_regression(n=128)
+        model = Sequential([Dense(8, activation="tanh"), Dense(1)])
+        model.compile(optimizer="adam", loss="mse", metrics=["mae"])
+        model.fit(x, y, batch_size=32, nb_epoch=5)
+        scores = model.evaluate(x, y, batch_size=32)
+        assert "mae" in scores
+        preds = model.predict(x)
+        assert preds.shape == (128, 1)
+
+    def test_get_set_weights(self, ctx):
+        x, y = make_regression(n=64)
+        model = Sequential([Dense(4), Dense(1)])
+        model.compile(optimizer="sgd", loss="mse")
+        model.fit(x, y, batch_size=32, nb_epoch=1)
+        w = model.get_weights()
+        preds1 = model.predict(x)
+        model.set_weights(jax.tree_util.tree_map(lambda a: a * 0.0, w))
+        preds_zero = model.predict(x)
+        np.testing.assert_allclose(preds_zero, 0.0, atol=1e-6)
+        model.set_weights(w)
+        np.testing.assert_allclose(model.predict(x), preds1, rtol=1e-6)

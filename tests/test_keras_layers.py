@@ -1,0 +1,217 @@
+"""Layer unit tests: forward shapes + golden values (reference test strategy:
+per-layer forward/backward numerical checks, SURVEY.md §4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.keras import Input, Model, Sequential, init_model
+from analytics_zoo_tpu.keras.layers import (
+    Activation, BatchNormalization, Bidirectional, Conv1D, Conv2D, Dense,
+    Dropout, Embedding, Flatten, GlobalAveragePooling2D, GRU,
+    LayerNormalization, LSTM, MaxPooling2D, Merge, Reshape, SimpleRNN,
+    WordEmbedding, merge)
+
+RNG = jax.random.PRNGKey(0)
+
+
+def run_layer(layer, x, training=False, rng=None):
+    params, state = layer.build(RNG, (None,) + x.shape[1:])
+    y, new_state = layer.call(params, state, jnp.asarray(x),
+                              training=training, rng=rng)
+    return y, params, new_state
+
+
+class TestCoreLayers:
+    def test_dense_forward_and_shape(self):
+        x = np.ones((2, 3), np.float32)
+        layer = Dense(4, activation="relu")
+        y, params, _ = run_layer(layer, x)
+        assert y.shape == (2, 4)
+        expected = jax.nn.relu(x @ np.asarray(params["kernel"]))
+        np.testing.assert_allclose(y, expected, rtol=1e-6)
+        assert layer.compute_output_shape((None, 3)) == (None, 4)
+
+    def test_dense_grad(self):
+        x = jnp.ones((2, 3))
+        layer = Dense(4)
+        params, _ = layer.build(RNG, (None, 3))
+        g = jax.grad(lambda p: layer.call(p, {}, x)[0].sum())(params)
+        assert g["kernel"].shape == (3, 4)
+        np.testing.assert_allclose(g["bias"], 2.0 * np.ones(4), rtol=1e-6)
+
+    def test_dropout_train_vs_infer(self):
+        x = np.ones((4, 10), np.float32)
+        layer = Dropout(0.5)
+        y_inf, _, _ = run_layer(layer, x, training=False)
+        np.testing.assert_array_equal(y_inf, x)
+        y_tr, _, _ = run_layer(layer, x, training=True, rng=jax.random.PRNGKey(1))
+        assert float(jnp.sum(y_tr == 0.0)) > 0  # some dropped
+        kept = np.asarray(y_tr)[np.asarray(y_tr) != 0]
+        np.testing.assert_allclose(kept, 2.0)  # scaled by 1/keep
+
+    def test_flatten_reshape(self):
+        x = np.zeros((2, 3, 4), np.float32)
+        y, _, _ = run_layer(Flatten(), x)
+        assert y.shape == (2, 12)
+        y2, _, _ = run_layer(Reshape((4, 3)), x)
+        assert y2.shape == (2, 4, 3)
+
+    def test_merge_modes(self):
+        a = jnp.ones((2, 3))
+        b = 2 * jnp.ones((2, 3))
+        for mode, want in [("sum", 3.0), ("mul", 2.0), ("max", 2.0), ("ave", 1.5)]:
+            layer = Merge(mode)
+            y, _ = layer.call({}, {}, [a, b])
+            np.testing.assert_allclose(y, want * np.ones((2, 3)), rtol=1e-6)
+        y, _ = Merge("concat").call({}, {}, [a, b])
+        assert y.shape == (2, 6)
+        y, _ = Merge("dot").call({}, {}, [a, b])
+        np.testing.assert_allclose(y, 6 * np.ones((2, 1)), rtol=1e-6)
+
+
+class TestEmbeddingNorm:
+    def test_embedding(self):
+        x = np.array([[0, 2], [1, 1]], np.int32)
+        layer = Embedding(5, 8)
+        y, params, _ = run_layer(layer, x)
+        assert y.shape == (2, 2, 8)
+        np.testing.assert_allclose(y[0, 1], params["embeddings"][2], rtol=1e-6)
+
+    def test_word_embedding_frozen(self):
+        table = np.arange(12, dtype=np.float32).reshape(4, 3)
+        layer = WordEmbedding(table, trainable=False)
+        params, state = layer.build(RNG, (None, 2))
+        assert params == {}  # frozen: lives in state, excluded from grads
+        y, _ = layer.call(params, state, jnp.array([[3, 0]]))
+        np.testing.assert_allclose(y[0, 0], table[3])
+
+    def test_batchnorm_train_updates_stats(self):
+        x = np.random.RandomState(0).randn(16, 4).astype(np.float32) * 3 + 1
+        layer = BatchNormalization(momentum=0.9)
+        params, state = layer.build(RNG, (None, 4))
+        y, new_state = layer.call(params, state, jnp.asarray(x), training=True)
+        np.testing.assert_allclose(np.mean(y, axis=0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(np.std(y, axis=0), 1.0, atol=1e-2)
+        assert not np.allclose(new_state["moving_mean"], 0.0)
+        # inference path uses moving stats
+        y_inf, s2 = layer.call(params, new_state, jnp.asarray(x), training=False)
+        assert s2 is new_state or np.allclose(
+            s2["moving_mean"], new_state["moving_mean"])
+
+    def test_layernorm(self):
+        x = np.random.RandomState(1).randn(3, 7).astype(np.float32)
+        layer = LayerNormalization()
+        y, _, _ = run_layer(layer, x)
+        np.testing.assert_allclose(np.mean(y, axis=-1), 0.0, atol=1e-5)
+        np.testing.assert_allclose(np.std(y, axis=-1), 1.0, atol=1e-2)
+
+
+class TestConvPool:
+    def test_conv2d_shapes(self):
+        x = np.zeros((2, 8, 8, 3), np.float32)
+        layer = Conv2D(16, 3, 3)
+        y, _, _ = run_layer(layer, x)
+        assert y.shape == (2, 6, 6, 16)
+        same = Conv2D(16, 3, 3, border_mode="same", subsample=(2, 2))
+        y2, _, _ = run_layer(same, x)
+        assert y2.shape == (2, 4, 4, 16)
+        assert same.compute_output_shape((None, 8, 8, 3)) == (None, 4, 4, 16)
+
+    def test_conv2d_known_value(self):
+        x = np.ones((1, 3, 3, 1), np.float32)
+        layer = Conv2D(1, 2, 2, init="ones", bias=False)
+        y, _, _ = run_layer(layer, x)
+        np.testing.assert_allclose(y, 4 * np.ones((1, 2, 2, 1)), rtol=1e-6)
+
+    def test_conv1d(self):
+        x = np.zeros((2, 10, 4), np.float32)
+        y, _, _ = run_layer(Conv1D(8, 3), x)
+        assert y.shape == (2, 8, 8)
+
+    def test_pooling(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+        y, _, _ = run_layer(MaxPooling2D((2, 2)), x)
+        assert y.shape == (1, 2, 2, 1)
+        np.testing.assert_allclose(y[0, :, :, 0], [[5, 7], [13, 15]])
+        g, _, _ = run_layer(GlobalAveragePooling2D(), x)
+        np.testing.assert_allclose(g, [[7.5]])
+
+
+class TestRecurrent:
+    def test_lstm_shapes(self):
+        x = np.random.RandomState(0).randn(2, 5, 3).astype(np.float32)
+        y, _, _ = run_layer(LSTM(7), x)
+        assert y.shape == (2, 7)
+        y2, _, _ = run_layer(LSTM(7, return_sequences=True), x)
+        assert y2.shape == (2, 5, 7)
+
+    def test_gru_simple_rnn(self):
+        x = np.random.RandomState(0).randn(2, 5, 3).astype(np.float32)
+        assert run_layer(GRU(4), x)[0].shape == (2, 4)
+        assert run_layer(SimpleRNN(4), x)[0].shape == (2, 4)
+
+    def test_lstm_numerics_vs_manual(self):
+        # golden check: 1 step of LSTM == hand-computed gates
+        x = np.ones((1, 1, 2), np.float32)
+        layer = LSTM(2)
+        params, _ = layer.build(RNG, (None, 1, 2))
+        y, _ = layer.call(params, {}, jnp.asarray(x))
+        k = np.asarray(params["kernel"])
+        b = np.asarray(params["bias"])
+        z = np.concatenate([x[0, 0], np.zeros(2)]) @ k + b
+        i, f, g, o = np.split(z, 4)
+        c = 1 / (1 + np.exp(-i)) * np.tanh(g)
+        h = 1 / (1 + np.exp(-o)) * np.tanh(c)
+        np.testing.assert_allclose(np.asarray(y)[0], h, rtol=1e-5)
+
+    def test_bidirectional(self):
+        x = np.random.RandomState(0).randn(2, 5, 3).astype(np.float32)
+        y, _, _ = run_layer(Bidirectional(LSTM(4)), x)
+        assert y.shape == (2, 8)
+
+
+class TestContainers:
+    def test_sequential(self):
+        model = Sequential([Dense(8, activation="relu"), Dense(2)])
+        params, state = init_model(model, RNG, np.zeros((4, 3), np.float32))
+        y, _ = model.call(params, state, jnp.zeros((4, 3)))
+        assert y.shape == (4, 2)
+        assert model.compute_output_shape((None, 3)) == (None, 2)
+
+    def test_functional_graph_two_towers(self):
+        a = Input((4,))
+        b = Input((4,))
+        ha = Dense(8, activation="relu")(a)
+        hb = Dense(8, activation="relu")(b)
+        m = merge([ha, hb], mode="concat")
+        out = Dense(1, activation="sigmoid")(m)
+        model = Model([a, b], out)
+        params, state = model.build(RNG)
+        y, _ = model.call(params, state, [jnp.ones((2, 4)), jnp.ones((2, 4))])
+        assert y.shape == (2, 1)
+
+    def test_shared_layer(self):
+        shared = Dense(6)
+        a = Input((3,))
+        b = Input((3,))
+        out = merge([shared(a), shared(b)], mode="sum")
+        model = Model([a, b], out)
+        params, _ = model.build(RNG)
+        assert len([k for k in params if k.startswith("dense")]) == 1  # shared
+
+    def test_symbolic_operators(self):
+        a = Input((4,))
+        b = Input((4,))
+        out = (a + b) * 2.0 - 1.0
+        model = Model([a, b], out)
+        params, state = model.build(RNG)
+        y, _ = model.call(params, state, [jnp.ones((2, 4)), jnp.ones((2, 4))])
+        np.testing.assert_allclose(y, 3.0 * np.ones((2, 4)), rtol=1e-6)
+
+    def test_jit_forward(self):
+        model = Sequential([Dense(8, activation="tanh"), Dense(2)])
+        params, state = init_model(model, RNG, np.zeros((4, 3), np.float32))
+        fwd = jax.jit(lambda p, x: model.call(p, state, x)[0])
+        y = fwd(params, jnp.ones((4, 3)))
+        assert y.shape == (4, 2)

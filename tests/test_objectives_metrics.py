@@ -1,0 +1,97 @@
+"""Objective and metric golden-value tests."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.keras import metrics, objectives
+
+
+class TestObjectives:
+    def test_mse_mae(self):
+        t = jnp.array([1.0, 2.0])
+        p = jnp.array([2.0, 4.0])
+        assert float(objectives.get("mse")(t, p)) == pytest.approx(2.5)
+        assert float(objectives.get("mae")(t, p)) == pytest.approx(1.5)
+
+    def test_binary_crossentropy(self):
+        t = jnp.array([1.0, 0.0])
+        p = jnp.array([0.9, 0.1])
+        want = -np.mean([np.log(0.9), np.log(0.9)])
+        assert float(objectives.binary_crossentropy(t, p)) == pytest.approx(want, rel=1e-5)
+        # logits variant agrees with probability variant
+        logits = jnp.log(p / (1 - p))
+        assert float(objectives.binary_crossentropy_from_logits(t, logits)) == \
+            pytest.approx(want, rel=1e-4)
+
+    def test_categorical_crossentropy(self):
+        t = jnp.array([[0.0, 1.0], [1.0, 0.0]])
+        p = jnp.array([[0.2, 0.8], [0.6, 0.4]])
+        want = -np.mean([np.log(0.8), np.log(0.6)])
+        assert float(objectives.categorical_crossentropy(t, p)) == \
+            pytest.approx(want, rel=1e-5)
+        sp = objectives.sparse_categorical_crossentropy(jnp.array([1, 0]), p)
+        assert float(sp) == pytest.approx(want, rel=1e-5)
+
+    def test_hinge_family(self):
+        t = jnp.array([1.0, -1.0])
+        p = jnp.array([0.5, 0.5])
+        assert float(objectives.hinge(t, p)) == pytest.approx((0.5 + 1.5) / 2)
+        assert float(objectives.squared_hinge(t, p)) == \
+            pytest.approx((0.25 + 2.25) / 2)
+
+    def test_kld_poisson_cosine(self):
+        t = jnp.array([[0.5, 0.5]])
+        p = jnp.array([[0.25, 0.75]])
+        want = 0.5 * np.log(2) + 0.5 * np.log(2 / 3)
+        assert float(objectives.kullback_leibler_divergence(t, p)) == \
+            pytest.approx(want, rel=1e-4)
+        assert float(objectives.cosine_proximity(t, t)) == pytest.approx(-1.0, rel=1e-5)
+
+    def test_rank_hinge(self):
+        # pairs: (pos=0.9, neg=0.1) -> 0.2 ; (pos=0.2, neg=0.8) -> 1.6
+        p = jnp.array([0.9, 0.1, 0.2, 0.8])
+        assert float(objectives.rank_hinge(None, p)) == pytest.approx(0.9, rel=1e-5)
+
+    def test_unknown_loss(self):
+        with pytest.raises(ValueError):
+            objectives.get("nope")
+
+
+class TestMetrics:
+    def run(self, metric, y_true, y_pred, mask=None):
+        y_true = jnp.asarray(y_true)
+        y_pred = jnp.asarray(y_pred)
+        if mask is None:
+            mask = jnp.ones(y_pred.shape[0])
+        s = metric.update(metric.init_state(), y_true, y_pred, mask)
+        return metric.compute(s)
+
+    def test_binary_accuracy(self):
+        acc = self.run(metrics.Accuracy(), [1.0, 0.0, 1.0, 0.0],
+                       [0.9, 0.2, 0.3, 0.6])
+        assert acc == pytest.approx(0.5)
+
+    def test_categorical_accuracy_with_mask(self):
+        y_pred = [[0.9, 0.1], [0.2, 0.8], [0.9, 0.1]]
+        acc = self.run(metrics.Accuracy(), [0, 1, 1], y_pred,
+                       mask=jnp.array([1.0, 1.0, 0.0]))  # padded row ignored
+        assert acc == pytest.approx(1.0)
+
+    def test_topk(self):
+        y_pred = [[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]]
+        assert self.run(metrics.TopK(2), [1, 0], y_pred) == pytest.approx(0.5)
+
+    def test_mae_streaming(self):
+        m = metrics.MAE()
+        s = m.init_state()
+        s = m.update(s, jnp.array([1.0]), jnp.array([2.0]), jnp.ones(1))
+        s = m.update(s, jnp.array([0.0]), jnp.array([4.0]), jnp.ones(1))
+        assert m.compute(s) == pytest.approx(2.5)
+
+    def test_auc_perfect_separation(self):
+        t = jnp.array([1.0, 1.0, 0.0, 0.0])
+        p = jnp.array([0.9, 0.8, 0.2, 0.1])
+        auc = self.run(metrics.AUC(), t, p)
+        assert auc == pytest.approx(1.0, abs=0.02)
+        auc_rand = self.run(metrics.AUC(), t, jnp.array([0.5, 0.5, 0.5, 0.5]))
+        assert 0.3 < auc_rand < 0.7
