@@ -30,6 +30,11 @@ class TrainingState:
 
 
 class Trigger:
+    #: True when the trigger reads per-step loss — the loop only syncs the
+    #: device loss back to host when some consumer needs it. Defaults to True
+    #: so custom triggers are safe; built-ins that ignore loss opt out.
+    requires_loss: bool = True
+
     def __call__(self, state: TrainingState) -> bool:
         raise NotImplementedError
 
@@ -49,6 +54,8 @@ class EveryEpoch(Trigger):
     closes a full epoch.
     """
 
+    requires_loss = False
+
     def __call__(self, state: TrainingState) -> bool:
         if not state.epoch_finished:
             return False
@@ -58,6 +65,7 @@ class EveryEpoch(Trigger):
 
 
 class SeveralIteration(Trigger):
+    requires_loss = False
     def __init__(self, interval: int):
         if interval <= 0:
             raise ValueError("interval must be positive")
@@ -68,6 +76,7 @@ class SeveralIteration(Trigger):
 
 
 class MaxEpoch(Trigger):
+    requires_loss = False
     def __init__(self, max_epoch: int):
         self.max_epoch = max_epoch
 
@@ -76,6 +85,7 @@ class MaxEpoch(Trigger):
 
 
 class MaxIteration(Trigger):
+    requires_loss = False
     def __init__(self, max_iteration: int):
         self.max_iteration = max_iteration
 
@@ -86,6 +96,8 @@ class MaxIteration(Trigger):
 class MaxScore(Trigger):
     """Stop once validation score exceeds a bar."""
 
+    requires_loss = False
+
     def __init__(self, max_score: float):
         self.max_score = max_score
 
@@ -95,6 +107,8 @@ class MaxScore(Trigger):
 
 class MinLoss(Trigger):
     """Stop once training loss drops below a bar."""
+
+    requires_loss = True
 
     def __init__(self, min_loss: float):
         self.min_loss = min_loss
@@ -107,6 +121,10 @@ class And(Trigger):
     def __init__(self, *triggers: Trigger):
         self.triggers = triggers
 
+    @property
+    def requires_loss(self):
+        return any(t.requires_loss for t in self.triggers)
+
     def __call__(self, state: TrainingState) -> bool:
         return all(t(state) for t in self.triggers)
 
@@ -114,6 +132,10 @@ class And(Trigger):
 class Or(Trigger):
     def __init__(self, *triggers: Trigger):
         self.triggers = triggers
+
+    @property
+    def requires_loss(self):
+        return any(t.requires_loss for t in self.triggers)
 
     def __call__(self, state: TrainingState) -> bool:
         return any(t(state) for t in self.triggers)

@@ -174,6 +174,15 @@ class Estimator:
         validation_trigger = validation_trigger or EveryEpoch()
         checkpoint_trigger = checkpoint_trigger or self._ckpt_trigger or EveryEpoch()
         local_batch = self.ctx.local_batch(batch_size)
+        # the batch axis is sharded over the mesh's data axis only; this host
+        # contributes its per-host share of that axis
+        dp_size = self.mesh.devices.shape[0]
+        local_dp = max(1, dp_size // self.ctx.process_count)
+        if local_batch % local_dp:
+            good = self.ctx.process_count * local_dp * max(1, local_batch // local_dp)
+            raise ValueError(
+                f"per-host batch {local_batch} must be divisible by this "
+                f"host's {local_dp} data-axis devices; use batch_size={good}")
 
         sample = next(train_set.train_iterator(local_batch))
         self._ensure_initialized(sample[0])
@@ -194,6 +203,14 @@ class Estimator:
         retries_left = retry_budget
         last_failure = 0.0
         history: List[float] = []
+        pending: List[Any] = []  # device loss scalars, drained per epoch
+        # only sync loss to host per-step when something consumes it; otherwise
+        # jax's async dispatch pipelines the whole epoch without host stalls
+        # (duck-typed callables without requires_loss are treated as consumers)
+        need_loss = (self._tb is not None
+                     or getattr(end_trigger, "requires_loss", True)
+                     or getattr(validation_trigger, "requires_loss", True)
+                     or getattr(checkpoint_trigger, "requires_loss", True))
 
         while not end_trigger(state):
             feed = DeviceFeed(train_set.train_iterator(local_batch), self.mesh)
@@ -209,22 +226,31 @@ class Estimator:
                     self.global_step += 1
                     epoch_iter += 1
                     state.iteration = self.global_step
-                    state.loss = None  # fetched lazily below only if needed
+                    pending.append(loss)
 
-                    loss_val = float(loss)  # device sync point
-                    history.append(loss_val)
-                    state.loss = loss_val
-                    if self._train_writer is not None:
-                        lr = self.optimizer.learning_rate
-                        lr_val = float(lr(self.global_step)) if callable(lr) else float(lr)
-                        self._train_writer.add_scalar("Loss", loss_val, self.global_step)
-                        self._train_writer.add_scalar("LearningRate", lr_val,
-                                                      self.global_step)
+                    if need_loss:
+                        loss_val = float(loss)  # device sync point
+                        state.loss = loss_val
+                        if self._train_writer is not None:
+                            lr = self.optimizer.learning_rate
+                            lr_val = (float(lr(self.global_step)) if callable(lr)
+                                      else float(lr))
+                            self._train_writer.add_scalar("Loss", loss_val,
+                                                          self.global_step)
+                            self._train_writer.add_scalar("LearningRate", lr_val,
+                                                          self.global_step)
 
                     state.epoch_finished = epoch_iter >= batches_per_epoch
                     in_slice_bound = epoch_iter in slice_bounds or state.epoch_finished
                     if in_slice_bound:
                         state.slice_index += 1
+                    if state.epoch_finished:
+                        # drain device losses inside the try: this is the sync
+                        # point where async step failures surface so the
+                        # checkpoint-retry path below can catch them, and it
+                        # bounds the number of live device scalars
+                        history.extend(float(l) for l in jax.device_get(pending))
+                        pending.clear()
                     if state.epoch_finished:
                         state.epoch += 1
                         self.epoch = state.epoch
@@ -256,12 +282,16 @@ class Estimator:
                 logger.exception(
                     "training step failed; resuming from checkpoint "
                     "(%d retries left)", retries_left)
+                pending.clear()  # discard losses from the failed dispatch
                 self.load_checkpoint(self._latest_snapshot())
                 state.epoch = self.epoch
                 state.iteration = self.global_step
                 continue
             state.epoch_finished = False
 
+        if pending:
+            history.extend(float(l) for l in jax.device_get(pending))
+            pending.clear()
         if self._train_writer is not None:
             self._train_writer.flush()
             self._val_writer.flush()

@@ -1,0 +1,2 @@
+from .common import Recommender, ZooModel, register_zoo_model  # noqa: F401
+from .recommendation import NeuralCF  # noqa: F401

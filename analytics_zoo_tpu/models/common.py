@@ -1,0 +1,133 @@
+"""Model-zoo base classes.
+
+``ZooModel`` (reference ``models/common/ZooModel.scala:38``): a built-in model
+is a thin config object that builds a Keras-style graph, trains/predicts
+through the Estimator, and persists as ``config + weights`` (the reference's
+``saveModel``/``loadModel`` ``.model`` archive becomes a directory with a JSON
+config and an orbax weight checkpoint).
+
+``Recommender`` (reference ``models/recommendation/Recommender.scala``): adds
+``predict_user_item_pair`` / ``recommend_for_user`` / ``recommend_for_item``
+over (user, item) pair arrays — numpy in place of RDDs.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_MODEL_REGISTRY: Dict[str, type] = {}
+
+
+def register_zoo_model(cls):
+    _MODEL_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+class ZooModel:
+    """Base for built-in models. Subclasses implement ``build_model()``
+    returning a keras ``Model``/``Sequential`` and ``get_config()``."""
+
+    def __init__(self):
+        self.model = None
+
+    def _ensure_built(self):
+        if self.model is None:
+            self.model = self.build_model()
+        return self.model
+
+    def build_model(self):
+        raise NotImplementedError
+
+    def get_config(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # -- training facade ------------------------------------------------------
+
+    def compile(self, optimizer, loss, metrics=None):
+        self._ensure_built().compile(optimizer, loss, metrics)
+
+    def fit(self, *args, **kwargs):
+        return self._ensure_built().fit(*args, **kwargs)
+
+    def evaluate(self, *args, **kwargs):
+        return self._ensure_built().evaluate(*args, **kwargs)
+
+    def predict(self, *args, **kwargs):
+        return self._ensure_built().predict(*args, **kwargs)
+
+    # -- persistence (ZooModel.saveModel / loadModel) -------------------------
+
+    def save_model(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        config = {"class": type(self).__name__, "config": self.get_config()}
+        with open(os.path.join(path, "zoo_model.json"), "w") as f:
+            json.dump(config, f, indent=2)
+        self._ensure_built().save_model(os.path.join(path, "weights"))
+
+    @staticmethod
+    def load_model(path: str) -> "ZooModel":
+        with open(os.path.join(path, "zoo_model.json")) as f:
+            spec = json.load(f)
+        cls = _MODEL_REGISTRY.get(spec["class"])
+        if cls is None:
+            raise ValueError(f"unknown zoo model class {spec['class']}; "
+                             f"registered: {sorted(_MODEL_REGISTRY)}")
+        inst = cls(**spec["config"])
+        inst._ensure_built()
+        # models must be compiled before weights load to own an estimator
+        if not hasattr(inst.model, "loss_fn"):
+            inst.default_compile()
+        inst.model.load_weights(os.path.join(path, "weights"))
+        return inst
+
+    def default_compile(self):
+        self.compile(optimizer="adam", loss="mse")
+
+
+class Recommender(ZooModel):
+    """Adds ranking helpers over (user, item) pair predictions."""
+
+    def _pair_probs(self, user_ids: np.ndarray, item_ids: np.ndarray,
+                    batch_size: int = 1024) -> np.ndarray:
+        pairs = np.stack([user_ids, item_ids], axis=1).astype(np.float32)
+        probs = self.predict(pairs, batch_size=batch_size)
+        return np.asarray(probs)
+
+    def predict_user_item_pair(self, user_ids, item_ids, batch_size: int = 1024
+                               ) -> List[Tuple[int, int, int, float]]:
+        """Returns (user, item, predicted_class, probability) per pair
+        (reference ``predictUserItemPair``; classes are 1-based like BigDL)."""
+        probs = self._pair_probs(np.asarray(user_ids), np.asarray(item_ids),
+                                 batch_size)
+        cls = np.argmax(probs, axis=-1)
+        return [(int(u), int(i), int(c) + 1, float(p[c]))
+                for u, i, c, p in zip(user_ids, item_ids, cls, probs)]
+
+    def recommend_for_user(self, user_ids, item_ids, max_items: int = 5,
+                           batch_size: int = 1024):
+        """Top-N items per user from candidate (user, item) pairs
+        (reference ``recommendForUser``): positive-class probability ranks."""
+        preds = self.predict_user_item_pair(user_ids, item_ids, batch_size)
+        by_user: Dict[int, List] = {}
+        for u, i, c, p in preds:
+            by_user.setdefault(u, []).append((i, c, p))
+        out = {}
+        for u, items in by_user.items():
+            items.sort(key=lambda t: -t[2])
+            out[u] = items[:max_items]
+        return out
+
+    def recommend_for_item(self, user_ids, item_ids, max_users: int = 5,
+                           batch_size: int = 1024):
+        preds = self.predict_user_item_pair(user_ids, item_ids, batch_size)
+        by_item: Dict[int, List] = {}
+        for u, i, c, p in preds:
+            by_item.setdefault(i, []).append((u, c, p))
+        out = {}
+        for i, users in by_item.items():
+            users.sort(key=lambda t: -t[2])
+            out[i] = users[:max_users]
+        return out

@@ -1,0 +1,1 @@
+from .ncf import NeuralCF  # noqa: F401

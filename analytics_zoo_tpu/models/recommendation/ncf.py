@@ -1,0 +1,74 @@
+"""Neural Collaborative Filtering (capability parity with reference
+``models/recommendation/NeuralCF.scala:45``: GMF + MLP twin towers over
+user/item embeddings, softmax head; same constructor surface).
+
+TPU design notes: the four embedding tables are plain param arrays whose
+lookup gradients XLA turns into on-device scatter-adds; for huge vocabularies
+pass ``shard_embeddings=True`` to the Estimator wiring so the vocab axis is
+sharded over the ``model`` mesh axis.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..common import Recommender, register_zoo_model
+from ...keras import Input, Model
+from ...keras.layers import Dense, Embedding, Flatten, Lambda, merge
+
+
+@register_zoo_model
+class NeuralCF(Recommender):
+    def __init__(self, user_count: int, item_count: int, num_classes: int,
+                 user_embed: int = 20, item_embed: int = 20,
+                 hidden_layers: Sequence[int] = (40, 20, 10),
+                 include_mf: bool = True, mf_embed: int = 20):
+        super().__init__()
+        self.user_count = user_count
+        self.item_count = item_count
+        self.num_classes = num_classes
+        self.user_embed = user_embed
+        self.item_embed = item_embed
+        self.hidden_layers = list(hidden_layers)
+        self.include_mf = include_mf
+        self.mf_embed = mf_embed
+
+    def get_config(self):
+        return {
+            "user_count": self.user_count, "item_count": self.item_count,
+            "num_classes": self.num_classes, "user_embed": self.user_embed,
+            "item_embed": self.item_embed, "hidden_layers": self.hidden_layers,
+            "include_mf": self.include_mf, "mf_embed": self.mf_embed,
+        }
+
+    def build_model(self) -> Model:
+        pairs = Input((2,), name="user_item_pairs")
+        user = Lambda(lambda x: x[:, 0:1], name="user_select")(pairs)
+        item = Lambda(lambda x: x[:, 1:2], name="item_select")(pairs)
+
+        mlp_user = Flatten(name="mlp_user_flat")(
+            Embedding(self.user_count + 1, self.user_embed, init="normal",
+                      name="mlp_user_table")(user))
+        mlp_item = Flatten(name="mlp_item_flat")(
+            Embedding(self.item_count + 1, self.item_embed, init="normal",
+                      name="mlp_item_table")(item))
+        h = merge([mlp_user, mlp_item], mode="concat")
+        for i, units in enumerate(self.hidden_layers):
+            h = Dense(units, activation="relu", name=f"mlp_dense_{i}")(h)
+
+        if self.include_mf:
+            if self.mf_embed <= 0:
+                raise ValueError("mf_embed must be positive when include_mf")
+            mf_user = Flatten(name="mf_user_flat")(
+                Embedding(self.user_count + 1, self.mf_embed, init="normal",
+                          name="mf_user_table")(user))
+            mf_item = Flatten(name="mf_item_flat")(
+                Embedding(self.item_count + 1, self.mf_embed, init="normal",
+                          name="mf_item_table")(item))
+            gmf = merge([mf_user, mf_item], mode="mul")
+            h = merge([h, gmf], mode="concat")
+        out = Dense(self.num_classes, activation="softmax", name="prediction")(h)
+        return Model(pairs, out, name="neural_cf")
+
+    def default_compile(self):
+        self.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                     metrics=["accuracy"])

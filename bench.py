@@ -1,0 +1,85 @@
+"""Benchmark driver: prints ONE JSON line with the headline metric.
+
+Round-1 flagship: NCF (MovieLens-1M scale) training throughput in samples/sec
+on the available accelerator (BASELINE.json config #1). The reference
+publishes no absolute numbers (`published: {}`), so ``vs_baseline`` is null.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_ncf(batch_size: int = 8192, steps: int = 50, warmup: int = 5):
+    import jax
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.estimator import Estimator
+    from analytics_zoo_tpu.feature import FeatureSet
+    from analytics_zoo_tpu.keras import objectives, optimizers
+    from analytics_zoo_tpu.models import NeuralCF
+
+    ctx = init_tpu_context()
+    ndev = ctx.num_devices
+    if batch_size % ndev:
+        batch_size = (batch_size // ndev) * ndev
+
+    # MovieLens-1M dimensions
+    users, items = 6040, 3706
+    n = batch_size * 8
+    rs = np.random.RandomState(0)
+    x = np.stack([rs.randint(1, users + 1, n),
+                  rs.randint(1, items + 1, n)], 1).astype(np.float32)
+    y = rs.randint(0, 2, n).astype(np.float32)
+
+    ncf = NeuralCF(users, items, 2, user_embed=64, item_embed=64,
+                   hidden_layers=[128, 64, 32], mf_embed=32)
+    model = ncf._ensure_built()
+    est = Estimator(model=model,
+                    loss_fn=objectives.get("sparse_categorical_crossentropy"),
+                    optimizer=optimizers.Adam(1e-3))
+    fs = FeatureSet.from_ndarrays(x, y)
+
+    it = fs.train_iterator(batch_size)
+    from analytics_zoo_tpu.feature import DeviceFeed
+    feed = DeviceFeed(it, est.mesh)
+    bx, by = next(feed)
+    est._ensure_initialized(bx)
+    step_fn = est._build_train_step()
+
+    rng = jax.random.PRNGKey(0)
+    params, opt_state, mstate = est.params, est.opt_state, est.model_state
+    for i in range(warmup):
+        params, opt_state, mstate, loss = step_fn(params, opt_state, mstate,
+                                                  rng, bx, by)
+    jax.block_until_ready(loss)
+
+    start = time.perf_counter()
+    for i in range(steps):
+        bx, by = next(feed)
+        params, opt_state, mstate, loss = step_fn(params, opt_state, mstate,
+                                                  rng, bx, by)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - start
+    samples_per_sec = batch_size * steps / elapsed
+    return samples_per_sec, ctx
+
+
+def main():
+    sps, ctx = bench_ncf()
+    print(json.dumps({
+        "metric": "ncf_train_samples_per_sec",
+        "value": round(sps, 1),
+        "unit": "samples/s",
+        "vs_baseline": None,
+        "detail": {
+            "model": "NeuralCF ml-1m (embed 64, mlp 128-64-32, mf 32)",
+            "batch_size": 8192,
+            "platform": ctx.platform,
+            "num_devices": ctx.num_devices,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
