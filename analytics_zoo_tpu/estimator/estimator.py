@@ -176,7 +176,9 @@ class Estimator:
         local_batch = self.ctx.local_batch(batch_size)
         # the batch axis is sharded over the mesh's data axis only; this host
         # contributes its per-host share of that axis
-        dp_size = self.mesh.devices.shape[0]
+        from ..parallel.mesh import DATA_AXIS
+        dp_size = (self.mesh.shape[DATA_AXIS]
+                   if DATA_AXIS in self.mesh.axis_names else 1)
         local_dp = max(1, dp_size // self.ctx.process_count)
         if local_batch % local_dp:
             good = self.ctx.process_count * local_dp * max(1, local_batch // local_dp)
@@ -251,7 +253,6 @@ class Estimator:
                         # bounds the number of live device scalars
                         history.extend(float(l) for l in jax.device_get(pending))
                         pending.clear()
-                    if state.epoch_finished:
                         state.epoch += 1
                         self.epoch = state.epoch
 
@@ -290,8 +291,20 @@ class Estimator:
             state.epoch_finished = False
 
         if pending:
-            history.extend(float(l) for l in jax.device_get(pending))
-            pending.clear()
+            # trailing drain (end_trigger fired mid-epoch): an async failure
+            # here means params are in an undefined state — restore the newest
+            # checkpoint so the estimator stays usable, then surface the error
+            try:
+                history.extend(float(l) for l in jax.device_get(pending))
+            except Exception:
+                if self._ckpt_dir and self._latest_snapshot():
+                    logger.exception(
+                        "trailing training step failed; restoring newest "
+                        "checkpoint before surfacing the error")
+                    self.load_checkpoint(self._latest_snapshot())
+                raise
+            finally:
+                pending.clear()
         if self._train_writer is not None:
             self._train_writer.flush()
             self._val_writer.flush()

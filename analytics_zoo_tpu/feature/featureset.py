@@ -31,6 +31,13 @@ class MemoryType(Enum):
     DISK = "disk"
 
 
+def _normalize(tree):
+    """Lists of arrays (the Keras multi-input convention) become tuples."""
+    if isinstance(tree, list):
+        return tuple(tree)
+    return tree
+
+
 def _tree_map(fn, tree: ArrayTree) -> ArrayTree:
     if isinstance(tree, tuple):
         return tuple(fn(t) for t in tree)
@@ -71,6 +78,8 @@ class FeatureSet:
                  cache_dir: Optional[str] = None,
                  shard: bool = True,
                  seed: int = 0):
+        features = _normalize(features)
+        labels = _normalize(labels)
         n = _tree_leaves(features)[0].shape[0]
         for leaf in _tree_leaves(features) + (
                 _tree_leaves(labels) if labels is not None else []):
@@ -111,9 +120,9 @@ class FeatureSet:
     def from_ndarrays(cls, features: ArrayTree, labels: Optional[ArrayTree] = None,
                       **kwargs) -> "FeatureSet":
         to_np = lambda a: np.asarray(a)
-        features = _tree_map(to_np, features)
+        features = _tree_map(to_np, _normalize(features))
         if labels is not None:
-            labels = _tree_map(to_np, labels)
+            labels = _tree_map(to_np, _normalize(labels))
         return cls(features, labels, **kwargs)
 
     @classmethod

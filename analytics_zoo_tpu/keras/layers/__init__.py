@@ -8,3 +8,5 @@ from .conv import (  # noqa: F401
     AveragePooling2D, Conv1D, Conv2D, Convolution1D, Convolution2D,
     GlobalAveragePooling1D, GlobalAveragePooling2D, GlobalMaxPooling1D,
     GlobalMaxPooling2D, MaxPooling1D, MaxPooling2D, ZeroPadding2D)
+from .attention import (  # noqa: F401
+    BERT, MultiHeadAttention, TransformerLayer)

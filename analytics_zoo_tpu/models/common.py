@@ -108,15 +108,17 @@ class Recommender(ZooModel):
 
     def recommend_for_user(self, user_ids, item_ids, max_items: int = 5,
                            batch_size: int = 1024):
-        """Top-N items per user from candidate (user, item) pairs
-        (reference ``recommendForUser``): positive-class probability ranks."""
+        """Top-N items per user from candidate (user, item) pairs. Ranks by
+        (predicted class desc, probability desc) — the reference's
+        ``sortBy(y => (-y.prediction, -y.probability))``
+        (``Recommender.scala:55``)."""
         preds = self.predict_user_item_pair(user_ids, item_ids, batch_size)
         by_user: Dict[int, List] = {}
         for u, i, c, p in preds:
             by_user.setdefault(u, []).append((i, c, p))
         out = {}
         for u, items in by_user.items():
-            items.sort(key=lambda t: -t[2])
+            items.sort(key=lambda t: (-t[1], -t[2]))
             out[u] = items[:max_items]
         return out
 
@@ -128,6 +130,6 @@ class Recommender(ZooModel):
             by_item.setdefault(i, []).append((u, c, p))
         out = {}
         for i, users in by_item.items():
-            users.sort(key=lambda t: -t[2])
+            users.sort(key=lambda t: (-t[1], -t[2]))
             out[i] = users[:max_users]
         return out

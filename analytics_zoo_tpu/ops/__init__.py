@@ -1,0 +1,12 @@
+"""TPU hot-op kernels (pallas) with XLA fallbacks.
+
+The reference's hot loops are MKL kernels inside BigDL layers and TF JNI
+``Session.run`` (SURVEY §3.2/§3.3). Here the hot ops are implemented directly
+for the TPU: pallas kernels where hand-tiling beats XLA fusion (attention),
+plain jnp everywhere XLA already does the right thing.
+"""
+from .attention import (  # noqa: F401
+    dot_product_attention,
+    blockwise_attention,
+    flash_attention,
+)

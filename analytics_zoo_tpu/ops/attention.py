@@ -1,0 +1,259 @@
+"""Attention kernels.
+
+Three tiers, one contract (``[batch, heads, seq, head_dim]`` tensors):
+
+- :func:`dot_product_attention` — plain XLA. The materialized ``[q, kv]``
+  score matrix is fine at short lengths; XLA fuses the softmax chain.
+- :func:`blockwise_attention` — flash-style streaming softmax over KV chunks
+  via ``lax.scan`` (never materializes ``[q, kv]``). Runs everywhere (CPU
+  tests, TPU), is differentiable through the scan, and is the building block
+  ring attention reuses per hop (``parallel/ring_attention.py``).
+- :func:`flash_attention` — pallas TPU kernel for the forward hot path
+  (tiled q/kv blocks in VMEM, running max/denominator in scratch, MXU
+  matmuls in fp32 accumulation); backward recomputes via the blockwise path
+  (``jax.custom_vjp``). Falls back to blockwise off-TPU.
+
+The reference has no long-context machinery (SURVEY §5: absent); this is the
+new TPU-native capability that backs ``TransformerLayer``/``BERT`` and the
+sequence-parallel mesh axis.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DEFAULT_Q_BLOCK = 512
+DEFAULT_KV_BLOCK = 512
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() grads finite
+
+
+def _largest_divisor_leq(n: int, cap: int) -> int:
+    for c in range(min(n, cap), 0, -1):
+        if n % c == 0:
+            return c
+    return 1
+
+
+def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          bias: Optional[jax.Array] = None,
+                          causal: bool = False,
+                          scale: Optional[float] = None) -> jax.Array:
+    """Reference attention: softmax(q k^T / sqrt(d) + bias) v."""
+    *_, q_len, head_dim = q.shape
+    kv_len = k.shape[-2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(head_dim)
+    scores = jnp.einsum("...qd,...kd->...qk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        scores = scores + bias
+    if causal:
+        qi = lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 0)
+        ki = lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 1)
+        scores = jnp.where(qi >= ki, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", probs.astype(v.dtype), v)
+
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        bias: Optional[jax.Array] = None,
+                        causal: bool = False,
+                        scale: Optional[float] = None,
+                        q_block: int = DEFAULT_Q_BLOCK,
+                        kv_block: int = DEFAULT_KV_BLOCK) -> jax.Array:
+    """Streaming-softmax attention over KV chunks; O(seq) memory.
+
+    ``bias`` broadcasts against ``[batch, heads, q_len, kv_len]``.
+    """
+    b, h, q_len, d = q.shape
+    kv_len = k.shape[-2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    bq = _largest_divisor_leq(q_len, q_block)
+    bk = _largest_divisor_leq(kv_len, kv_block)
+    n_q, n_kv = q_len // bq, kv_len // bk
+
+    if bias is not None:
+        bias = jnp.broadcast_to(bias, (b, h, q_len, kv_len))
+
+    q = q.reshape(b, h, n_q, bq, d)
+    k_chunks = k.reshape(b, h, n_kv, bk, d).transpose(2, 0, 1, 3, 4)
+    v_chunks = v.reshape(b, h, n_kv, bk, d).transpose(2, 0, 1, 3, 4)
+
+    def one_q_chunk(args):
+        qc, qi = args  # qc: [b, h, bq, d]
+
+        def kv_step(carry, inp):
+            acc, m, l = carry
+            kc, vc, ki = inp
+            s = jnp.einsum("bhqd,bhkd->bhqk", qc, kc,
+                           preferred_element_type=jnp.float32) * scale
+            if bias is not None:
+                bslice = lax.dynamic_slice(
+                    bias, (0, 0, qi * bq, ki * bk), (b, h, bq, bk))
+                s = s + bslice
+            if causal:
+                rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+                s = jnp.where(rows >= cols, s, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * corr + jnp.einsum(
+                "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32),
+                preferred_element_type=jnp.float32)
+            return (acc_new, m_new, l_new), None
+
+        # init derives from qc*0 so it inherits qc's varying-axis type when
+        # this runs inside shard_map (ulysses/ring sequence parallelism)
+        zero_q = qc.astype(jnp.float32) * 0.0
+        init = (zero_q, zero_q[..., :1] + _NEG_INF, zero_q[..., :1])
+        (acc, m, l), _ = lax.scan(
+            kv_step, init, (k_chunks, v_chunks, jnp.arange(n_kv)))
+        return (acc / jnp.maximum(l, 1e-30)).astype(v.dtype)
+
+    out = lax.map(one_q_chunk, (q.transpose(2, 0, 1, 3, 4), jnp.arange(n_q)))
+    return out.transpose(1, 2, 0, 3, 4).reshape(b, h, q_len, d)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                      scale: float, causal: bool, bq: int, bk: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    run = True
+    if causal:
+        # skip fully-masked blocks (query rows all before kv cols)
+        run = (qi + 1) * bq > ki * bk
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:, :1] = l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[:, :1] = m_new
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_kv - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] /
+                    jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, scale: float, causal: bool,
+                      q_block: int, kv_block: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, q_len, d = q.shape
+    kv_len = k.shape[-2]
+    bq = _largest_divisor_leq(q_len, q_block)
+    bk = _largest_divisor_leq(kv_len, kv_block)
+    bh = b * h
+    qf = q.reshape(bh, q_len, d)
+    kf = k.reshape(bh, kv_len, d)
+    vf = v.reshape(bh, kv_len, d)
+
+    grid = (bh, q_len // bq, kv_len // bk)
+    kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, q_len, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda a, i, j: (a, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda a, i, j: (a, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda a, i, j: (a, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda a, i, j: (a, i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+    )(qf, kf, vf)
+    return out.reshape(b, h, q_len, d)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, q_block, kv_block):
+    if _on_tpu():
+        return _flash_fwd_pallas(q, k, v, scale, causal, q_block, kv_block)
+    return blockwise_attention(q, k, v, None, causal, scale, q_block, kv_block)
+
+
+def _flash_fwd(q, k, v, scale, causal, q_block, kv_block):
+    return _flash(q, k, v, scale, causal, q_block, kv_block), (q, k, v)
+
+
+def _flash_bwd(scale, causal, q_block, kv_block, residuals, g):
+    q, k, v = residuals
+    # recompute-based backward through the memory-efficient blockwise path
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, None, causal, scale, q_block, kv_block), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    bias: Optional[jax.Array] = None,
+                    causal: bool = False,
+                    scale: Optional[float] = None,
+                    q_block: int = DEFAULT_Q_BLOCK,
+                    kv_block: int = DEFAULT_KV_BLOCK) -> jax.Array:
+    """Fused attention: pallas kernel on TPU, blockwise XLA elsewhere.
+
+    With a ``bias`` (additive mask) the blockwise path is used — the pallas
+    kernel covers the unbiased/causal hot path.
+    """
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if bias is not None:
+        return blockwise_attention(q, k, v, bias, causal, scale,
+                                   q_block, kv_block)
+    return _flash(q, k, v, scale, causal, q_block, kv_block)

@@ -1,3 +1,5 @@
 from .mesh import (  # noqa: F401
     DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, SEQ_AXIS, data_sharding,
     global_batch_shapes, param_sharding, replicated, shard_batch)
+from .ring_attention import (  # noqa: F401
+    ring_attention, ring_self_attention, ulysses_attention)

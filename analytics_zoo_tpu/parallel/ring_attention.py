@@ -1,0 +1,128 @@
+"""Ring attention — context/sequence parallelism over the mesh ``seq`` axis.
+
+New TPU-native capability (the reference has none — SURVEY §5 "long-context:
+absent"): each device holds a ``seq_len / n_seq`` shard of Q, K, V. K/V shards
+rotate around the ring via ``lax.ppermute`` over ICI while every device
+accumulates flash-style partial softmax statistics for its local Q against
+each visiting K/V shard. Communication overlaps the blockwise compute and the
+full ``[seq, seq]`` score matrix never exists on any one chip, so max context
+scales linearly with the number of devices on the ``seq`` axis.
+
+Use :func:`ring_attention` inside ``shard_map`` (or let
+:func:`ring_self_attention` set that up over a mesh). Differentiable: the
+backward of ``ppermute`` is the reverse rotation, so gradients ride the same
+ring.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.attention import blockwise_attention, _largest_divisor_leq, _NEG_INF
+
+SEQ_AXIS = "seq"
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = SEQ_AXIS,
+                   causal: bool = False,
+                   scale: Optional[float] = None,
+                   q_block: int = 512,
+                   kv_block: int = 512) -> jax.Array:
+    """Per-shard body: q/k/v are the LOCAL ``[b, h, seq/n, d]`` shards.
+
+    Must run under ``shard_map``/``pmap`` with ``axis_name`` bound. With
+    ``causal=True`` the global position of each shard (this device's
+    ``axis_index``) masks future tokens across shard boundaries.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    def hop(carry, i):
+        acc, m, l, kc, vc = carry
+        src_rank = (my + i) % n  # which shard's K/V we currently hold
+
+        # blockwise attention of local q against this k/v shard, folding the
+        # partial stats into the running (acc, m, l)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = my * sq + lax.broadcasted_iota(jnp.int32, (sq, sq), 0)
+            cols = src_rank * sq + lax.broadcasted_iota(jnp.int32, (sq, sq), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+
+        # rotate k/v to the next device on the ring (overlaps with the next
+        # hop's compute under XLA's async collective scheduling)
+        perm = [(j, (j - 1) % n) for j in range(n)]
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (acc_new, m_new, l_new, kc, vc), None
+
+    # accumulators derive from q*0 so they inherit q's varying-axis type —
+    # shard_map's vma check requires the scan carry to be device-varying
+    zero_q = q.astype(jnp.float32) * 0.0
+    init = (zero_q,
+            zero_q[..., :1] + _NEG_INF,
+            zero_q[..., :1],
+            k, v)
+    (acc, m, l, _, _), _ = lax.scan(hop, init, jnp.arange(n))
+    return (acc / jnp.maximum(l, 1e-30)).astype(v.dtype)
+
+
+def ring_self_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = False,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Global entry: shards the seq axis of [b, h, s, d] over ``mesh['seq']``
+    and runs the ring. Batch rides the ``data`` axis if present."""
+    from jax import shard_map
+
+    batch_axis = "data" if "data" in mesh.axis_names else None
+    spec = P(batch_axis, None, SEQ_AXIS, None)
+    fn = shard_map(
+        partial(ring_attention, causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = SEQ_AXIS,
+                      causal: bool = False,
+                      scale: Optional[float] = None) -> jax.Array:
+    """DeepSpeed-Ulysses-style sequence parallelism: all-to-all swaps the
+    sharded axis from sequence to heads, each device computes full-sequence
+    attention for ``heads/n`` heads, then all-to-all swaps back. Lower
+    latency than the ring when heads ≥ devices and ICI all-to-all is cheap.
+
+    Per-shard body for ``shard_map``; local shapes ``[b, h, seq/n, d]``.
+    """
+    n = lax.axis_size(axis_name)
+    b, h, sq, d = q.shape
+    if h % n:
+        raise ValueError(f"heads {h} not divisible by seq-axis size {n}")
+
+    def seq_to_heads(x):  # [b, h, sq, d] -> [b, h/n, sq*n, d]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def heads_to_seq(x):  # [b, h/n, sq*n, d] -> [b, h, sq, d]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = blockwise_attention(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(out)
