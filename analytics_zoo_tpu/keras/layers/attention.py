@@ -106,19 +106,9 @@ class MultiHeadAttention(Layer):
         if drop_rng is not None:
             # attention-probability dropout needs the materialized prob
             # matrix, so it runs the vanilla path; inference uses flash
-            scale = 1.0 / math.sqrt(dh)
-            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                                preferred_element_type=jnp.float32) * scale
-            if bias is not None:
-                scores = scores + bias
-            if self.causal:
-                kv_len = k.shape[2]
-                rows = jax.lax.broadcasted_iota(jnp.int32, (sq, kv_len), 0)
-                cols = jax.lax.broadcasted_iota(jnp.int32, (sq, kv_len), 1)
-                scores = jnp.where(rows >= cols, scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1)
-            probs = _dropout(probs, self.attn_drop, drop_rng, training)
-            ctx = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+            ctx = dot_product_attention(q, k, v, bias=bias, causal=self.causal,
+                                        dropout_rate=self.attn_drop,
+                                        dropout_rng=drop_rng)
         elif self.use_flash:
             ctx = flash_attention(q, k, v, bias=bias, causal=self.causal)
         else:

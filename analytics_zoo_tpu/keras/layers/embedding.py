@@ -22,42 +22,45 @@ from ..engine import Layer
 
 class Embedding(Layer):
     def __init__(self, input_dim: int, output_dim: int, init="uniform",
-                 input_length: Optional[int] = None, name: Optional[str] = None):
+                 input_length: Optional[int] = None,
+                 weights: Optional[np.ndarray] = None, trainable: bool = True,
+                 name: Optional[str] = None):
         super().__init__(name)
         self.input_dim = input_dim
         self.output_dim = output_dim
         self.init = initializers.get(init)
         self.input_length = input_length
+        self.weights = weights
+        self.trainable = trainable
 
     def build(self, rng, input_shape):
-        return {"embeddings": self.init(rng, (self.input_dim, self.output_dim))}, {}
+        if self.weights is not None:
+            table = jnp.asarray(self.weights, jnp.float32)
+            if table.shape != (self.input_dim, self.output_dim):
+                raise ValueError(
+                    f"pretrained weights {table.shape} != "
+                    f"({self.input_dim}, {self.output_dim})")
+        else:
+            table = self.init(rng, (self.input_dim, self.output_dim))
+        if self.trainable:
+            return {"embeddings": table}, {}
+        return {}, {"embeddings": table}  # frozen: state, not params
 
     def call(self, params, state, inputs, *, training=False, rng=None):
         idx = inputs.astype(jnp.int32)
-        return jnp.take(params["embeddings"], idx, axis=0), state
+        table = params["embeddings"] if self.trainable else state["embeddings"]
+        return jnp.take(table, idx, axis=0), state
 
     def compute_output_shape(self, input_shape):
         return tuple(input_shape) + (self.output_dim,)
 
 
-class WordEmbedding(Layer):
-    """Frozen pretrained word vectors (reference ``WordEmbedding.scala``):
-    the table lives in state (non-trainable), not params."""
+class WordEmbedding(Embedding):
+    """Pretrained word vectors, frozen by default (reference
+    ``WordEmbedding.scala``) — an ``Embedding`` constructed from a table."""
 
     def __init__(self, weights: np.ndarray, trainable: bool = False,
                  name: Optional[str] = None):
-        super().__init__(name)
-        self.weights = jnp.asarray(weights)
-        self.trainable = trainable
-
-    def build(self, rng, input_shape):
-        if self.trainable:
-            return {"embeddings": self.weights}, {}
-        return {}, {"embeddings": self.weights}
-
-    def call(self, params, state, inputs, *, training=False, rng=None):
-        table = params.get("embeddings", state.get("embeddings"))
-        return jnp.take(table, inputs.astype(jnp.int32), axis=0), state
-
-    def compute_output_shape(self, input_shape):
-        return tuple(input_shape) + (self.weights.shape[1],)
+        weights = np.asarray(weights)
+        super().__init__(weights.shape[0], weights.shape[1],
+                         weights=weights, trainable=trainable, name=name)

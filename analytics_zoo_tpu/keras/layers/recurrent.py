@@ -21,12 +21,14 @@ from .core import get_activation
 
 class _RNNBase(Layer):
     def __init__(self, output_dim: int, return_sequences: bool = False,
-                 go_backwards: bool = False, init="glorot_uniform",
+                 go_backwards: bool = False, return_state: bool = False,
+                 init="glorot_uniform",
                  inner_init="orthogonal", name: Optional[str] = None):
         super().__init__(name)
         self.output_dim = output_dim
         self.return_sequences = return_sequences
         self.go_backwards = go_backwards
+        self.return_state = return_state
         self.init = initializers.get(init if init != "orthogonal" else "glorot_uniform")
         self.inner_init = self._orthogonal if inner_init == "orthogonal" \
             else initializers.get(inner_init)
@@ -42,9 +44,14 @@ class _RNNBase(Layer):
         return q[:rows, :cols]
 
     def compute_output_shape(self, input_shape):
-        if self.return_sequences:
-            return (input_shape[0], input_shape[1], self.output_dim)
-        return (input_shape[0], self.output_dim)
+        if isinstance(input_shape, list):
+            input_shape = input_shape[0]
+        out = ((input_shape[0], input_shape[1], self.output_dim)
+               if self.return_sequences else (input_shape[0], self.output_dim))
+        if self.return_state:
+            n_states = 2 if isinstance(self, LSTM) else 1
+            return [out] + [(input_shape[0], self.output_dim)] * n_states
+        return out
 
     def _run_scan(self, step, carry0, inputs):
         xs = jnp.swapaxes(inputs, 0, 1)  # [T, B, D] scan layout
@@ -58,6 +65,8 @@ class _RNNBase(Layer):
 
 class LSTM(_RNNBase):
     def build(self, rng, input_shape):
+        if isinstance(input_shape, list):
+            input_shape = input_shape[0]
         in_dim = input_shape[-1]
         u = self.output_dim
         k1, k2 = jax.random.split(rng)
@@ -70,8 +79,12 @@ class LSTM(_RNNBase):
     def call(self, params, state, inputs, *, training=False, rng=None):
         u = self.output_dim
         kernel, bias = params["kernel"], params["bias"]
-        B = inputs.shape[0]
-        dtype = inputs.dtype
+        if isinstance(inputs, (list, tuple)):  # [x, h0, c0] initial state
+            x, h0, c0 = inputs[0], inputs[1], inputs[2]
+        else:
+            x, h0, c0 = inputs, None, None
+        B = x.shape[0]
+        dtype = x.dtype
 
         def step(carry, x_t):
             h, c = carry
@@ -81,13 +94,19 @@ class LSTM(_RNNBase):
             h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
             return (h_new, c_new), h_new
 
-        carry0 = (jnp.zeros((B, u), dtype), jnp.zeros((B, u), dtype))
-        (h, _), ys = self._run_scan(step, carry0, inputs)
-        return (ys if self.return_sequences else h), state
+        carry0 = (h0 if h0 is not None else jnp.zeros((B, u), dtype),
+                  c0 if c0 is not None else jnp.zeros((B, u), dtype))
+        (h, c), ys = self._run_scan(step, carry0, x)
+        out = ys if self.return_sequences else h
+        if self.return_state:
+            return [out, h, c], state
+        return out, state
 
 
 class GRU(_RNNBase):
     def build(self, rng, input_shape):
+        if isinstance(input_shape, list):
+            input_shape = input_shape[0]
         in_dim = input_shape[-1]
         u = self.output_dim
         k1, k2, k3, k4 = jax.random.split(rng, 4)
@@ -100,8 +119,12 @@ class GRU(_RNNBase):
 
     def call(self, params, state, inputs, *, training=False, rng=None):
         u = self.output_dim
-        B = inputs.shape[0]
-        dtype = inputs.dtype
+        if isinstance(inputs, (list, tuple)):  # [x, h0] initial state
+            x, h0_in = inputs[0], inputs[1]
+        else:
+            x, h0_in = inputs, None
+        B = x.shape[0]
+        dtype = x.dtype
         gates_k = params["gates"].astype(dtype)
         cand_k = params["candidate"].astype(dtype)
         gb, cb = params["gate_bias"].astype(dtype), params["cand_bias"].astype(dtype)
@@ -113,9 +136,12 @@ class GRU(_RNNBase):
             h_new = z * h + (1 - z) * hh
             return h_new, h_new
 
-        h0 = jnp.zeros((B, u), dtype)
-        h, ys = self._run_scan(step, h0, inputs)
-        return (ys if self.return_sequences else h), state
+        h0 = h0_in if h0_in is not None else jnp.zeros((B, u), dtype)
+        h, ys = self._run_scan(step, h0, x)
+        out = ys if self.return_sequences else h
+        if self.return_state:
+            return [out, h], state
+        return out, state
 
 
 class SimpleRNN(_RNNBase):
@@ -124,6 +150,8 @@ class SimpleRNN(_RNNBase):
         self.activation = get_activation(activation)
 
     def build(self, rng, input_shape):
+        if isinstance(input_shape, list):
+            input_shape = input_shape[0]
         in_dim = input_shape[-1]
         u = self.output_dim
         k1, k2 = jax.random.split(rng)
@@ -133,8 +161,12 @@ class SimpleRNN(_RNNBase):
 
     def call(self, params, state, inputs, *, training=False, rng=None):
         u = self.output_dim
-        B = inputs.shape[0]
-        dtype = inputs.dtype
+        if isinstance(inputs, (list, tuple)):  # [x, h0] initial state
+            x, h0_in = inputs[0], inputs[1]
+        else:
+            x, h0_in = inputs, None
+        B = x.shape[0]
+        dtype = x.dtype
         kernel = params["kernel"].astype(dtype)
         bias = params["bias"].astype(dtype)
 
@@ -142,9 +174,12 @@ class SimpleRNN(_RNNBase):
             h_new = self.activation(jnp.concatenate([x_t, h], axis=-1) @ kernel + bias)
             return h_new, h_new
 
-        h0 = jnp.zeros((B, u), dtype)
-        h, ys = self._run_scan(step, h0, inputs)
-        return (ys if self.return_sequences else h), state
+        h0 = h0_in if h0_in is not None else jnp.zeros((B, u), dtype)
+        h, ys = self._run_scan(step, h0, x)
+        out = ys if self.return_sequences else h
+        if self.return_state:
+            return [out, h], state
+        return out, state
 
 
 class Bidirectional(Layer):

@@ -1,2 +1,9 @@
 from .common import Recommender, ZooModel, register_zoo_model  # noqa: F401
-from .recommendation import NeuralCF  # noqa: F401
+from .recommendation import (  # noqa: F401
+    ColumnFeatureInfo, NeuralCF, SessionRecommender, WideAndDeep,
+    cross_columns, features_from_dataframe)
+from .anomalydetection import (  # noqa: F401
+    AnomalyDetector, detect_anomalies, unroll)
+from .textclassification import TextClassifier  # noqa: F401
+from .textmatching import KNRM  # noqa: F401
+from .seq2seq import Seq2seq  # noqa: F401

@@ -1,0 +1,81 @@
+"""LSTM anomaly detector (reference
+``models/anomalydetection/AnomalyDetector.scala:40`` + unroll/threshold utils
+in ``anomalydetection/Utils.scala``): stacked LSTMs forecast the next value of
+a time series; records with the largest forecast error are anomalies."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common import ZooModel, register_zoo_model
+from ...keras import Sequential
+from ...keras.layers import Dense, Dropout, LSTM
+
+
+def unroll(data: np.ndarray, unroll_length: int,
+           predict_step: int = 1) -> Tuple[np.ndarray, np.ndarray]:
+    """Sliding windows: features [n, unroll_length, d], labels = the value
+    ``predict_step`` after each window (first feature column)."""
+    data = np.asarray(data, np.float32)
+    if data.ndim == 1:
+        data = data[:, None]
+    n = len(data) - unroll_length - predict_step + 1
+    if n <= 0:
+        raise ValueError("series shorter than unroll_length + predict_step")
+    idx = np.arange(unroll_length)[None, :] + np.arange(n)[:, None]
+    x = data[idx]
+    y = data[np.arange(n) + unroll_length + predict_step - 1, 0]
+    return x, y.astype(np.float32)
+
+
+def detect_anomalies(y_true: np.ndarray, y_pred: np.ndarray,
+                     anomaly_size: int = 5
+                     ) -> List[Tuple[int, float, float, bool]]:
+    """Mark the ``anomaly_size`` records with the largest absolute forecast
+    error (reference ``AnomalyDetector.detectAnomalies``). Returns
+    (index, truth, predicted, is_anomaly) per record."""
+    y_true = np.asarray(y_true).reshape(-1)
+    y_pred = np.asarray(y_pred).reshape(-1)
+    err = np.abs(y_true - y_pred)
+    if anomaly_size <= 0:
+        threshold = np.inf  # nothing flagged
+    elif anomaly_size <= len(err):
+        threshold = np.sort(err)[-anomaly_size]
+    else:
+        threshold = -1.0  # everything flagged
+    return [(i, float(t), float(p), bool(e >= threshold))
+            for i, (t, p, e) in enumerate(zip(y_true, y_pred, err))]
+
+
+@register_zoo_model
+class AnomalyDetector(ZooModel):
+    """``feature_shape`` = (unroll_length, feature_dim)."""
+
+    def __init__(self, feature_shape: Sequence[int],
+                 hidden_layers: Sequence[int] = (8, 32, 15),
+                 dropouts: Sequence[float] = (0.2, 0.2, 0.2)):
+        super().__init__()
+        if len(hidden_layers) != len(dropouts):
+            raise ValueError("hidden_layers and dropouts must align")
+        self.feature_shape = tuple(feature_shape)
+        self.hidden_layers = list(hidden_layers)
+        self.dropouts = list(dropouts)
+
+    def get_config(self) -> Dict[str, Any]:
+        return {"feature_shape": list(self.feature_shape),
+                "hidden_layers": self.hidden_layers,
+                "dropouts": self.dropouts}
+
+    def build_model(self) -> Sequential:
+        model = Sequential(name="anomaly_detector")
+        for units, drop in zip(self.hidden_layers[:-1], self.dropouts[:-1]):
+            model.add(LSTM(units, return_sequences=True))
+            model.add(Dropout(drop))
+        model.add(LSTM(self.hidden_layers[-1], return_sequences=False))
+        model.add(Dropout(self.dropouts[-1]))
+        model.add(Dense(1))
+        return model
+
+    def default_compile(self):
+        self.compile(optimizer="adam", loss="mse", metrics=["mse"])

@@ -1,1 +1,4 @@
 from .ncf import NeuralCF  # noqa: F401
+from .wide_and_deep import (  # noqa: F401
+    ColumnFeatureInfo, WideAndDeep, cross_columns, features_from_dataframe)
+from .session_recommender import SessionRecommender  # noqa: F401

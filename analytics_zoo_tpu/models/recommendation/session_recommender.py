@@ -1,0 +1,89 @@
+"""Session-based recommender (reference
+``models/recommendation/SessionRecommender.scala``: GRU stack over the
+session click sequence, optional MLP over summed purchase-history embeddings,
+summed logits → softmax over the item vocabulary)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common import Recommender, register_zoo_model
+from ...keras import Input, Model
+from ...keras.engine import SymbolicTensor
+from ...keras.layers import (
+    Activation, Dense, Embedding, Flatten, GRU, Lambda, merge)
+
+
+@register_zoo_model
+class SessionRecommender(Recommender):
+    def __init__(self, item_count: int, item_embed: int = 100,
+                 rnn_hidden_layers: Sequence[int] = (40, 20),
+                 session_length: int = 0, include_history: bool = False,
+                 mlp_hidden_layers: Sequence[int] = (40, 20),
+                 history_length: int = 0):
+        super().__init__()
+        if session_length <= 0:
+            raise ValueError("session_length must be positive")
+        if include_history and history_length <= 0:
+            raise ValueError("history_length must be positive with history")
+        self.item_count = item_count
+        self.item_embed = item_embed
+        self.rnn_hidden_layers = list(rnn_hidden_layers)
+        self.session_length = session_length
+        self.include_history = include_history
+        self.mlp_hidden_layers = list(mlp_hidden_layers)
+        self.history_length = history_length
+
+    def get_config(self) -> Dict[str, Any]:
+        return {
+            "item_count": self.item_count, "item_embed": self.item_embed,
+            "rnn_hidden_layers": self.rnn_hidden_layers,
+            "session_length": self.session_length,
+            "include_history": self.include_history,
+            "mlp_hidden_layers": self.mlp_hidden_layers,
+            "history_length": self.history_length,
+        }
+
+    def build_model(self) -> Model:
+        in_session = Input((self.session_length,), name="session_input")
+        x = Embedding(self.item_count + 1, self.item_embed, init="normal",
+                      name="session_table")(in_session)
+        for units in self.rnn_hidden_layers[:-1]:
+            x = GRU(units, return_sequences=True)(x)
+        x = GRU(self.rnn_hidden_layers[-1], return_sequences=False)(x)
+        rnn_logits = Dense(self.item_count, name="rnn_linear")(x)
+
+        if not self.include_history:
+            out = Activation("softmax", name="prediction")(rnn_logits)
+            return Model(in_session, out, name="session_recommender")
+
+        in_history = Input((self.history_length,), name="history_input")
+        h = Embedding(self.item_count + 1, self.item_embed, init="normal",
+                      name="history_table")(in_history)
+        h = Lambda(lambda t: t.sum(axis=1), name="history_sum")(h)
+        for i, units in enumerate(self.mlp_hidden_layers):
+            h = Dense(units, activation="relu", name=f"mlp_dense_{i}")(h)
+        mlp_logits = Dense(self.item_count, name="mlp_linear")(h)
+        out = Activation("softmax", name="prediction")(
+            merge([rnn_logits, mlp_logits], mode="sum"))
+        return Model([in_session, in_history], out,
+                     name="session_recommender")
+
+    def default_compile(self):
+        self.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                     metrics=["accuracy"])
+
+    # -- session ranking (reference recommendForSession) ----------------------
+
+    def recommend_for_session(self, sessions, max_items: int = 5,
+                              zero_based_label: bool = True,
+                              batch_size: int = 1024
+                              ) -> List[List[Tuple[int, float]]]:
+        """Top-N (item, probability) per session row. Items are 1-based when
+        ``zero_based_label`` is False (the reference's BigDL convention)."""
+        probs = np.asarray(self.predict(sessions, batch_size=batch_size))
+        top = np.argsort(-probs, axis=1)[:, :max_items]
+        offset = 0 if zero_based_label else 1
+        return [[(int(i) + offset, float(p[i])) for i in row]
+                for row, p in zip(top, probs)]

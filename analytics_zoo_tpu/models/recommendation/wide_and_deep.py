@@ -1,0 +1,231 @@
+"""Wide & Deep recommender (reference
+``models/recommendation/WideAndDeep.scala:101`` + column spec
+``recommendation/Utils.scala``).
+
+TPU re-design of the sparse "wide" path: the reference feeds a giant sparse
+one-hot vector into ``SparseDense``; here the wide features stay as *bucket
+indices* and the wide linear layer is an embedding-sum over a
+``[total_wide_dim, num_classes]`` table — mathematically identical
+(one_hot(x) @ W == W[x].sum), but it becomes an on-device gather + scatter-add
+gradient, the allreduce-stress case SURVEY.md §7 hard part (b) calls out.
+Indicator columns are one-hot'ed on device (cheap, fuses into the first
+matmul); embedding columns get per-column tables; continuous pass through.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..common import Recommender, register_zoo_model
+from ...keras import Input, Model
+from ...keras.engine import Layer
+from ...keras.layers import Dense, Embedding, Flatten, Lambda, merge
+
+
+@dataclass
+class ColumnFeatureInfo:
+    """Column spec (reference ``ColumnFeatureInfo``, recommendation/Utils.scala).
+
+    All dims are per-column cardinalities; wide-cross columns are pre-hashed
+    bucket ids produced by :func:`cross_columns`.
+    """
+    wide_base_cols: Sequence[str] = field(default_factory=list)
+    wide_base_dims: Sequence[int] = field(default_factory=list)
+    wide_cross_cols: Sequence[str] = field(default_factory=list)
+    wide_cross_dims: Sequence[int] = field(default_factory=list)
+    indicator_cols: Sequence[str] = field(default_factory=list)
+    indicator_dims: Sequence[int] = field(default_factory=list)
+    embed_cols: Sequence[str] = field(default_factory=list)
+    embed_in_dims: Sequence[int] = field(default_factory=list)
+    embed_out_dims: Sequence[int] = field(default_factory=list)
+    continuous_cols: Sequence[str] = field(default_factory=list)
+    label: str = "label"
+
+    @property
+    def wide_dims(self) -> List[int]:
+        return list(self.wide_base_dims) + list(self.wide_cross_dims)
+
+    @property
+    def wide_cols(self) -> List[str]:
+        return list(self.wide_base_cols) + list(self.wide_cross_cols)
+
+
+def cross_columns(df, cols: Sequence[str], bucket_size: int) -> np.ndarray:
+    """Hash-cross of categorical columns into ``bucket_size`` buckets
+    (reference ``Utils.buckBucket``). Uses crc32, stable across processes —
+    train-time and serve-time features must land in the same bucket."""
+    import zlib
+    acc = np.zeros(len(df), dtype=np.int64)
+    for c in cols:
+        acc = acc * 1000003 + np.asarray(
+            [zlib.crc32(str(v).encode()) for v in df[c]], dtype=np.int64)
+    return np.abs(acc) % bucket_size
+
+
+def features_from_dataframe(df, column_info: ColumnFeatureInfo
+                            ) -> Tuple[List[np.ndarray], Optional[np.ndarray]]:
+    """pandas DataFrame → the 4 model input arrays + labels (the reference's
+    ``row2Sample``, Utils.scala:108). Categorical columns must already be
+    integer-indexed (0-based per column)."""
+    n = len(df)
+    offsets = np.cumsum([0] + list(column_info.wide_dims))[:-1]
+    # categorical ids travel as int32 — float32 transport would corrupt ids
+    # above 2^24 (hashed crosses / large vocabularies)
+    wide = np.stack([
+        np.clip(df[c].to_numpy().astype(np.int64), 0, d - 1) + off
+        for c, d, off in zip(column_info.wide_cols, column_info.wide_dims,
+                             offsets)], axis=1).astype(np.int32) \
+        if column_info.wide_cols else np.zeros((n, 0), np.int32)
+    ind = np.stack([
+        np.clip(df[c].to_numpy().astype(np.int64), 0, d - 1)
+        for c, d in zip(column_info.indicator_cols, column_info.indicator_dims)],
+        axis=1).astype(np.int32) \
+        if column_info.indicator_cols else np.zeros((n, 0), np.int32)
+    emb = np.stack([
+        np.clip(df[c].to_numpy().astype(np.int64), 0, d - 1)
+        for c, d in zip(column_info.embed_cols, column_info.embed_in_dims)],
+        axis=1).astype(np.int32) \
+        if column_info.embed_cols else np.zeros((n, 0), np.int32)
+    cont = np.stack([df[c].to_numpy().astype(np.float32)
+                     for c in column_info.continuous_cols], axis=1) \
+        if column_info.continuous_cols else np.zeros((n, 0), np.float32)
+    labels = (df[column_info.label].to_numpy().astype(np.float32)
+              if column_info.label in df.columns else None)
+    return [wide, ind, emb, cont], labels
+
+
+class _WideLinear(Layer):
+    """Embedding-sum sparse linear layer: the TPU ``SparseDense``."""
+
+    def __init__(self, total_dim: int, num_classes: int, name=None):
+        super().__init__(name)
+        self.total_dim = total_dim
+        self.num_classes = num_classes
+
+    def build(self, rng, input_shape):
+        import jax
+        table = jax.random.uniform(
+            rng, (self.total_dim, self.num_classes), minval=-0.05, maxval=0.05)
+        return {"table": table, "bias": jnp.zeros((self.num_classes,))}, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        idx = inputs.astype(jnp.int32)  # [b, n_wide] offset bucket ids
+        out = jnp.take(params["table"], idx, axis=0).sum(1) + params["bias"]
+        return out, state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], self.num_classes)
+
+
+class _OneHotConcat(Layer):
+    """Indicator indices → concatenated one-hot block (device-side)."""
+
+    def __init__(self, dims: Sequence[int], name=None):
+        super().__init__(name)
+        self.dims = list(dims)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        import jax
+        idx = inputs.astype(jnp.int32)
+        parts = [jax.nn.one_hot(idx[:, i], d) for i, d in enumerate(self.dims)]
+        return jnp.concatenate(parts, axis=-1), state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], sum(self.dims))
+
+
+@register_zoo_model
+class WideAndDeep(Recommender):
+    """Inputs (all [batch, n] float arrays, see ``features_from_dataframe``):
+    [wide offset-indices, indicator indices, embed indices, continuous]."""
+
+    def __init__(self, model_type: str = "wide_n_deep", num_classes: int = 2,
+                 column_info: Optional[ColumnFeatureInfo] = None,
+                 hidden_layers: Sequence[int] = (40, 20, 10),
+                 **column_kwargs):
+        super().__init__()
+        if model_type not in ("wide", "deep", "wide_n_deep"):
+            raise ValueError(f"unknown model_type {model_type}")
+        if column_info is None:
+            column_info = ColumnFeatureInfo(**column_kwargs)
+        elif isinstance(column_info, dict):
+            column_info = ColumnFeatureInfo(**column_info)
+        self.model_type = model_type
+        self.num_classes = num_classes
+        self.column_info = column_info
+        self.hidden_layers = list(hidden_layers)
+
+    def get_config(self) -> Dict[str, Any]:
+        ci = self.column_info
+        return {
+            "model_type": self.model_type, "num_classes": self.num_classes,
+            "hidden_layers": self.hidden_layers,
+            "column_info": {
+                "wide_base_cols": list(ci.wide_base_cols),
+                "wide_base_dims": list(ci.wide_base_dims),
+                "wide_cross_cols": list(ci.wide_cross_cols),
+                "wide_cross_dims": list(ci.wide_cross_dims),
+                "indicator_cols": list(ci.indicator_cols),
+                "indicator_dims": list(ci.indicator_dims),
+                "embed_cols": list(ci.embed_cols),
+                "embed_in_dims": list(ci.embed_in_dims),
+                "embed_out_dims": list(ci.embed_out_dims),
+                "continuous_cols": list(ci.continuous_cols),
+                "label": ci.label,
+            },
+        }
+
+    def build_model(self) -> Model:
+        ci = self.column_info
+        in_wide = Input((len(ci.wide_cols),), name="wide_input")
+        in_ind = Input((len(ci.indicator_cols),), name="indicator_input")
+        in_emb = Input((len(ci.embed_cols),), name="embed_input")
+        in_cont = Input((len(ci.continuous_cols),), name="continuous_input")
+        inputs = [in_wide, in_ind, in_emb, in_cont]
+
+        wide_out = None
+        if ci.wide_cols:
+            wide_out = _WideLinear(sum(ci.wide_dims), self.num_classes,
+                                   name="wide_linear")(in_wide)
+
+        deep_out = None
+        deep_parts = []
+        if ci.indicator_cols:
+            deep_parts.append(
+                _OneHotConcat(ci.indicator_dims, name="indicator_onehot")(in_ind))
+        for i, (c, din, dout) in enumerate(zip(
+                ci.embed_cols, ci.embed_in_dims, ci.embed_out_dims)):
+            col = Lambda(lambda x, i=i: x[:, i:i + 1], name=f"embed_col_{i}")(in_emb)
+            e = Embedding(din, dout, init="normal", name=f"embed_table_{c}")(col)
+            deep_parts.append(Flatten(name=f"embed_flat_{c}")(e))
+        if ci.continuous_cols:
+            deep_parts.append(in_cont)
+        if deep_parts:
+            h = (merge(deep_parts, mode="concat") if len(deep_parts) > 1
+                 else deep_parts[0])
+            for i, units in enumerate(self.hidden_layers):
+                h = Dense(units, activation="relu", name=f"deep_dense_{i}")(h)
+            deep_out = Dense(self.num_classes, name="deep_linear")(h)
+
+        from ...keras.layers import Activation
+        if self.model_type == "wide":
+            if wide_out is None:
+                raise ValueError("model_type 'wide' needs wide columns")
+            out = Activation("softmax", name="prediction")(wide_out)
+        elif self.model_type == "deep":
+            if deep_out is None:
+                raise ValueError("model_type 'deep' needs deep columns")
+            out = Activation("softmax", name="prediction")(deep_out)
+        else:
+            if wide_out is None or deep_out is None:
+                raise ValueError("wide_n_deep needs both wide and deep columns")
+            out = Activation("softmax", name="prediction")(
+                merge([wide_out, deep_out], mode="sum"))
+        return Model(inputs, out, name="wide_and_deep")
+
+    def default_compile(self):
+        self.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                     metrics=["accuracy"])

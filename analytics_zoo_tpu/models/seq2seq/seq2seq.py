@@ -1,0 +1,193 @@
+"""Seq2seq (reference ``models/seq2seq/Seq2seq.scala:50``: RNN encoder →
+bridge → RNN decoder → generator head, teacher-forced training, greedy
+inference loop).
+
+TPU design: encoder and decoder are stacked fused-gate LSTM/GRU scans
+(``keras/layers/recurrent.py``); the bridge maps every encoder final state to
+the decoder's initial state ("passthrough" identity or "dense" learned
+projection — the reference Bridge.scala contract). Training input is
+``[encoder_seq, decoder_seq]`` (teacher forcing); ``infer`` runs the greedy
+decode loop on host with a jitted single-step."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import ZooModel, register_zoo_model
+from ...keras import Sequential
+from ...keras.engine import Layer
+from ...keras.layers import Dense, GRU, LSTM
+
+
+class _Seq2seqCore(Layer):
+    def __init__(self, rnn_type: str, num_layers: int, hidden_size: int,
+                 bridge: str, generator_dim: Optional[int],
+                 generator_activation: Optional[str], name=None):
+        super().__init__(name)
+        rnn_type = rnn_type.lower()
+        if rnn_type not in ("lstm", "gru"):
+            raise ValueError(f"unsupported rnn_type {rnn_type}")
+        if bridge not in ("passthrough", "dense"):
+            raise ValueError(f"unsupported bridge {bridge}")
+        self.rnn_type = rnn_type
+        self.num_layers = num_layers
+        self.hidden_size = hidden_size
+        self.bridge = bridge
+        self.generator_dim = generator_dim
+        cls = LSTM if rnn_type == "lstm" else GRU
+        self.n_states = 2 if rnn_type == "lstm" else 1
+        self.enc_layers = [
+            cls(hidden_size, return_sequences=True, return_state=True,
+                name=f"{self.name}_enc_{i}") for i in range(num_layers)]
+        self.dec_layers = [
+            cls(hidden_size, return_sequences=True, return_state=True,
+                name=f"{self.name}_dec_{i}") for i in range(num_layers)]
+        self.generator = (Dense(generator_dim,
+                                activation=generator_activation,
+                                name=f"{self.name}_generator")
+                          if generator_dim else None)
+
+    def build(self, rng, input_shape):
+        enc_shape, dec_shape = input_shape[0], input_shape[1]
+        params = {}
+        shape = enc_shape
+        for i, layer in enumerate(self.enc_layers):
+            rng, sub = jax.random.split(rng)
+            params[f"enc_{i}"], _ = layer.build(sub, shape)
+            shape = (shape[0], shape[1], self.hidden_size)
+        shape = dec_shape
+        for i, layer in enumerate(self.dec_layers):
+            rng, sub = jax.random.split(rng)
+            params[f"dec_{i}"], _ = layer.build(sub, shape)
+            shape = (shape[0], shape[1], self.hidden_size)
+        if self.bridge == "dense":
+            for i in range(self.num_layers):
+                for s in range(self.n_states):
+                    rng, sub = jax.random.split(rng)
+                    d = Dense(self.hidden_size, name=f"bridge_{i}_{s}")
+                    params[f"bridge_{i}_{s}"], _ = d.build(
+                        sub, (None, self.hidden_size))
+        if self.generator is not None:
+            rng, sub = jax.random.split(rng)
+            params["generator"], _ = self.generator.build(
+                sub, (None, None, self.hidden_size))
+        return params, {}
+
+    def compute_output_shape(self, input_shape):
+        dec_shape = input_shape[1]
+        out_dim = self.generator_dim or self.hidden_size
+        return (dec_shape[0], dec_shape[1], out_dim)
+
+    def _bridge_state(self, params, i, states):
+        if self.bridge == "passthrough":
+            return states
+        out = []
+        for s, st in enumerate(states):
+            p = params[f"bridge_{i}_{s}"]
+            out.append(st @ p["kernel"] + p["bias"])
+        return out
+
+    def encode(self, params, x):
+        """Run the encoder stack; returns per-layer final states."""
+        states = []
+        for i, layer in enumerate(self.enc_layers):
+            outs, _ = layer.call(params[f"enc_{i}"], {}, x)
+            x, layer_states = outs[0], outs[1:]
+            states.append(self._bridge_state(params, i, layer_states))
+        return states
+
+    def decode(self, params, y, init_states):
+        """Run the decoder stack from ``init_states``; returns
+        (sequence output, per-layer final states)."""
+        new_states = []
+        for i, layer in enumerate(self.dec_layers):
+            outs, _ = layer.call(
+                params[f"dec_{i}"], {}, [y] + list(init_states[i]))
+            y, layer_states = outs[0], outs[1:]
+            new_states.append(list(layer_states))
+        if self.generator is not None:
+            p = self.generator
+            y, _ = p.call(params["generator"], {}, y)
+        return y, new_states
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        enc_in, dec_in = inputs[0], inputs[1]
+        enc_states = self.encode(params, enc_in)
+        y, _ = self.decode(params, dec_in, enc_states)
+        return y, state
+
+
+@register_zoo_model
+class Seq2seq(ZooModel):
+    """Inputs: [encoder features [b, in_seq, in_dim],
+    decoder features [b, out_seq, out_dim]] → [b, out_seq, generator_dim]."""
+
+    def __init__(self, rnn_type: str = "lstm", num_layers: int = 1,
+                 hidden_size: int = 64, bridge: str = "passthrough",
+                 generator_dim: Optional[int] = None,
+                 generator_activation: Optional[str] = None):
+        super().__init__()
+        self.rnn_type = rnn_type
+        self.num_layers = num_layers
+        self.hidden_size = hidden_size
+        self.bridge = bridge
+        self.generator_dim = generator_dim
+        self.generator_activation = generator_activation
+
+    def get_config(self) -> Dict[str, Any]:
+        return {"rnn_type": self.rnn_type, "num_layers": self.num_layers,
+                "hidden_size": self.hidden_size, "bridge": self.bridge,
+                "generator_dim": self.generator_dim,
+                "generator_activation": self.generator_activation}
+
+    def build_model(self) -> Sequential:
+        core = _Seq2seqCore(self.rnn_type, self.num_layers, self.hidden_size,
+                            self.bridge, self.generator_dim,
+                            self.generator_activation, name="seq2seq_core")
+        self.core = core
+        return Sequential([core], name="seq2seq")
+
+    def default_compile(self):
+        self.compile(optimizer="adam", loss="mse")
+
+    def infer(self, enc_input: np.ndarray, start_sign: np.ndarray,
+              max_seq_len: int = 30,
+              stop_sign: Optional[np.ndarray] = None) -> np.ndarray:
+        """Greedy autoregressive decode (reference ``Seq2seq.infer``): feed
+        ``start_sign`` [out_dim], append each generated step. The per-step
+        encoder+decoder is jitted once; the loop runs on host."""
+        self._ensure_built()
+        est = self.model.get_estimator()
+        if est.params is None:
+            raise RuntimeError("model has no parameters yet; fit or "
+                               "load_weights first")
+        params = est.params["seq2seq_core"]
+        core = self.core
+
+        @jax.jit
+        def enc_fn(params, x):
+            return core.encode(params, x)
+
+        @jax.jit
+        def step_fn(params, y_t, states):
+            out, new_states = core.decode(params, y_t, states)
+            return out[:, -1], new_states
+
+        enc_input = np.asarray(enc_input, np.float32)
+        b = enc_input.shape[0]
+        states = enc_fn(params, jnp.asarray(enc_input))
+        y_t = jnp.broadcast_to(
+            jnp.asarray(start_sign, jnp.float32)[None, None, :],
+            (b, 1, len(start_sign)))
+        outs = []
+        for _ in range(max_seq_len):
+            y_next, states = step_fn(params, y_t, states)
+            outs.append(np.asarray(y_next))
+            if stop_sign is not None and np.allclose(
+                    outs[-1], np.asarray(stop_sign)[None, :], atol=1e-4):
+                break
+            y_t = y_next[:, None, :]
+        return np.stack(outs, axis=1)

@@ -1,0 +1,72 @@
+"""Text classifier (reference
+``models/textclassification/TextClassifier.scala:34``): embedding → CNN/LSTM/
+GRU encoder → Dense(128) relu → softmax. Input is either token ids [seq_len]
+(``vocab_size`` given, trainable embedding) or pre-embedded vectors
+[seq_len, token_length]."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..common import ZooModel, register_zoo_model
+from ...keras import Sequential
+from ...keras.layers import (
+    Activation, Convolution1D, Dense, Dropout, Embedding, GlobalMaxPooling1D,
+    GRU, LSTM)
+
+
+@register_zoo_model
+class TextClassifier(ZooModel):
+    def __init__(self, class_num: int, token_length: int,
+                 sequence_length: int = 500, encoder: str = "cnn",
+                 encoder_output_dim: int = 256,
+                 vocab_size: Optional[int] = None,
+                 embedding_weights: Optional[np.ndarray] = None,
+                 train_embedding: bool = True):
+        super().__init__()
+        if encoder.lower() not in ("cnn", "lstm", "gru"):
+            raise ValueError(f"unsupported encoder {encoder}")
+        self.class_num = class_num
+        self.token_length = token_length
+        self.sequence_length = sequence_length
+        self.encoder = encoder.lower()
+        self.encoder_output_dim = encoder_output_dim
+        self.vocab_size = vocab_size
+        self.embedding_weights = embedding_weights
+        self.train_embedding = train_embedding
+
+    def get_config(self) -> Dict[str, Any]:
+        return {"class_num": self.class_num,
+                "token_length": self.token_length,
+                "sequence_length": self.sequence_length,
+                "encoder": self.encoder,
+                "encoder_output_dim": self.encoder_output_dim,
+                "vocab_size": self.vocab_size,
+                "train_embedding": self.train_embedding}
+
+    def build_model(self) -> Sequential:
+        model = Sequential(name="text_classifier")
+        if self.vocab_size:
+            model.add(Embedding(self.vocab_size, self.token_length,
+                                weights=self.embedding_weights,
+                                trainable=self.train_embedding,
+                                name="embedding"))
+        if self.encoder == "cnn":
+            model.add(Convolution1D(self.encoder_output_dim, 5,
+                                    activation="relu"))
+            model.add(GlobalMaxPooling1D())
+        elif self.encoder == "lstm":
+            model.add(LSTM(self.encoder_output_dim))
+        else:
+            model.add(GRU(self.encoder_output_dim))
+        model.add(Dense(128))
+        model.add(Dropout(0.2))
+        model.add(Activation("relu"))
+        model.add(Dense(self.class_num, activation="softmax"))
+        return model
+
+    def default_compile(self):
+        self.compile(optimizer="adagrad",
+                     loss="sparse_categorical_crossentropy",
+                     metrics=["accuracy"])

@@ -42,8 +42,11 @@ def _largest_divisor_leq(n: int, cap: int) -> int:
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           bias: Optional[jax.Array] = None,
                           causal: bool = False,
-                          scale: Optional[float] = None) -> jax.Array:
-    """Reference attention: softmax(q k^T / sqrt(d) + bias) v."""
+                          scale: Optional[float] = None,
+                          dropout_rate: float = 0.0,
+                          dropout_rng: Optional[jax.Array] = None) -> jax.Array:
+    """Reference attention: softmax(q k^T / sqrt(d) + bias) v, with optional
+    attention-probability dropout (training regularizer)."""
     *_, q_len, head_dim = q.shape
     kv_len = k.shape[-2]
     scale = scale if scale is not None else 1.0 / math.sqrt(head_dim)
@@ -56,6 +59,10 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         ki = lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 1)
         scores = jnp.where(qi >= ki, scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = 1.0 - dropout_rate
+        mask = jax.random.bernoulli(dropout_rng, keep, probs.shape)
+        probs = jnp.where(mask, probs / keep, 0.0)
     return jnp.einsum("...qk,...kd->...qd", probs.astype(v.dtype), v)
 
 

@@ -46,12 +46,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     b, h, sq, d = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
 
-    def hop(carry, i):
-        acc, m, l, kc, vc = carry
+    def fold(acc, m, l, kc, vc, i):
+        """Fold one visiting K/V shard's partial softmax stats into (acc, m, l)."""
         src_rank = (my + i) % n  # which shard's K/V we currently hold
-
-        # blockwise attention of local q against this k/v shard, folding the
-        # partial stats into the running (acc, m, l)
         s = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
                        preferred_element_type=jnp.float32) * scale
         if causal:
@@ -65,13 +62,17 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         acc_new = acc * corr + jnp.einsum(
             "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32),
             preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
 
+    def hop(carry, i):
+        acc, m, l, kc, vc = carry
+        acc, m, l = fold(acc, m, l, kc, vc, i)
         # rotate k/v to the next device on the ring (overlaps with the next
         # hop's compute under XLA's async collective scheduling)
         perm = [(j, (j - 1) % n) for j in range(n)]
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
-        return (acc_new, m_new, l_new, kc, vc), None
+        return (acc, m, l, kc, vc), None
 
     # accumulators derive from q*0 so they inherit q's varying-axis type —
     # shard_map's vma check requires the scan carry to be device-varying
@@ -80,7 +81,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             zero_q[..., :1] + _NEG_INF,
             zero_q[..., :1],
             k, v)
-    (acc, m, l, _, _), _ = lax.scan(hop, init, jnp.arange(n))
+    # n-1 rotating hops, then the last visiting shard is folded without the
+    # (wasted) final rotation
+    (acc, m, l, kc, vc), _ = lax.scan(hop, init, jnp.arange(n - 1))
+    acc, m, l = fold(acc, m, l, kc, vc, n - 1)
     return (acc / jnp.maximum(l, 1e-30)).astype(v.dtype)
 
 

@@ -1,0 +1,209 @@
+"""Model-zoo tests (reference strategy, SURVEY.md §4: construct, fit 1-2
+iterations on tiny random data, predict/evaluate, save/load round-trip)."""
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models import (
+    AnomalyDetector, ColumnFeatureInfo, KNRM, Seq2seq, SessionRecommender,
+    TextClassifier, WideAndDeep, ZooModel, detect_anomalies, unroll)
+
+
+def fit_little(model, x, y, batch=8):
+    model.default_compile()
+    return model.fit(x, y, batch_size=batch, nb_epoch=1)
+
+
+class TestWideAndDeep:
+    def make_data(self, n=32):
+        rs = np.random.RandomState(0)
+        wide = np.stack([rs.randint(0, 5, n), 5 + rs.randint(0, 7, n)],
+                        1).astype(np.float32)
+        ind = rs.randint(0, 4, (n, 1)).astype(np.float32)
+        emb = rs.randint(0, 10, (n, 1)).astype(np.float32)
+        cont = rs.rand(n, 2).astype(np.float32)
+        y = rs.randint(0, 2, n).astype(np.float32)
+        return [wide, ind, emb, cont], y
+
+    def make_model(self, model_type="wide_n_deep"):
+        info = ColumnFeatureInfo(
+            wide_base_cols=["a"], wide_base_dims=[5],
+            wide_cross_cols=["ab"], wide_cross_dims=[7],
+            indicator_cols=["c"], indicator_dims=[4],
+            embed_cols=["d"], embed_in_dims=[10], embed_out_dims=[6],
+            continuous_cols=["x1", "x2"])
+        return WideAndDeep(model_type, num_classes=2, column_info=info,
+                           hidden_layers=[8, 4])
+
+    def test_fit_predict(self, ctx):
+        x, y = self.make_data()
+        wnd = self.make_model()
+        hist = fit_little(wnd, x, y)
+        assert hist["iterations"] >= 1
+        preds = wnd.predict(x, batch_size=8)
+        assert preds.shape == (32, 2)
+        np.testing.assert_allclose(np.asarray(preds).sum(1), 1, atol=1e-4)
+
+    def test_wide_only_and_deep_only(self, ctx):
+        x, y = self.make_data(16)
+        for mt in ("wide", "deep"):
+            m = self.make_model(mt)
+            fit_little(m, x, y)
+            assert m.predict(x, batch_size=8).shape == (16, 2)
+
+    def test_save_load(self, ctx, tmp_path):
+        x, y = self.make_data(16)
+        wnd = self.make_model()
+        fit_little(wnd, x, y)
+        p1 = wnd.predict(x, batch_size=8)
+        path = str(tmp_path / "wnd")
+        wnd.save_model(path)
+        loaded = ZooModel.load_model(path)
+        np.testing.assert_allclose(np.asarray(loaded.predict(x, batch_size=8)),
+                                   np.asarray(p1), atol=1e-5)
+
+    def test_features_from_dataframe(self):
+        import pandas as pd
+        from analytics_zoo_tpu.models import features_from_dataframe
+        df = pd.DataFrame({"a": [0, 1], "ab": [2, 3], "c": [1, 0],
+                           "d": [4, 5], "x1": [0.1, 0.2], "x2": [1.0, 2.0],
+                           "label": [0, 1]})
+        info = ColumnFeatureInfo(
+            wide_base_cols=["a"], wide_base_dims=[5],
+            wide_cross_cols=["ab"], wide_cross_dims=[7],
+            indicator_cols=["c"], indicator_dims=[4],
+            embed_cols=["d"], embed_in_dims=[10], embed_out_dims=[6],
+            continuous_cols=["x1", "x2"])
+        feats, labels = features_from_dataframe(df, info)
+        assert feats[0].shape == (2, 2)
+        assert feats[0][0, 1] == 5 + 2  # offset applied
+        assert labels.tolist() == [0.0, 1.0]
+
+
+class TestSessionRecommender:
+    def test_session_only(self, ctx):
+        rs = np.random.RandomState(1)
+        n, slen, items = 24, 6, 20
+        x = rs.randint(1, items + 1, (n, slen)).astype(np.float32)
+        y = rs.randint(0, items, n).astype(np.float32)
+        m = SessionRecommender(items, item_embed=8, rnn_hidden_layers=[8, 4],
+                               session_length=slen)
+        fit_little(m, x, y)
+        recs = m.recommend_for_session(x[:4], max_items=3)
+        assert len(recs) == 4 and len(recs[0]) == 3
+        assert all(0 <= i < items for i, p in recs[0])
+
+    def test_with_history(self, ctx):
+        rs = np.random.RandomState(2)
+        n, slen, hlen, items = 16, 5, 4, 15
+        x = [rs.randint(1, items + 1, (n, slen)).astype(np.float32),
+             rs.randint(1, items + 1, (n, hlen)).astype(np.float32)]
+        y = rs.randint(0, items, n).astype(np.float32)
+        m = SessionRecommender(items, item_embed=8, rnn_hidden_layers=[8],
+                               session_length=slen, include_history=True,
+                               mlp_hidden_layers=[8], history_length=hlen)
+        fit_little(m, x, y)
+        preds = m.predict(x, batch_size=8)
+        assert preds.shape == (n, items)
+
+
+class TestAnomalyDetector:
+    def test_unroll_and_detect(self):
+        series = np.arange(20, dtype=np.float32)
+        x, y = unroll(series, unroll_length=4)
+        assert x.shape == (16, 4, 1)
+        assert y[0] == 4.0  # first window [0..3] predicts 4
+        report = detect_anomalies(np.zeros(10), np.r_[np.zeros(9), 5.0],
+                                  anomaly_size=1)
+        assert report[9][3] and not report[0][3]
+
+    def test_fit_predict(self, ctx):
+        rs = np.random.RandomState(3)
+        series = np.sin(np.arange(80) / 5) + rs.rand(80) * 0.1
+        x, y = unroll(series.astype(np.float32), unroll_length=8)
+        m = AnomalyDetector(feature_shape=(8, 1), hidden_layers=[8, 4],
+                            dropouts=[0.2, 0.2])
+        fit_little(m, x, y)
+        preds = m.predict(x, batch_size=16)
+        assert preds.shape == (len(x), 1)
+
+
+class TestTextClassifier:
+    @pytest.mark.parametrize("encoder", ["cnn", "lstm", "gru"])
+    def test_encoders(self, ctx, encoder):
+        rs = np.random.RandomState(4)
+        n, seq, vocab = 16, 10, 30
+        x = rs.randint(0, vocab, (n, seq)).astype(np.float32)
+        y = rs.randint(0, 3, n).astype(np.float32)
+        m = TextClassifier(class_num=3, token_length=8, sequence_length=seq,
+                           encoder=encoder, encoder_output_dim=16,
+                           vocab_size=vocab)
+        fit_little(m, x, y)
+        preds = m.predict(x, batch_size=8)
+        assert preds.shape == (n, 3)
+        np.testing.assert_allclose(np.asarray(preds).sum(1), 1, atol=1e-4)
+
+    def test_pretrained_frozen_embedding(self, ctx):
+        rs = np.random.RandomState(5)
+        vocab, dim = 12, 6
+        weights = rs.rand(vocab, dim).astype(np.float32)
+        m = TextClassifier(class_num=2, token_length=dim, sequence_length=5,
+                           encoder="cnn", encoder_output_dim=8,
+                           vocab_size=vocab, embedding_weights=weights,
+                           train_embedding=False)
+        x = rs.randint(0, vocab, (8, 5)).astype(np.float32)
+        y = rs.randint(0, 2, 8).astype(np.float32)
+        fit_little(m, x, y)
+        est = m.model.get_estimator()
+        assert "embedding" not in est.params  # frozen table lives in state
+        assert "embedding" in est.model_state
+
+
+class TestKNRM:
+    def test_ranking_and_classification(self, ctx):
+        rs = np.random.RandomState(6)
+        q_len, d_len, vocab = 4, 6, 25
+        n = 16
+        x = rs.randint(0, vocab, (n, q_len + d_len)).astype(np.float32)
+        y = rs.rand(n).astype(np.float32)
+        m = KNRM(q_len, d_len, vocab, embed_size=8, kernel_num=5,
+                 target_mode="ranking")
+        m.compile("adam", "mse")
+        m.fit(x, y, batch_size=8, nb_epoch=1)
+        s = m.predict(x, batch_size=8)
+        assert s.shape == (n, 1)
+
+        mc = KNRM(q_len, d_len, vocab, embed_size=8, kernel_num=5,
+                  target_mode="classification")
+        mc.default_compile()
+        mc.fit(x, (y > 0.5).astype(np.float32), batch_size=8, nb_epoch=1)
+        p = np.asarray(mc.predict(x, batch_size=8))
+        assert ((0 <= p) & (p <= 1)).all()
+
+
+class TestSeq2seq:
+    def test_fit_and_infer(self, ctx):
+        rs = np.random.RandomState(7)
+        n, in_seq, out_seq, dim = 16, 6, 5, 3
+        enc = rs.rand(n, in_seq, dim).astype(np.float32)
+        dec = rs.rand(n, out_seq, dim).astype(np.float32)
+        target = rs.rand(n, out_seq, dim).astype(np.float32)
+        m = Seq2seq(rnn_type="lstm", num_layers=2, hidden_size=8,
+                    bridge="dense", generator_dim=dim)
+        m.default_compile()
+        m.fit([enc, dec], target, batch_size=8, nb_epoch=1)
+        preds = m.predict([enc, dec], batch_size=8)
+        assert preds.shape == (n, out_seq, dim)
+        gen = m.infer(enc[:2], start_sign=np.zeros(dim, np.float32),
+                      max_seq_len=4)
+        assert gen.shape == (2, 4, dim)
+
+    def test_gru_passthrough(self, ctx):
+        rs = np.random.RandomState(8)
+        enc = rs.rand(8, 4, 2).astype(np.float32)
+        dec = rs.rand(8, 3, 2).astype(np.float32)
+        target = rs.rand(8, 3, 2).astype(np.float32)
+        m = Seq2seq(rnn_type="gru", num_layers=1, hidden_size=4,
+                    generator_dim=2)
+        m.default_compile()
+        m.fit([enc, dec], target, batch_size=8, nb_epoch=1)
+        assert m.predict([enc, dec], batch_size=8).shape == (8, 3, 2)
