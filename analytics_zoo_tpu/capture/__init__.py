@@ -1,0 +1,23 @@
+"""Capture-style training APIs — the TFPark equivalent (SURVEY §2.5).
+
+The reference captures arbitrary TF1 graphs (``tf_optimizer.py:342``
+``from_loss``/``from_keras``/``from_train_op``; ``estimator.py:30``
+``TFEstimator``; ``model.py:34`` tfpark ``KerasModel``). On TPU nothing needs
+"capturing": a JAX function *is* the graph. This package keeps the same
+user contracts over plain functions / flax / haiku models:
+
+- :class:`GraphModel` — ``from_loss`` (user loss fn), ``from_forward``
+  (user forward fn + named loss), ``from_flax`` / ``from_haiku`` (module
+  capture), each driving the shared on-device Estimator loop.
+- :class:`FnEstimator` — ``model_fn(params, features, labels, mode, rng)``
+  with TRAIN/EVAL/PREDICT modes and ``input_fn(mode)`` datasets
+  (≙ ``TFEstimator``).
+- :class:`GANEstimator` — alternating generator/discriminator optimization
+  (≙ ``gan_estimator.py`` + ``GanOptimMethod.scala``).
+- text estimators: :class:`BERTClassifier` etc. over the native BERT layer
+  (≙ ``tfpark/text/estimator``).
+"""
+from .graph_model import GraphModel  # noqa: F401
+from .fn_estimator import FnEstimator, ModeKeys  # noqa: F401
+from .gan import GANEstimator  # noqa: F401
+from .text import BERTClassifier, BERTNER, BERTSQuAD  # noqa: F401

@@ -1,0 +1,69 @@
+"""FnEstimator — the TFEstimator contract (reference
+``pyzoo/zoo/tfpark/estimator.py:30,47,116,174,247``): a single ``model_fn``
+drives train/evaluate/predict, and data arrives via ``input_fn(mode)``.
+
+JAX shape of the contract: ``model_fn(params, features, labels, mode, rng)``
+returns the mode's value — TRAIN/EVAL: scalar loss; PREDICT: predictions.
+``init_fn(rng, sample_features) -> params``."""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..estimator.estimator import Estimator
+from ..feature.featureset import FeatureSet
+from ..keras import optimizers as opt_mod
+from .fn_layer import FunctionalModel
+
+
+class ModeKeys:
+    TRAIN = "train"
+    EVAL = "eval"
+    PREDICT = "predict"
+
+
+class FnEstimator:
+    def __init__(self, model_fn: Callable, init_fn: Callable,
+                 optimizer="adam", metrics: Optional[Sequence] = None):
+        self.model_fn = model_fn
+        model = FunctionalModel(
+            init_fn=lambda rng, sx: (init_fn(rng, sx), {}),
+            apply_fn=lambda p, s, x, training, rng: (
+                model_fn(p, x, None, ModeKeys.PREDICT, rng), s),
+            name="fn_estimator_model")
+
+        def direct(params, model_state, rng, x, y):
+            return self.model_fn(params, x, y, ModeKeys.TRAIN, rng), model_state
+
+        def direct_eval(params, model_state, rng, x, y):
+            return self.model_fn(params, x, y, ModeKeys.EVAL, rng), model_state
+
+        self.estimator = Estimator(
+            model=model, loss_fn=lambda y, yp: 0.0,
+            optimizer=opt_mod.get(optimizer), metrics=metrics,
+            direct_loss_fn=direct, direct_eval_loss_fn=direct_eval)
+
+    def _featureset(self, input_fn: Callable, mode: str) -> FeatureSet:
+        data = input_fn(mode)
+        if isinstance(data, FeatureSet):
+            return data
+        if isinstance(data, tuple) and len(data) == 2:
+            return FeatureSet.from_ndarrays(*data)
+        return FeatureSet.from_ndarrays(data, None, shuffle=False)
+
+    def train(self, input_fn: Callable, batch_size: int = 32,
+              epochs: int = 1, **kwargs) -> Dict[str, Any]:
+        fs = self._featureset(input_fn, ModeKeys.TRAIN)
+        return self.estimator.train(fs, batch_size=batch_size, epochs=epochs,
+                                    **kwargs)
+
+    def evaluate(self, input_fn: Callable, batch_size: int = 32
+                 ) -> Dict[str, float]:
+        fs = self._featureset(input_fn, ModeKeys.EVAL)
+        return self.estimator.evaluate(fs, batch_size=batch_size)
+
+    def predict(self, input_fn: Callable, batch_size: int = 32) -> np.ndarray:
+        fs = self._featureset(input_fn, ModeKeys.PREDICT)
+        return self.estimator.predict(fs, batch_size=batch_size)

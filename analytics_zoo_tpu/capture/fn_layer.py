@@ -1,0 +1,89 @@
+"""Adapter: (init_fn, apply_fn) pairs as Estimator-compatible model objects.
+
+The Estimator's contract is the Keras ``Layer`` protocol (``build`` →
+(params, state), pure ``call``). A :class:`FunctionalModel` satisfies it for
+any functional model — hand-written JAX, flax ``Module.init/apply``, haiku
+``transform`` — so captured models reuse the whole distributed loop,
+checkpointing, elasticity and metrics without translation (the reference
+needed ``TFTrainingHelper`` to fake a BigDL Layer around a TF graph;
+here the adapter is ~60 lines because the contracts already align)."""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _zeros_from_shape(shape):
+    """Batch-1 concrete zeros for a (None, ...) shape spec (init needs
+    concrete arrays, shapes come from the Estimator)."""
+    import jax.numpy as jnp
+    if isinstance(shape, list):
+        return [_zeros_from_shape(s) for s in shape]
+    if isinstance(shape, dict):
+        return {k: _zeros_from_shape(v) for k, v in shape.items()}
+    return jnp.zeros(tuple(1 if d is None else d for d in shape))
+
+
+class FunctionalModel:
+    """``init_fn(rng, sample_x) -> (params, state)``;
+    ``apply_fn(params, state, x, training, rng) -> (y, new_state)``."""
+
+    def __init__(self, init_fn: Callable, apply_fn: Callable,
+                 name: str = "functional_model"):
+        self.init_fn = init_fn
+        self.apply_fn = apply_fn
+        self.name = name
+
+    def build(self, rng, input_shape) -> Tuple[Any, Any]:
+        return self.init_fn(rng, _zeros_from_shape(input_shape))
+
+    def call(self, params, state, inputs, *, training: bool = False,
+             rng: Optional[jax.Array] = None):
+        return self.apply_fn(params, state, inputs, training, rng)
+
+
+def from_flax_module(module, method=None) -> FunctionalModel:
+    """Wrap a ``flax.linen.Module``. Mutable collections (e.g. batch_stats)
+    ride the Estimator's model_state."""
+
+    def init_fn(rng, sample_x):
+        variables = module.init(rng, sample_x)
+        params = variables.get("params", {})
+        state = {k: v for k, v in variables.items() if k != "params"}
+        return params, state
+
+    def apply_fn(params, state, x, training, rng):
+        variables = {"params": params, **state}
+        mutable = list(state.keys()) if training and state else False
+        kwargs = {}
+        if rng is not None:
+            kwargs["rngs"] = {"dropout": rng}
+        out = module.apply(variables, x, mutable=mutable, method=method,
+                           **kwargs)
+        if mutable:
+            y, new_state = out
+            return y, dict(new_state)
+        return out, state
+
+    return FunctionalModel(init_fn, apply_fn, name=type(module).__name__)
+
+
+def from_haiku_transformed(transformed) -> FunctionalModel:
+    """Wrap a ``haiku.transform``/``transform_with_state`` result."""
+    import haiku as hk
+    with_state = isinstance(transformed, hk.TransformedWithState)
+
+    def init_fn(rng, sample_x):
+        out = transformed.init(rng, sample_x)
+        if with_state:
+            return out  # (params, state)
+        return out, {}
+
+    def apply_fn(params, state, x, training, rng):
+        if with_state:
+            return transformed.apply(params, state, rng, x)
+        return transformed.apply(params, rng, x), state
+
+    return FunctionalModel(init_fn, apply_fn, name="haiku_model")

@@ -1,0 +1,127 @@
+"""GraphModel — capture a user-defined training computation.
+
+Parity map (reference ``pyzoo/zoo/tfpark/tf_optimizer.py``):
+- ``from_loss`` ≙ ``TFOptimizer.from_loss:493`` — user supplies the whole
+  loss function; grads/optimizer/allreduce happen in the shared loop.
+- ``from_forward`` ≙ ``TFOptimizer.from_keras:578`` — forward fn + named
+  objective.
+- ``from_flax``/``from_haiku`` ≙ tfpark ``KerasModel.fit`` (model.py:88) —
+  framework-module capture.
+- a user-supplied optax transform ≙ ``from_train_op:455`` — the
+  ``TFTrainingHelperV2``/``ZooOptimizer`` contract (grads are averaged
+  across replicas, then the *user's* optimizer applies them) holds by
+  construction: XLA inserts the psum, the optax chain is the train op.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from ..estimator.estimator import Estimator
+from ..feature.featureset import FeatureSet
+from ..keras import objectives, optimizers as opt_mod
+from .fn_layer import FunctionalModel, from_flax_module, from_haiku_transformed
+
+
+class GraphModel:
+    """fit/evaluate/predict over a captured functional model."""
+
+    def __init__(self, estimator: Estimator):
+        self.estimator = estimator
+
+    # -- constructors ---------------------------------------------------------
+
+    @classmethod
+    def from_loss(cls, loss_fn: Callable, init_params_fn: Callable,
+                  optimizer="adam", metrics: Optional[Sequence] = None,
+                  forward_fn: Optional[Callable] = None) -> "GraphModel":
+        """``loss_fn(params, x, y) -> scalar``;
+        ``init_params_fn(rng, sample_x) -> params``. Supply ``forward_fn``
+        (``forward(params, x) -> y_pred``) to enable predict/metric
+        evaluation — the loss alone doesn't define predictions."""
+
+        def no_forward(p, s, x, training, rng):
+            raise NotImplementedError(
+                "GraphModel.from_loss captured only the loss; pass "
+                "forward_fn=... to enable predict()/metric evaluate()")
+
+        apply_fn = (no_forward if forward_fn is None else
+                    (lambda p, s, x, training, rng: (forward_fn(p, x), s)))
+        model = FunctionalModel(
+            init_fn=lambda rng, sx: (init_params_fn(rng, sx), {}),
+            apply_fn=apply_fn, name="loss_capture")
+
+        def direct(params, model_state, rng, x, y):
+            return loss_fn(params, x, y), model_state
+
+        est = Estimator(model=model, loss_fn=lambda y, yp: 0.0,
+                        optimizer=opt_mod.get(optimizer),
+                        metrics=metrics, direct_loss_fn=direct)
+        return cls(est)
+
+    @classmethod
+    def from_forward(cls, forward_fn: Callable, init_params_fn: Callable,
+                     loss="mse", optimizer="adam",
+                     metrics: Optional[Sequence] = None) -> "GraphModel":
+        """``forward_fn(params, x) -> y_pred`` + a named/callable objective."""
+        model = FunctionalModel(
+            init_fn=lambda rng, sx: (init_params_fn(rng, sx), {}),
+            apply_fn=lambda p, s, x, training, rng: (forward_fn(p, x), s),
+            name="forward_capture")
+        est = Estimator(model=model, loss_fn=objectives.get(loss),
+                        optimizer=opt_mod.get(optimizer), metrics=metrics)
+        return cls(est)
+
+    @classmethod
+    def from_flax(cls, module, loss="mse", optimizer="adam",
+                  metrics: Optional[Sequence] = None) -> "GraphModel":
+        est = Estimator(model=from_flax_module(module),
+                        loss_fn=objectives.get(loss),
+                        optimizer=opt_mod.get(optimizer), metrics=metrics)
+        return cls(est)
+
+    @classmethod
+    def from_haiku(cls, transformed, loss="mse", optimizer="adam",
+                   metrics: Optional[Sequence] = None) -> "GraphModel":
+        est = Estimator(model=from_haiku_transformed(transformed),
+                        loss_fn=objectives.get(loss),
+                        optimizer=opt_mod.get(optimizer), metrics=metrics)
+        return cls(est)
+
+    # -- the tfpark user surface ----------------------------------------------
+
+    def fit(self, x, y=None, batch_size: int = 32, epochs: int = 1,
+            validation_data=None, featureset: Optional[FeatureSet] = None,
+            **kwargs):
+        if featureset is None:
+            featureset = FeatureSet.from_ndarrays(x, y)
+        if validation_data is not None and not isinstance(validation_data,
+                                                          FeatureSet):
+            validation_data = FeatureSet.from_ndarrays(*validation_data)
+        return self.estimator.train(featureset, batch_size=batch_size,
+                                    epochs=epochs,
+                                    validation_set=validation_data, **kwargs)
+
+    def evaluate(self, x, y=None, batch_size: int = 32,
+                 featureset: Optional[FeatureSet] = None):
+        if featureset is None:
+            featureset = FeatureSet.from_ndarrays(x, y)
+        return self.estimator.evaluate(featureset, batch_size=batch_size)
+
+    def predict(self, x, batch_size: int = 32):
+        return self.estimator.predict(x, batch_size=batch_size)
+
+    def get_weights(self):
+        """≙ ``get_weights_to_python`` (tf_optimizer.py:90) — weights leave
+        the distributed loop as host numpy pytrees."""
+        return self.estimator.get_params()
+
+    def set_weights(self, params) -> None:
+        self.estimator.set_params(params)
+
+    def save_checkpoint(self, path: str) -> None:
+        self.estimator.save_checkpoint(path)
+
+    def load_checkpoint(self, path: str) -> None:
+        self.estimator.load_checkpoint(path)

@@ -50,9 +50,19 @@ class Estimator:
     def __init__(self, model, loss_fn: Callable, optimizer: Optimizer,
                  metrics: Optional[Sequence] = None,
                  mesh=None, param_sharding_rules: Optional[Sequence] = None,
+                 direct_loss_fn: Optional[Callable] = None,
+                 direct_eval_loss_fn: Optional[Callable] = None,
                  seed: int = 42):
+        """``direct_loss_fn(params, model_state, rng, x, y) -> (loss,
+        new_state)`` bypasses the model.call→loss_fn(y, y_pred) convention —
+        the capture-style API hook (≙ TFOptimizer.from_loss, where the user
+        hands over the whole loss graph instead of a model).
+        ``direct_eval_loss_fn`` is the eval-mode variant (no dropout etc.);
+        defaults to ``direct_loss_fn``."""
         self.model = model
         self.loss_fn = loss_fn
+        self.direct_loss_fn = direct_loss_fn
+        self.direct_eval_loss_fn = direct_eval_loss_fn or direct_loss_fn
         self.optimizer = optimizer
         self.metrics = [metrics_mod.get(m) for m in (metrics or [])]
         self.ctx = get_context()
@@ -69,6 +79,7 @@ class Estimator:
         self._train_step = None
         self._eval_step = None
         self._predict_step = None
+        self._direct_eval_step = None
         self._clip: Optional[Tuple[str, Any]] = None
         self._tb: Optional[Tuple[str, str]] = None
         self._ckpt_dir: Optional[str] = None
@@ -123,10 +134,13 @@ class Estimator:
 
     def _build_train_step(self):
         model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
+        direct = self.direct_loss_fn
         clip = self._clip_transform()
 
         def train_step(params, opt_state, model_state, rng, x, y):
             def compute_loss(p):
+                if direct is not None:
+                    return direct(p, model_state, rng, x, y)
                 y_pred, new_state = model.call(p, model_state, x,
                                                training=True, rng=rng)
                 return loss_fn(y, y_pred), new_state
@@ -313,6 +327,8 @@ class Estimator:
     # -- evaluate (Estimator.evaluate / InternalDistriOptimizer eval) ---------
 
     def evaluate(self, val_set: FeatureSet, batch_size: int) -> Dict[str, float]:
+        if self.direct_loss_fn is not None and not self.metrics:
+            return self._evaluate_direct(val_set, batch_size)
         if not self.metrics:
             self.metrics = [metrics_mod.Loss(self.loss_fn)]
         local_batch = min(self.ctx.local_batch(batch_size), val_set.size)
@@ -330,6 +346,35 @@ class Estimator:
             metric_states = self._eval_step(self.params, self.model_state,
                                             metric_states, *batch)
         return {m.name: m.compute(s) for m, s in zip(self.metrics, metric_states)}
+
+    def _evaluate_direct(self, val_set: FeatureSet, batch_size: int
+                         ) -> Dict[str, float]:
+        """Average captured loss over full batches (direct-loss capture mode:
+        the loss fn sees the raw batch, so padding can't be masked — the tail
+        remainder is dropped)."""
+        local_batch = min(self.ctx.local_batch(batch_size), val_set.size)
+        ndev = self.mesh.devices.size
+        local_batch = max(ndev, (local_batch // ndev) * ndev)
+        sample = next(val_set.eval_iterator(local_batch, pad_remainder=True))
+        self._ensure_initialized(sample[0])
+        if self._direct_eval_step is None:
+            direct = self.direct_eval_loss_fn
+            self._direct_eval_step = jax.jit(
+                lambda p, s, rng, x, y: direct(p, s, rng, x, y)[0])
+        eval_rng = jax.random.PRNGKey(0)
+        losses = []  # partial tail batches are dropped (loss can't mask pad)
+        for x, y, valid in val_set.eval_iterator(local_batch,
+                                                 pad_remainder=True):
+            if valid < local_batch:
+                continue
+            bx, by = shard_batch(self.mesh, (x, y))
+            losses.append(float(self._direct_eval_step(
+                self.params, self.model_state, eval_rng, bx, by)))
+        if not losses:
+            raise ValueError(
+                f"validation set smaller than one batch ({val_set.size} < "
+                f"{local_batch}); reduce batch_size")
+        return {"loss": float(np.mean(losses))}
 
     # -- predict (TFNet/Predictable equivalent) -------------------------------
 

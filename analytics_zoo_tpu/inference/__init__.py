@@ -1,0 +1,4 @@
+"""Inference engine (reference ``pipeline/inference/InferenceModel.scala:30``
++ ``net/TFNet.scala``): pooled, multi-format, quantizable model serving."""
+from .inference_model import InferenceModel  # noqa: F401
+from .quantize import dequantize_params, quantize_params  # noqa: F401

@@ -1,0 +1,57 @@
+"""Post-training quantization (the reference's OpenVINO int8/VNNI path,
+``OpenVinoInferenceSupportive.scala`` + ``examples/vnni/*`` — SURVEY §2.3
+maps it to "int8/bf16 quantized inference via XLA").
+
+- bf16: cast weight pytrees; TPU MXUs consume bf16 natively, halving HBM
+  traffic with ~no accuracy loss.
+- int8: symmetric per-tensor weight quantization with fp32 scales; weights
+  are stored int8 (4x smaller) and dequantized on the fly — XLA fuses the
+  ``int8 -> f32 mul`` into the consumer matmul's operand load."""
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def quantize_params(params: Any, dtype: str = "bf16") -> Any:
+    """Quantize a parameter pytree. int8 leaves become
+    ``{"q": int8, "scale": f32}`` dicts; bf16 leaves are plain casts."""
+    if dtype in ("bf16", "bfloat16"):
+        return jax.tree_util.tree_map(
+            lambda t: t.astype(jnp.bfloat16)
+            if jnp.issubdtype(jnp.asarray(t).dtype, jnp.floating) else t,
+            params)
+    if dtype != "int8":
+        raise ValueError(f"unsupported quantization dtype {dtype}")
+
+    def q(t):
+        t = jnp.asarray(t)
+        if not jnp.issubdtype(t.dtype, jnp.floating) or t.ndim < 2:
+            return t  # biases/scalars stay fp32 (negligible size)
+        scale = jnp.maximum(jnp.max(jnp.abs(t)), 1e-8) / 127.0
+        return {"q": jnp.clip(jnp.round(t / scale), -127, 127
+                              ).astype(jnp.int8),
+                "scale": scale.astype(jnp.float32)}
+
+    return jax.tree_util.tree_map(q, params)
+
+
+def _is_qleaf(x) -> bool:
+    return isinstance(x, dict) and set(x.keys()) == {"q", "scale"}
+
+
+def dequantize_params(params: Any, dtype=jnp.float32) -> Any:
+    """Inverse of int8 quantization (bf16 casts just upcast)."""
+
+    def dq(t):
+        if _is_qleaf(t):
+            return (t["q"].astype(dtype) * t["scale"]).astype(dtype)
+        t = jnp.asarray(t)
+        if jnp.issubdtype(t.dtype, jnp.floating):
+            return t.astype(dtype)
+        return t
+
+    return jax.tree_util.tree_map(dq, params, is_leaf=_is_qleaf)

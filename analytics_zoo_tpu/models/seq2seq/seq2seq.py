@@ -183,11 +183,18 @@ class Seq2seq(ZooModel):
             jnp.asarray(start_sign, jnp.float32)[None, None, :],
             (b, 1, len(start_sign)))
         outs = []
+        done = np.zeros(b, bool)  # per-sequence stop tracking
+        stop = (np.asarray(stop_sign, np.float32)
+                if stop_sign is not None else None)
         for _ in range(max_seq_len):
             y_next, states = step_fn(params, y_t, states)
-            outs.append(np.asarray(y_next))
-            if stop_sign is not None and np.allclose(
-                    outs[-1], np.asarray(stop_sign)[None, :], atol=1e-4):
+            step_out = np.asarray(y_next)
+            if stop is not None:
+                # finished sequences keep emitting the stop sign
+                step_out[done] = stop
+                done |= np.all(np.abs(step_out - stop[None, :]) < 1e-4, axis=1)
+            outs.append(step_out)
+            if stop is not None and done.all():
                 break
-            y_t = y_next[:, None, :]
+            y_t = jnp.asarray(step_out)[:, None, :]
         return np.stack(outs, axis=1)

@@ -36,9 +36,11 @@ def shard_batch(mesh: Mesh, batch: Any) -> Any:
     ``data``. This is the host→device edge of the input pipeline (the
     reference's FeatureSet-iterator → model-replica feed)."""
     def put(x):
+        if x is None:  # unlabeled datasets yield (x, None)
+            return None
         arr = np.asarray(x)
         return jax.device_put(arr, data_sharding(mesh, arr.ndim))
-    return jax.tree_util.tree_map(put, batch)
+    return jax.tree_util.tree_map(put, batch, is_leaf=lambda x: x is None)
 
 
 def param_sharding(mesh: Mesh, params: Any,

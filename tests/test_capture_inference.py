@@ -1,0 +1,293 @@
+"""Capture-style API (TFPark equivalent) + inference engine tests."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+RNG = jax.random.PRNGKey(0)
+
+
+def linreg_data(n=64, d=4, noise=0.0, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(n, d).astype(np.float32)
+    w = np.arange(1, d + 1, dtype=np.float32)
+    y = (x @ w + noise * rs.randn(n)).astype(np.float32)[:, None]
+    return x, y
+
+
+class TestGraphModel:
+    def test_from_loss(self, ctx):
+        from analytics_zoo_tpu.capture import GraphModel
+        x, y = linreg_data()
+
+        def init_params(rng, sample_x):
+            return {"w": jnp.zeros((sample_x.shape[-1], 1)),
+                    "b": jnp.zeros((1,))}
+
+        def loss_fn(params, bx, by):
+            pred = bx @ params["w"] + params["b"]
+            return jnp.mean((pred - by) ** 2)
+
+        gm = GraphModel.from_loss(loss_fn, init_params, optimizer="adam")
+        hist = gm.fit(x, y, batch_size=16, epochs=30)
+        assert hist["loss_history"][-1] < hist["loss_history"][0]
+        res = gm.evaluate(x, y, batch_size=16)
+        assert "loss" in res
+        w = gm.get_weights()["w"]
+        assert w.shape == (4, 1)
+
+    def test_from_forward(self, ctx):
+        from analytics_zoo_tpu.capture import GraphModel
+        x, y = linreg_data()
+
+        def init_params(rng, sample_x):
+            return {"w": jax.random.normal(rng, (sample_x.shape[-1], 1)) * 0.1}
+
+        def forward(params, bx):
+            return bx @ params["w"]
+
+        gm = GraphModel.from_forward(forward, init_params, loss="mse",
+                                     optimizer="sgd")
+        hist = gm.fit(x, y, batch_size=16, epochs=20)
+        assert hist["loss_history"][-1] < hist["loss_history"][0]
+        preds = gm.predict(x, batch_size=16)
+        assert preds.shape == (64, 1)
+
+    def test_from_flax(self, ctx):
+        import flax.linen as nn
+        from analytics_zoo_tpu.capture import GraphModel
+
+        class MLP(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.Dense(8)(x)
+                x = nn.relu(x)
+                return nn.Dense(1)(x)
+
+        x, y = linreg_data()
+        gm = GraphModel.from_flax(MLP(), loss="mse", optimizer="adam")
+        hist = gm.fit(x, y, batch_size=16, epochs=10)
+        assert hist["loss_history"][-1] < hist["loss_history"][0]
+        assert gm.predict(x, batch_size=16).shape == (64, 1)
+
+    def test_checkpoint_roundtrip(self, ctx, tmp_path):
+        from analytics_zoo_tpu.capture import GraphModel
+        x, y = linreg_data()
+
+        def init_params(rng, sx):
+            return {"w": jnp.zeros((sx.shape[-1], 1))}
+
+        gm = GraphModel.from_forward(lambda p, bx: bx @ p["w"], init_params)
+        gm.fit(x, y, batch_size=16, epochs=5)
+        p1 = gm.predict(x, batch_size=16)
+        gm.save_checkpoint(str(tmp_path / "ckpt"))
+        gm2 = GraphModel.from_forward(lambda p, bx: bx @ p["w"], init_params)
+        gm2.fit(x, y, batch_size=16, epochs=1)  # init shapes
+        gm2.load_checkpoint(str(tmp_path / "ckpt"))
+        np.testing.assert_allclose(gm2.predict(x, batch_size=16), p1,
+                                   atol=1e-5)
+
+
+class TestFnEstimator:
+    def test_modes(self, ctx):
+        from analytics_zoo_tpu.capture import FnEstimator, ModeKeys
+        x, y = linreg_data()
+
+        def init_fn(rng, sx):
+            return {"w": jnp.zeros((sx.shape[-1], 1))}
+
+        def model_fn(params, features, labels, mode, rng):
+            pred = features @ params["w"]
+            if mode == ModeKeys.PREDICT:
+                return pred
+            return jnp.mean((pred - labels) ** 2)
+
+        est = FnEstimator(model_fn, init_fn, optimizer="adam")
+        h = est.train(lambda mode: (x, y), batch_size=16, epochs=20)
+        assert h["loss_history"][-1] < h["loss_history"][0]
+        res = est.evaluate(lambda mode: (x, y), batch_size=16)
+        assert res["loss"] < h["loss_history"][0]
+        preds = est.predict(lambda mode: x, batch_size=16)
+        assert preds.shape == (64, 1)
+
+
+class TestGAN:
+    def test_gan_trains(self, ctx):
+        from analytics_zoo_tpu.capture import GANEstimator
+        rs = np.random.RandomState(0)
+        real = (rs.randn(256, 2) * 0.3 + np.array([2.0, -1.0])).astype(
+            np.float32)
+
+        def gen_init(rng, noise):
+            k1, k2 = jax.random.split(rng)
+            return {"w1": jax.random.normal(k1, (noise.shape[-1], 16)) * 0.1,
+                    "b1": jnp.zeros((16,)),
+                    "w2": jax.random.normal(k2, (16, 2)) * 0.1,
+                    "b2": jnp.zeros((2,))}
+
+        def gen_fn(p, z):
+            h = jax.nn.relu(z @ p["w1"] + p["b1"])
+            return h @ p["w2"] + p["b2"]
+
+        def disc_init(rng, x):
+            k1, k2 = jax.random.split(rng)
+            return {"w1": jax.random.normal(k1, (x.shape[-1], 16)) * 0.1,
+                    "b1": jnp.zeros((16,)),
+                    "w2": jax.random.normal(k2, (16, 1)) * 0.1}
+
+        def disc_fn(p, x):
+            h = jax.nn.relu(x @ p["w1"] + p["b1"])
+            return h @ p["w2"]
+
+        def g_loss(fake_logits):
+            return jnp.mean(jax.nn.softplus(-fake_logits))
+
+        def d_loss(real_logits, fake_logits):
+            return jnp.mean(jax.nn.softplus(-real_logits)) + \
+                jnp.mean(jax.nn.softplus(fake_logits))
+
+        from analytics_zoo_tpu.keras import optimizers
+        gan = GANEstimator(gen_fn, disc_fn, g_loss, d_loss, gen_init,
+                           disc_init,
+                           generator_optimizer=optimizers.Adam(1e-2),
+                           discriminator_optimizer=optimizers.Adam(1e-2),
+                           noise_dim=4, d_steps=1, g_steps=2)
+        hist = gan.train(real, batch_size=64, steps=150)
+        assert hist["iterations"] == 150
+        samples = gan.generate(128)
+        assert samples.shape == (128, 2)
+        # generator should move toward the real mode at (2, -1) from ~N(0, .1)
+        assert samples.mean(0)[0] > 0.8 and samples.mean(0)[1] < -0.3
+
+
+class TestBERTEstimators:
+    def test_bert_classifier(self, ctx):
+        from analytics_zoo_tpu.capture import BERTClassifier
+        rs = np.random.RandomState(1)
+        tokens = rs.randint(1, 50, (16, 10))
+        labels = rs.randint(0, 2, 16)
+        clf = BERTClassifier(2, bert_config=dict(
+            vocab=50, hidden_size=16, n_block=1, n_head=2,
+            max_position_len=10, intermediate_size=32))
+        h = clf.fit(tokens, labels, batch_size=8, epochs=1)
+        assert h["iterations"] >= 1
+        p = clf.predict(tokens, batch_size=8)
+        assert p.shape == (16, 2)
+
+    def test_bert_ner(self, ctx):
+        from analytics_zoo_tpu.capture import BERTNER
+        rs = np.random.RandomState(2)
+        tokens = rs.randint(1, 40, (8, 6))
+        tags = rs.randint(0, 3, (8, 6))
+        ner = BERTNER(3, bert_config=dict(
+            vocab=40, hidden_size=16, n_block=1, n_head=2,
+            max_position_len=6, intermediate_size=32))
+        ner.fit(tokens, tags, batch_size=8, epochs=1)
+        p = ner.predict(tokens, batch_size=8)
+        assert p.shape == (8, 6, 3)
+
+    def test_bert_squad(self, ctx):
+        from analytics_zoo_tpu.capture import BERTSQuAD
+        rs = np.random.RandomState(3)
+        tokens = rs.randint(1, 40, (8, 6))
+        spans = np.stack([rs.randint(0, 6, 8), rs.randint(0, 6, 8)], 1)
+        qa = BERTSQuAD(bert_config=dict(
+            vocab=40, hidden_size=16, n_block=1, n_head=2,
+            max_position_len=6, intermediate_size=32))
+        qa.fit(tokens, spans, batch_size=8, epochs=1)
+        start, end = qa.predict(tokens, batch_size=8)
+        assert start.shape == (8, 6) and end.shape == (8, 6)
+
+
+class TestInferenceModel:
+    def _simple_forward(self):
+        def forward(params, x):
+            return x @ params["w"] + params["b"]
+        params = {"w": jnp.asarray(np.eye(3, 2, dtype=np.float32)),
+                  "b": jnp.ones((2,))}
+        return forward, params
+
+    def test_load_jax_and_bucketing(self, ctx):
+        from analytics_zoo_tpu.inference import InferenceModel
+        fwd, params = self._simple_forward()
+        im = InferenceModel(concurrent_num=2).load_jax(fwd, params)
+        x = np.random.rand(5, 3).astype(np.float32)  # pads to bucket 8
+        y = im.predict(x)
+        assert y.shape == (5, 2)
+        np.testing.assert_allclose(y, x @ np.eye(3, 2) + 1, atol=1e-5)
+        assert len(im._jitted) == 1
+        y2 = im.predict(np.random.rand(7, 3).astype(np.float32))
+        assert y2.shape == (7, 2)
+        assert len(im._jitted) == 1  # same bucket reused
+
+    def test_pool_concurrency(self, ctx):
+        from analytics_zoo_tpu.inference import InferenceModel
+        fwd, params = self._simple_forward()
+        im = InferenceModel(concurrent_num=4).load_jax(fwd, params)
+        batches = [np.random.rand(4, 3).astype(np.float32) for _ in range(8)]
+        outs = im.predict_many(batches)
+        assert len(outs) == 8 and all(o.shape == (4, 2) for o in outs)
+
+    def test_quantize_bf16_int8(self, ctx):
+        from analytics_zoo_tpu.inference import InferenceModel
+        rs = np.random.RandomState(0)
+        w = rs.randn(8, 4).astype(np.float32)
+
+        def forward(params, x):
+            return x @ params["w"]
+
+        x = rs.rand(4, 8).astype(np.float32)
+        ref = x @ w
+        for dtype, tol in (("bf16", 0.1), ("int8", 0.2)):
+            im = InferenceModel().load_jax(forward, {"w": jnp.asarray(w)})
+            im.quantize(dtype)
+            y = im.predict(x)
+            np.testing.assert_allclose(y, ref, atol=tol)
+
+    def test_load_zoo_model(self, ctx, tmp_path):
+        from analytics_zoo_tpu.inference import InferenceModel
+        from analytics_zoo_tpu.models import NeuralCF
+        ncf = NeuralCF(10, 8, 2, user_embed=4, item_embed=4,
+                       hidden_layers=[8], mf_embed=4)
+        ncf.default_compile()
+        rs = np.random.RandomState(0)
+        x = np.stack([rs.randint(1, 11, 16), rs.randint(1, 9, 16)],
+                     1).astype(np.float32)
+        y = rs.randint(0, 2, 16).astype(np.float32)
+        ncf.fit(x, y, batch_size=8, nb_epoch=1)
+        path = str(tmp_path / "ncf")
+        ncf.save_model(path)
+        im = InferenceModel().load_zoo(path)
+        p = im.predict(x)
+        np.testing.assert_allclose(
+            p, np.asarray(ncf.predict(x, batch_size=16)), atol=1e-5)
+
+    def test_load_savedmodel(self, ctx, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        from analytics_zoo_tpu.inference import InferenceModel
+
+        class M(tf.Module):
+            @tf.function(input_signature=[
+                tf.TensorSpec([None, 3], tf.float32)])
+            def __call__(self, x):
+                return {"out": 2.0 * x}
+
+        path = str(tmp_path / "sm")
+        tf.saved_model.save(M(), path)
+        im = InferenceModel().load_savedmodel(path)
+        x = np.random.rand(4, 3).astype(np.float32)
+        np.testing.assert_allclose(im.predict(x), 2 * x, atol=1e-5)
+
+    def test_load_torch(self, ctx, tmp_path):
+        torch = pytest.importorskip("torch")
+        from analytics_zoo_tpu.inference import InferenceModel
+
+        class Net(torch.nn.Module):
+            def forward(self, x):
+                return x * 3.0
+
+        path = str(tmp_path / "net.pt")
+        torch.jit.script(Net()).save(path)
+        im = InferenceModel().load_torch(path)
+        x = np.random.rand(4, 3).astype(np.float32)
+        np.testing.assert_allclose(im.predict(x), 3 * x, atol=1e-5)
