@@ -49,6 +49,10 @@ class FnEstimator:
         data = input_fn(mode)
         if isinstance(data, FeatureSet):
             return data
+        if mode == ModeKeys.PREDICT:
+            # predictions must cover every row on every host — no sharding
+            return FeatureSet.from_ndarrays(data, None, shuffle=False,
+                                            shard=False)
         if isinstance(data, tuple) and len(data) == 2:
             return FeatureSet.from_ndarrays(*data)
         return FeatureSet.from_ndarrays(data, None, shuffle=False)

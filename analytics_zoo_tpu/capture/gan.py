@@ -116,7 +116,7 @@ class GANEstimator:
         local_batch = self.ctx.local_batch(batch_size)
         it = fs.train_iterator(local_batch)
         feed = DeviceFeed(it, self.mesh)
-        d_hist, g_hist = [], []
+        pending = []  # device loss scalars; drained once — async dispatch
         for _ in range(steps):
             real, _ = next(feed)
             self._ensure_initialized(real)
@@ -128,8 +128,10 @@ class GANEstimator:
                                      self.g_opt_state, self.d_opt_state,
                                      step_rng, real)
             self.global_step += 1
-            d_hist.append(float(dl))
-            g_hist.append(float(gl))
+            pending.append((dl, gl))
+        drained = jax.device_get(pending)
+        d_hist = [float(d) for d, _ in drained]
+        g_hist = [float(g) for _, g in drained]
         return {"d_loss_history": d_hist, "g_loss_history": g_hist,
                 "iterations": self.global_step}
 

@@ -1,0 +1,6 @@
+from .image_set import DistributedImageSet, ImageSet, LocalImageSet  # noqa: F401
+from .transforms import (  # noqa: F401
+    AspectScale, Brightness, CenterCrop, ChannelNormalize, ChannelOrder,
+    ColorJitter, Contrast, Expand, FixedCrop, Hue, ImageSetToSample,
+    MatToFloats, PixelBytesToMat, RandomCrop, RandomPreprocessing,
+    RandomTransformer, Resize, Saturation, HFlip)

@@ -1,0 +1,105 @@
+"""ImageSet (reference ``feature/image/ImageSet.scala:140``:
+``LocalImageSet``/``DistributedImageSet`` collections + ``read`` factory +
+``transform`` chaining + ``toDataSet``).
+
+TPU-host shape: a LocalImageSet holds host images (list of HWC arrays,
+possibly ragged before resize); a DistributedImageSet additionally records a
+shard count for multi-host splits (per-host sharding happens in the
+FeatureSet it lowers into). ``to_featureset`` is the ``ImageSetToSample →
+FeatureSet`` lowering that feeds the device."""
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from ..featureset import FeatureSet
+from ..preprocessing import Preprocessing
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+class ImageSet:
+    def __init__(self, images: List[np.ndarray],
+                 labels: Optional[np.ndarray] = None,
+                 paths: Optional[List[str]] = None):
+        self.images = list(images)
+        self.labels = None if labels is None else np.asarray(labels)
+        self.paths = paths
+
+    # -- factories (reference ImageSet.read) ----------------------------------
+
+    @staticmethod
+    def read(path: str, with_label: bool = False,
+             one_based_label: bool = True) -> "LocalImageSet":
+        """Read images from ``path`` (a dir of images, or with ``with_label``
+        a dir of class-named subdirs, labels alphabetical)."""
+        import cv2
+        images, labels, paths = [], [], []
+        if with_label:
+            classes = sorted(d for d in os.listdir(path)
+                             if os.path.isdir(os.path.join(path, d)))
+            base = 1 if one_based_label else 0
+            for ci, cls in enumerate(classes):
+                for f in sorted(glob.glob(os.path.join(path, cls, "*"))):
+                    if not f.lower().endswith(_IMG_EXTS):
+                        continue
+                    img = cv2.imread(f)
+                    if img is None:
+                        continue
+                    images.append(img)
+                    labels.append(ci + base)
+                    paths.append(f)
+            return LocalImageSet(images, np.asarray(labels, np.float32), paths)
+        for f in sorted(glob.glob(os.path.join(path, "*"))):
+            if not f.lower().endswith(_IMG_EXTS):
+                continue
+            img = cv2.imread(f)
+            if img is not None:
+                images.append(img)
+                paths.append(f)
+        return LocalImageSet(images, None, paths)
+
+    @staticmethod
+    def from_arrays(images: Sequence[np.ndarray],
+                    labels: Optional[np.ndarray] = None) -> "LocalImageSet":
+        return LocalImageSet(list(images), labels)
+
+    # -- transform chaining ---------------------------------------------------
+
+    def transform(self, preprocessing: Preprocessing) -> "ImageSet":
+        out = [preprocessing.apply(img) for img in self.images]
+        return type(self)(out, self.labels, self.paths)
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    # -- lowering to the device feed ------------------------------------------
+
+    def to_featureset(self, **kwargs) -> FeatureSet:
+        shapes = {np.asarray(i).shape for i in self.images}
+        if len(shapes) > 1:
+            raise ValueError(
+                f"images have mixed shapes {shapes}; apply Resize/Crop "
+                "transforms before to_featureset (XLA needs static shapes)")
+        feats = np.stack([np.asarray(i, np.float32) for i in self.images])
+        return FeatureSet.from_ndarrays(feats, self.labels, **kwargs)
+
+
+class LocalImageSet(ImageSet):
+    """Single-host image collection (reference ``LocalImageSet:98``)."""
+
+
+class DistributedImageSet(ImageSet):
+    """Sharded image collection (reference ``DistributedImageSet:119``) —
+    per-host sharding is applied by the FeatureSet it lowers into."""
+
+    def __init__(self, images, labels=None, paths=None, num_shards: int = 1):
+        super().__init__(images, labels, paths)
+        self.num_shards = num_shards
+
+    def to_featureset(self, **kwargs) -> FeatureSet:
+        kwargs.setdefault("shard", True)
+        return super().to_featureset(**kwargs)

@@ -1,0 +1,3 @@
+from .text_set import (  # noqa: F401
+    DistributedTextSet, LocalTextSet, Relation, TextFeature, TextSet,
+    read_relations)

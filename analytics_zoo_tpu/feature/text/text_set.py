@@ -1,0 +1,208 @@
+"""TextSet / TextFeature pipeline (reference ``feature/text/*.scala``:
+``TextSet:247``, ``Tokenizer``, ``Normalizer``, ``WordIndexer``,
+``SequenceShaper``, ``TextFeatureToSample``; Q&A ``Relations`` in
+``feature/common/Relations.scala``).
+
+Host-side text prep: tokenize → normalize → word-index → shape → arrays; the
+resulting fixed-length index matrices lower into a FeatureSet for the device
+feed. The word index is built once (frequency-ranked, ``remove_topN`` /
+``max_words_num`` contract) and persists as JSON."""
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..featureset import FeatureSet
+
+
+@dataclass
+class Relation:
+    """Q&A relation (reference ``Relation``): id1 relates to id2 w/ label."""
+    id1: str
+    id2: str
+    label: int
+
+
+def read_relations(path: str) -> List[Relation]:
+    """CSV ``id1,id2,label`` (with or without header)."""
+    rels = []
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split(",")
+            if len(parts) != 3 or parts[2].lower() == "label":
+                continue
+            rels.append(Relation(parts[0], parts[1], int(parts[2])))
+    return rels
+
+
+class TextFeature:
+    """One text record flowing through the pipeline (reference
+    ``TextFeature.scala``)."""
+
+    def __init__(self, text: str, label: Optional[int] = None,
+                 uri: Optional[str] = None):
+        self.text = text
+        self.label = label
+        self.uri = uri
+        self.tokens: Optional[List[str]] = None
+        self.indices: Optional[np.ndarray] = None
+
+    def get_sample(self) -> Tuple[np.ndarray, Optional[float]]:
+        if self.indices is None:
+            raise ValueError("run word2idx/shape_sequence first")
+        return (np.asarray(self.indices, np.float32),
+                None if self.label is None else float(self.label))
+
+
+class TextSet:
+    def __init__(self, features: List[TextFeature],
+                 word_index: Optional[Dict[str, int]] = None):
+        self.features = features
+        self.word_index = word_index
+
+    # -- factories ------------------------------------------------------------
+
+    @staticmethod
+    def from_texts(texts: Sequence[str],
+                   labels: Optional[Sequence[int]] = None) -> "LocalTextSet":
+        feats = [TextFeature(t, None if labels is None else int(labels[i]))
+                 for i, t in enumerate(texts)]
+        return LocalTextSet(feats)
+
+    @staticmethod
+    def read(path: str, one_based_label: bool = False) -> "LocalTextSet":
+        """Read a dir of class-named subdirs of .txt files (reference
+        ``TextSet.read``); labels follow alphabetical class order."""
+        feats = []
+        classes = sorted(d for d in os.listdir(path)
+                         if os.path.isdir(os.path.join(path, d)))
+        base = 1 if one_based_label else 0
+        for ci, cls in enumerate(classes):
+            cdir = os.path.join(path, cls)
+            for fname in sorted(os.listdir(cdir)):
+                fpath = os.path.join(cdir, fname)
+                if not os.path.isfile(fpath):
+                    continue
+                with open(fpath, errors="ignore") as f:
+                    feats.append(TextFeature(f.read(), ci + base, uri=fpath))
+        return LocalTextSet(feats)
+
+    @staticmethod
+    def from_relation_pairs(relations: Sequence[Relation],
+                            corpus1: Dict[str, str],
+                            corpus2: Dict[str, str]) -> "LocalTextSet":
+        """Build (text1 ++ text2, label) records for pairwise ranking
+        (reference ``TextSet.fromRelationPairs`` feeding KNRM). The two
+        texts are kept separated by '\\n' so lengths can be shaped
+        independently downstream via ``shape_sequence`` on the concatenated
+        index array."""
+        feats = []
+        for r in relations:
+            tf = TextFeature(corpus1[r.id1] + "\n" + corpus2[r.id2], r.label,
+                             uri=f"{r.id1}:{r.id2}")
+            feats.append(tf)
+        return LocalTextSet(feats)
+
+    # -- pipeline ops (each returns self-type with updated features) ----------
+
+    def tokenize(self) -> "TextSet":
+        for f in self.features:
+            f.tokens = re.findall(r"[\w']+", f.text)
+        return self
+
+    def normalize(self) -> "TextSet":
+        for f in self.features:
+            if f.tokens is None:
+                raise ValueError("tokenize first")
+            f.tokens = [t.lower() for t in f.tokens if t.strip()]
+        return self
+
+    def word2idx(self, remove_top_n: int = 0,
+                 max_words_num: int = -1,
+                 existing_map: Optional[Dict[str, int]] = None) -> "TextSet":
+        """Build (or reuse) the frequency-ranked word index and map tokens.
+        Index 0 is reserved for padding/unknown (reference starts at 1)."""
+        if existing_map is not None:
+            self.word_index = dict(existing_map)
+        if self.word_index is None:
+            counts: Dict[str, int] = {}
+            for f in self.features:
+                for t in (f.tokens or []):
+                    counts[t] = counts.get(t, 0) + 1
+            ranked = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+            ranked = ranked[remove_top_n:]
+            if max_words_num > 0:
+                ranked = ranked[:max_words_num]
+            self.word_index = {w: i + 1 for i, (w, _) in enumerate(ranked)}
+        wi = self.word_index
+        for f in self.features:
+            f.indices = np.asarray(
+                [wi.get(t, 0) for t in (f.tokens or [])], np.int64)
+        return self
+
+    def shape_sequence(self, length: int, trunc_mode: str = "pre",
+                       pad_element: int = 0) -> "TextSet":
+        """Pad/truncate index arrays to a fixed length (reference
+        ``SequenceShaper``: trunc_mode pre|post)."""
+        for f in self.features:
+            idx = f.indices
+            if idx is None:
+                raise ValueError("word2idx first")
+            if len(idx) > length:
+                idx = idx[-length:] if trunc_mode == "pre" else idx[:length]
+            elif len(idx) < length:
+                idx = np.concatenate(
+                    [idx, np.full(length - len(idx), pad_element, idx.dtype)])
+            f.indices = idx
+        return self
+
+    def generate_sample(self) -> "TextSet":
+        return self  # samples materialize in to_featureset
+
+    # -- word index persistence ----------------------------------------------
+
+    def get_word_index(self) -> Optional[Dict[str, int]]:
+        return self.word_index
+
+    def save_word_index(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.word_index, f)
+
+    def load_word_index(self, path: str) -> "TextSet":
+        with open(path) as f:
+            self.word_index = json.load(f)
+        return self
+
+    # -- lowering -------------------------------------------------------------
+
+    def to_featureset(self, **kwargs) -> FeatureSet:
+        xs, ys = [], []
+        for f in self.features:
+            x, y = f.get_sample()
+            xs.append(x)
+            ys.append(y)
+        feats = np.stack(xs)
+        labels = (None if any(y is None for y in ys)
+                  else np.asarray(ys, np.float32))
+        return FeatureSet.from_ndarrays(feats, labels, **kwargs)
+
+    def __len__(self) -> int:
+        return len(self.features)
+
+
+class LocalTextSet(TextSet):
+    """Single-host text collection (reference ``LocalTextSet:630``)."""
+
+
+class DistributedTextSet(TextSet):
+    """Sharded text collection (reference ``DistributedTextSet:712``);
+    per-host sharding applies in the lowered FeatureSet."""
+
+    def to_featureset(self, **kwargs) -> FeatureSet:
+        kwargs.setdefault("shard", True)
+        return super().to_featureset(**kwargs)

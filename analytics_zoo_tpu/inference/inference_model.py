@@ -44,9 +44,8 @@ class InferenceModel:
         self._slots = threading.Semaphore(concurrent_num)
         self._forward: Optional[Callable] = None  # forward(params, x)
         self._params: Any = None
-        self._jitted: Dict[Any, Callable] = {}  # AOT cache per bucket key
+        self._jit: Optional[Callable] = None  # jit caches per shape itself
         self._host_predict: Optional[Callable] = None  # non-XLA backends
-        self._lock = threading.Lock()
 
     # -- loaders (doLoad* family) ---------------------------------------------
 
@@ -158,25 +157,14 @@ class InferenceModel:
                     lambda t: t.astype(jnp.float32), y)
             self._forward = forward
         self._params = qparams
-        self._jitted.clear()
+        self._jit = None
         return self
 
     # -- predict (doPredict) --------------------------------------------------
 
-    def _compiled_for(self, x) -> Callable:
-        xs = x if isinstance(x, (list, tuple)) else [x]
-        key = tuple((a.shape, str(a.dtype)) for a in xs)
-        fn = self._jitted.get(key)
-        if fn is None:
-            with self._lock:
-                fn = self._jitted.get(key)
-                if fn is None:
-                    fn = jax.jit(self._forward)
-                    self._jitted[key] = fn
-        return fn
-
     def predict(self, x, batch_size: Optional[int] = None):
-        """Borrow a pool slot, pad to the shape bucket, run, trim."""
+        """Borrow a pool slot, pad to the shape bucket, run, trim.
+        ``batch_size`` splits oversized inputs into chunks (each bucketed)."""
         if self._host_predict is not None:
             with self._slots:
                 return self._host_predict(x)
@@ -185,15 +173,31 @@ class InferenceModel:
         xs = x if isinstance(x, (list, tuple)) else [x]
         xs = [np.asarray(a) for a in xs]
         n = xs[0].shape[0]
+        if batch_size is not None and n > batch_size:
+            chunks = [self.predict(
+                [a[i:i + batch_size] for a in xs] if isinstance(
+                    x, (list, tuple)) else xs[0][i:i + batch_size])
+                for i in range(0, n, batch_size)]
+            if isinstance(chunks[0], (list, tuple)):
+                return type(chunks[0])(
+                    np.concatenate([c[i] for c in chunks])
+                    for i in range(len(chunks[0])))
+            if isinstance(chunks[0], dict):
+                return {k: np.concatenate([c[k] for c in chunks])
+                        for k in chunks[0]}
+            return np.concatenate(chunks)
         bucket = _bucket(n)
         if bucket != n:
             xs = [np.concatenate(
                 [a, np.repeat(a[-1:], bucket - n, axis=0)]) for a in xs]
         arg = xs if isinstance(x, (list, tuple)) else xs[0]
         with self._slots:
-            fn = self._compiled_for(arg)
-            y = fn(self._params, arg)
+            if self._jit is None:
+                self._jit = jax.jit(self._forward)
+            y = self._jit(self._params, arg)
         trim = lambda t: np.asarray(t)[:n]
+        if isinstance(y, dict):
+            return {k: trim(v) for k, v in y.items()}
         if isinstance(y, (list, tuple)):
             return type(y)(trim(t) for t in y)
         return trim(y)

@@ -188,7 +188,7 @@ class Seq2seq(ZooModel):
                 if stop_sign is not None else None)
         for _ in range(max_seq_len):
             y_next, states = step_fn(params, y_t, states)
-            step_out = np.asarray(y_next)
+            step_out = np.array(y_next)  # copy: device views are read-only
             if stop is not None:
                 # finished sequences keep emitting the stop sign
                 step_out[done] = stop

@@ -1,0 +1,2 @@
+from .nn_estimator import (  # noqa: F401
+    NNClassifier, NNClassifierModel, NNEstimator, NNImageReader, NNModel)

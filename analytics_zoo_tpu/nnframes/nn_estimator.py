@@ -1,0 +1,211 @@
+"""NNFrames — ML-pipeline style estimators over DataFrames (reference
+``pipeline/nnframes/NNEstimator.scala:198`` fit→``InternalDistriOptimizer``,
+``NNModel:635`` transform = distributed predict, ``NNClassifier.scala``,
+``NNImageReader.scala``).
+
+TPU shape: pandas DataFrames play the role of Spark DataFrames; ``fit``
+lowers feature/label columns into a FeatureSet (the reference's
+``getDataSet:382-412`` with cache level) and drives the shared on-device
+Estimator; ``transform`` appends a prediction column. The Spark-ML
+``Estimator/Transformer`` param-setter surface is preserved."""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..estimator.estimator import Estimator
+from ..feature.featureset import FeatureSet, MemoryType
+from ..keras import objectives, optimizers as opt_mod
+
+
+def _column_matrix(df, cols: Union[str, Sequence[str]]) -> np.ndarray:
+    """DataFrame columns → [n, d] float array; array-valued cells stack."""
+    if isinstance(cols, str):
+        cols = [cols]
+    parts = []
+    for c in cols:
+        col = df[c].to_numpy()
+        if len(col) and isinstance(col[0], (list, tuple, np.ndarray)):
+            parts.append(np.stack([np.asarray(v, np.float32) for v in col]))
+        else:
+            parts.append(col.astype(np.float32)[:, None])
+    out = np.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+    return np.ascontiguousarray(out, dtype=np.float32)
+
+
+class NNEstimator:
+    def __init__(self, model, criterion="mse",
+                 features_col: Union[str, Sequence[str]] = "features",
+                 label_col: str = "label"):
+        self.model = model
+        self.criterion = criterion
+        self.features_col = features_col
+        self.label_col = label_col
+        self.batch_size = 32
+        self.max_epoch = 1
+        self.optimizer = "adam"
+        self.learning_rate: Optional[float] = None
+        self.cache_level = MemoryType.DRAM
+        self.validation: Optional[tuple] = None
+        self._tb: Optional[tuple] = None
+        self._ckpt: Optional[tuple] = None
+
+    # -- Spark-ML param surface (NNEstimator setters) -------------------------
+
+    def set_batch_size(self, n: int) -> "NNEstimator":
+        self.batch_size = n
+        return self
+
+    def set_max_epoch(self, n: int) -> "NNEstimator":
+        self.max_epoch = n
+        return self
+
+    def set_optim_method(self, optimizer) -> "NNEstimator":
+        self.optimizer = optimizer
+        return self
+
+    def set_learning_rate(self, lr: float) -> "NNEstimator":
+        self.learning_rate = lr
+        return self
+
+    def set_data_cache_level(self, level: str) -> "NNEstimator":
+        self.cache_level = MemoryType[level.upper()] \
+            if isinstance(level, str) else level
+        return self
+
+    def set_validation(self, df, trigger=None) -> "NNEstimator":
+        self.validation = (df, trigger)
+        return self
+
+    def set_tensorboard(self, log_dir: str, app_name: str) -> "NNEstimator":
+        self._tb = (log_dir, app_name)
+        return self
+
+    def set_checkpoint(self, path: str, trigger=None) -> "NNEstimator":
+        self._ckpt = (path, trigger)
+        return self
+
+    # -- fit ------------------------------------------------------------------
+
+    def _label_array(self, df) -> np.ndarray:
+        y = df[self.label_col].to_numpy()
+        if len(y) and isinstance(y[0], (list, tuple, np.ndarray)):
+            return np.stack([np.asarray(v, np.float32) for v in y])
+        return y.astype(np.float32)
+
+    def _make_estimator(self) -> Estimator:
+        opt = self.optimizer
+        if isinstance(opt, str):
+            if self.learning_rate is None:
+                opt = opt_mod.get(opt)
+            else:
+                factory = opt_mod._FACTORIES.get(opt.lower())
+                if factory is None:
+                    raise ValueError(f"unknown optimizer '{opt}'; have "
+                                     f"{sorted(opt_mod._FACTORIES)}")
+                opt = factory(self.learning_rate)
+        return Estimator(model=self.model,
+                         loss_fn=objectives.get(self.criterion),
+                         optimizer=opt)
+
+    def fit(self, df) -> "NNModel":
+        x = _column_matrix(df, self.features_col)
+        y = self._label_array(df)
+        fs = FeatureSet.from_ndarrays(x, y, memory_type=self.cache_level)
+        est = self._make_estimator()
+        if self._tb:
+            est.set_tensorboard(*self._tb)
+        if self._ckpt:
+            est.set_checkpoint(*self._ckpt)
+        val_fs = None
+        val_trigger = None
+        if self.validation is not None:
+            vdf, val_trigger = self.validation
+            val_fs = FeatureSet.from_ndarrays(
+                _column_matrix(vdf, self.features_col),
+                self._label_array(vdf))
+        est.train(fs, batch_size=self.batch_size, epochs=self.max_epoch,
+                  validation_set=val_fs, validation_trigger=val_trigger)
+        return self._make_model(est)
+
+    def _make_model(self, est: Estimator) -> "NNModel":
+        return NNModel(self.model, est, self.features_col)
+
+
+class NNModel:
+    """Fitted transformer: ``transform`` appends ``prediction``
+    (reference ``NNModel.transform``, NNEstimator.scala:635)."""
+
+    def __init__(self, model, estimator: Estimator,
+                 features_col: Union[str, Sequence[str]] = "features",
+                 prediction_col: str = "prediction"):
+        self.model = model
+        self.estimator = estimator
+        self.features_col = features_col
+        self.prediction_col = prediction_col
+        self.batch_size = 32
+
+    def set_batch_size(self, n: int) -> "NNModel":
+        self.batch_size = n
+        return self
+
+    def set_prediction_col(self, c: str) -> "NNModel":
+        self.prediction_col = c
+        return self
+
+    def _predict_array(self, df) -> np.ndarray:
+        x = _column_matrix(df, self.features_col)
+        return np.asarray(self.estimator.predict(x, batch_size=self.batch_size))
+
+    def transform(self, df):
+        preds = self._predict_array(df)
+        out = df.copy()
+        out[self.prediction_col] = (list(preds) if preds.ndim > 1
+                                    else preds.tolist())
+        return out
+
+    def save(self, path: str) -> None:
+        self.estimator.save_checkpoint(path)
+
+    def load_weights(self, path: str) -> None:
+        self.estimator.load_checkpoint(path)
+
+
+class NNClassifier(NNEstimator):
+    """Classification sugar: integer labels, softmax argmax predictions
+    (reference ``NNClassifier.scala``)."""
+
+    def __init__(self, model, criterion="sparse_categorical_crossentropy",
+                 features_col="features", label_col="label"):
+        super().__init__(model, criterion, features_col, label_col)
+
+    def _make_model(self, est: Estimator) -> "NNClassifierModel":
+        return NNClassifierModel(self.model, est, self.features_col)
+
+
+class NNClassifierModel(NNModel):
+    def transform(self, df):
+        probs = self._predict_array(df)
+        out = df.copy()
+        out[self.prediction_col] = np.argmax(probs, axis=-1).astype(float)
+        return out
+
+
+class NNImageReader:
+    """Read an image folder into a DataFrame with decoded image arrays
+    (reference ``NNImageReader.scala``: image schema DataFrame)."""
+
+    @staticmethod
+    def read_images(path: str, resize_h: Optional[int] = None,
+                    resize_w: Optional[int] = None, with_label: bool = False):
+        import pandas as pd
+        from ..feature.image import ImageSet, Resize
+        iset = ImageSet.read(path, with_label=with_label)
+        if resize_h and resize_w:
+            iset = iset.transform(Resize(resize_h, resize_w))
+        data = {"image": [np.asarray(i, np.float32) for i in iset.images],
+                "origin": iset.paths}
+        if with_label:
+            data["label"] = iset.labels
+        return pd.DataFrame(data)

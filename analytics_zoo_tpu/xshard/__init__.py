@@ -1,0 +1,1 @@
+from .shard import DataShards, read_csv, read_json  # noqa: F401

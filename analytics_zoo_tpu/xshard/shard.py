@@ -1,0 +1,103 @@
+"""XShard — distributed pandas shards (reference ``pyzoo/zoo/xshard/``:
+``DataShards:20``, ``RayDataShards:42``, ``SparkDataShards:103``,
+``read_file_ray/read_file_spark``).
+
+TPU-host shape: shards are pandas partitions processed by a local process
+pool (the Ray/Spark executor role); ``apply`` maps a function over every
+shard in parallel, ``collect`` gathers, ``repartition`` rebalances. On a
+multi-host pod each host builds its own DataShards over its slice of files
+(the per-host shard_index contract)."""
+from __future__ import annotations
+
+import glob
+import os
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+
+class DataShards:
+    def __init__(self, shards: List[Any], parallelism: Optional[int] = None,
+                 use_processes: bool = False):
+        self.shards = list(shards)
+        self.parallelism = parallelism or min(8, os.cpu_count() or 1)
+        self.use_processes = use_processes
+
+    def _pool(self):
+        cls = ProcessPoolExecutor if self.use_processes else ThreadPoolExecutor
+        return cls(max_workers=self.parallelism)
+
+    def apply(self, fn: Callable[[Any], Any], *args) -> "DataShards":
+        """Map ``fn(shard, *args)`` over all shards in parallel (reference
+        ``DataShards.apply``)."""
+        if len(self.shards) == 1:
+            return DataShards([fn(self.shards[0], *args)], self.parallelism,
+                              self.use_processes)
+        with self._pool() as pool:
+            out = list(pool.map(lambda s: fn(s, *args), self.shards)) \
+                if not self.use_processes else \
+                [f.result() for f in [pool.submit(fn, s, *args)
+                                      for s in self.shards]]
+        return DataShards(out, self.parallelism, self.use_processes)
+
+    def transform_shard(self, fn: Callable, *args) -> "DataShards":
+        return self.apply(fn, *args)  # reference alias
+
+    def collect(self) -> List[Any]:
+        return list(self.shards)
+
+    def concat_to_pandas(self):
+        import pandas as pd
+        return pd.concat(self.shards, ignore_index=True)
+
+    def num_partitions(self) -> int:
+        return len(self.shards)
+
+    def repartition(self, n: int) -> "DataShards":
+        """Rebalance pandas shards into ``n`` partitions."""
+        import pandas as pd
+        whole = pd.concat(self.shards, ignore_index=True)
+        parts = np.array_split(np.arange(len(whole)), n)
+        return DataShards([whole.iloc[p].reset_index(drop=True)
+                           for p in parts], self.parallelism,
+                          self.use_processes)
+
+    def to_featureset(self, feature_cols: Sequence[str],
+                      label_cols: Optional[Sequence[str]] = None, **kwargs):
+        from ..feature.featureset import FeatureSet
+        return FeatureSet.from_dataframe(self.concat_to_pandas(),
+                                         feature_cols, label_cols, **kwargs)
+
+
+def _expand(path: str, exts: Sequence[str]) -> List[str]:
+    if os.path.isdir(path):
+        files: List[str] = []
+        for e in exts:
+            files.extend(sorted(glob.glob(os.path.join(path, f"*{e}"))))
+        return files
+    return sorted(glob.glob(path)) or [path]
+
+
+def read_csv(path: str, num_shards: Optional[int] = None,
+             **pandas_kwargs) -> DataShards:
+    """Read csv file(s)/dir/glob into shards (reference ``read_csv``:
+    one shard per file, or row-split when a single file)."""
+    import pandas as pd
+    files = _expand(path, [".csv"])
+    dfs = [pd.read_csv(f, **pandas_kwargs) for f in files]
+    shards = DataShards(dfs)
+    if num_shards and num_shards != len(dfs):
+        shards = shards.repartition(num_shards)
+    return shards
+
+
+def read_json(path: str, num_shards: Optional[int] = None,
+              **pandas_kwargs) -> DataShards:
+    import pandas as pd
+    files = _expand(path, [".json", ".jsonl"])
+    dfs = [pd.read_json(f, **pandas_kwargs) for f in files]
+    shards = DataShards(dfs)
+    if num_shards and num_shards != len(dfs):
+        shards = shards.repartition(num_shards)
+    return shards

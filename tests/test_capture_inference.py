@@ -215,10 +215,11 @@ class TestInferenceModel:
         y = im.predict(x)
         assert y.shape == (5, 2)
         np.testing.assert_allclose(y, x @ np.eye(3, 2) + 1, atol=1e-5)
-        assert len(im._jitted) == 1
         y2 = im.predict(np.random.rand(7, 3).astype(np.float32))
-        assert y2.shape == (7, 2)
-        assert len(im._jitted) == 1  # same bucket reused
+        assert y2.shape == (7, 2)  # same bucket (8) reused by jit's cache
+        y3 = im.predict(np.random.rand(20, 3).astype(np.float32),
+                        batch_size=8)
+        assert y3.shape == (20, 2)
 
     def test_pool_concurrency(self, ctx):
         from analytics_zoo_tpu.inference import InferenceModel

@@ -1,0 +1,207 @@
+"""ImageSet/TextSet pipelines, NNFrames, XShard tests."""
+import numpy as np
+import pandas as pd
+import pytest
+
+
+class TestImageTransforms:
+    def img(self, h=40, w=60):
+        rs = np.random.RandomState(0)
+        return rs.randint(0, 255, (h, w, 3)).astype(np.uint8)
+
+    def test_resize_crop_flip(self):
+        from analytics_zoo_tpu.feature.image import (
+            CenterCrop, HFlip, RandomCrop, Resize)
+        img = self.img()
+        assert Resize(20, 30).apply(img).shape == (20, 30, 3)
+        assert CenterCrop(16, 16).apply(img).shape == (16, 16, 3)
+        assert RandomCrop(16, 16, seed=0).apply(img).shape == (16, 16, 3)
+        np.testing.assert_array_equal(HFlip().apply(img), img[:, ::-1])
+
+    def test_color_ops(self):
+        from analytics_zoo_tpu.feature.image import (
+            Brightness, ChannelNormalize, ChannelOrder, ColorJitter, Contrast,
+            Hue, Saturation)
+        img = self.img().astype(np.float32)
+        out = Brightness(10, 10, seed=0).apply(img)
+        np.testing.assert_allclose(out, img + 10)
+        out = Contrast(2, 2, seed=0).apply(img)
+        np.testing.assert_allclose(out, img * 2)
+        assert Saturation(seed=0).apply(img).shape == img.shape
+        assert Hue(seed=0).apply(img).shape == img.shape
+        assert ColorJitter(seed=0).apply(img).shape == img.shape
+        norm = ChannelNormalize([1, 2, 3], [2, 2, 2]).apply(img)
+        np.testing.assert_allclose(norm, (img - [1, 2, 3]) / 2)
+        np.testing.assert_array_equal(ChannelOrder().apply(img),
+                                      img[..., ::-1])
+
+    def test_expand_and_random(self):
+        from analytics_zoo_tpu.feature.image import (
+            Expand, HFlip, RandomPreprocessing)
+        img = self.img(10, 10).astype(np.float32)
+        out = Expand(max_ratio=2.0, seed=1).apply(img)
+        assert out.shape[0] >= 10 and out.shape[1] >= 10
+        rp = RandomPreprocessing(HFlip(), prob=0.0, seed=0)
+        np.testing.assert_array_equal(rp.apply(img), img)
+
+    def test_chain_and_decode(self, tmp_path):
+        import cv2
+        from analytics_zoo_tpu.feature.image import (
+            ImageSetToSample, PixelBytesToMat, Resize)
+        img = self.img()
+        ok, buf = cv2.imencode(".jpg", img)
+        assert ok
+        chain = PixelBytesToMat() >> Resize(8, 8) >> ImageSetToSample()
+        out = chain.apply(buf.tobytes())
+        assert out.shape == (8, 8, 3) and out.dtype == np.float32
+
+
+class TestImageSet:
+    def test_read_with_labels_and_featureset(self, ctx, tmp_path):
+        import cv2
+        from analytics_zoo_tpu.feature.image import ImageSet, Resize
+        rs = np.random.RandomState(0)
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                cv2.imwrite(str(d / f"{i}.png"),
+                            rs.randint(0, 255, (12 + i, 10, 3)).astype(np.uint8))
+        iset = ImageSet.read(str(tmp_path), with_label=True)
+        assert len(iset) == 6
+        assert sorted(set(iset.labels.tolist())) == [1.0, 2.0]
+        with pytest.raises(ValueError):  # ragged sizes must fail loudly
+            iset.to_featureset()
+        fs = iset.transform(Resize(8, 8)).to_featureset()
+        assert fs.size == 6
+        x, y = next(fs.train_iterator(2))
+        assert x.shape == (2, 8, 8, 3)
+
+
+class TestTextSet:
+    def test_full_pipeline(self, ctx):
+        from analytics_zoo_tpu.feature.text import TextSet
+        texts = ["The quick brown fox", "the lazy dog sleeps",
+                 "quick quick fox"]
+        ts = TextSet.from_texts(texts, labels=[0, 1, 0])
+        ts.tokenize().normalize().word2idx().shape_sequence(5)
+        wi = ts.get_word_index()
+        assert wi["quick"] == 1  # most frequent gets lowest index
+        fs = ts.to_featureset(shuffle=False)
+        assert fs.size == 3
+        x, y = next(fs.train_iterator(3))
+        assert x.shape == (3, 5)
+
+    def test_word_index_persistence(self, tmp_path):
+        from analytics_zoo_tpu.feature.text import TextSet
+        ts = TextSet.from_texts(["a b c", "b c d"]).tokenize().normalize()
+        ts.word2idx()
+        path = str(tmp_path / "wi.json")
+        ts.save_word_index(path)
+        ts2 = TextSet.from_texts(["c d e"]).tokenize().normalize()
+        ts2.load_word_index(path)
+        ts2.word2idx(existing_map=ts2.word_index)
+        assert ts2.features[0].indices[0] == ts.word_index["c"]
+        assert ts2.features[0].indices[2] == 0  # OOV -> 0
+
+    def test_read_dir_and_relations(self, tmp_path):
+        from analytics_zoo_tpu.feature.text import (
+            Relation, TextSet, read_relations)
+        for cls, text in (("pos", "good great"), ("neg", "bad awful")):
+            d = tmp_path / cls
+            d.mkdir()
+            (d / "a.txt").write_text(text)
+        ts = TextSet.read(str(tmp_path))
+        assert len(ts) == 2 and {f.label for f in ts.features} == {0, 1}
+
+        rel_file = tmp_path / "rels.csv"
+        rel_file.write_text("id1,id2,label\nq1,d1,1\nq1,d2,0\n")
+        rels = read_relations(str(rel_file))
+        assert rels[0] == Relation("q1", "d1", 1)
+        qa = TextSet.from_relation_pairs(
+            rels, {"q1": "what is jax"}, {"d1": "jax is nice", "d2": "no"})
+        qa.tokenize().normalize().word2idx().shape_sequence(8)
+        fs = qa.to_featureset(shuffle=False)
+        assert fs.size == 2
+
+    def test_truncation_modes(self):
+        from analytics_zoo_tpu.feature.text import TextSet
+        ts = TextSet.from_texts(["a b c d e"]).tokenize().normalize()
+        ts.word2idx()
+        pre = [f.indices.copy() for f in ts.shape_sequence(3, "pre").features]
+        assert len(pre[0]) == 3
+        ts2 = TextSet.from_texts(["a b c d e"]).tokenize().normalize()
+        ts2.word2idx(existing_map=ts.word_index)
+        post = ts2.shape_sequence(3, "post").features[0].indices
+        assert not np.array_equal(pre[0], post)
+
+
+class TestNNFrames:
+    def make_df(self, n=48):
+        rs = np.random.RandomState(0)
+        x = rs.rand(n, 4).astype(np.float32)
+        y = (x.sum(1) > 2).astype(np.float32)
+        return pd.DataFrame({"features": list(x), "label": y})
+
+    def test_nnestimator_fit_transform(self, ctx):
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras.layers import Dense
+        from analytics_zoo_tpu.nnframes import NNEstimator
+        df = self.make_df()
+        model = Sequential([Dense(8, activation="relu"), Dense(1)])
+        est = (NNEstimator(model, "mse")
+               .set_batch_size(16).set_max_epoch(3)
+               .set_optim_method("adam"))
+        nn_model = est.fit(df)
+        out = nn_model.transform(df)
+        assert "prediction" in out.columns
+        assert len(out) == len(df)
+
+    def test_nnclassifier(self, ctx):
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras.layers import Dense
+        from analytics_zoo_tpu.nnframes import NNClassifier
+        df = self.make_df()
+        model = Sequential([Dense(8, activation="relu"),
+                            Dense(2, activation="softmax")])
+        clf = (NNClassifier(model).set_batch_size(16).set_max_epoch(30)
+               .set_optim_method("adam").set_learning_rate(0.01))
+        fitted = clf.fit(df)
+        out = fitted.transform(df)
+        assert set(np.unique(out["prediction"])) <= {0.0, 1.0}
+        acc = (out["prediction"].to_numpy() == df["label"].to_numpy()).mean()
+        assert acc > 0.6
+
+    def test_image_reader(self, ctx, tmp_path):
+        import cv2
+        from analytics_zoo_tpu.nnframes import NNImageReader
+        rs = np.random.RandomState(0)
+        for i in range(3):
+            cv2.imwrite(str(tmp_path / f"{i}.png"),
+                        rs.randint(0, 255, (10, 11, 3)).astype(np.uint8))
+        df = NNImageReader.read_images(str(tmp_path), resize_h=8, resize_w=8)
+        assert len(df) == 3
+        assert df["image"][0].shape == (8, 8, 3)
+
+
+class TestXShard:
+    def test_read_csv_apply_collect(self, ctx, tmp_path):
+        from analytics_zoo_tpu.xshard import read_csv
+        for i in range(3):
+            pd.DataFrame({"a": [i, i + 1], "b": [1.0, 2.0]}).to_csv(
+                tmp_path / f"p{i}.csv", index=False)
+        shards = read_csv(str(tmp_path))
+        assert shards.num_partitions() == 3
+        doubled = shards.apply(lambda df: df.assign(a=df.a * 2))
+        whole = doubled.concat_to_pandas()
+        assert whole["a"].sum() == 2 * sum([0, 1, 1, 2, 2, 3])
+
+    def test_repartition_and_featureset(self, ctx, tmp_path):
+        from analytics_zoo_tpu.xshard import read_csv
+        pd.DataFrame({"x": np.arange(10, dtype=float),
+                      "y": np.arange(10, dtype=float)}).to_csv(
+            tmp_path / "data.csv", index=False)
+        shards = read_csv(str(tmp_path / "data.csv"), num_shards=4)
+        assert shards.num_partitions() == 4
+        fs = shards.to_featureset(["x"], ["y"], shuffle=False)
+        assert fs.size == 10
