@@ -50,6 +50,8 @@ class FnEstimator:
         if isinstance(data, FeatureSet):
             return data
         if mode == ModeKeys.PREDICT:
+            if isinstance(data, tuple) and len(data) == 2:
+                data = data[0]  # shared input_fn returning (x, y): drop labels
             # predictions must cover every row on every host — no sharding
             return FeatureSet.from_ndarrays(data, None, shuffle=False,
                                             shard=False)

@@ -116,7 +116,16 @@ class GANEstimator:
         local_batch = self.ctx.local_batch(batch_size)
         it = fs.train_iterator(local_batch)
         feed = DeviceFeed(it, self.mesh)
-        pending = []  # device loss scalars; drained once — async dispatch
+        pending = []  # device loss scalars, drained periodically: keeps
+        d_hist, g_hist = [], []  # dispatch async but bounds live buffers and
+        drain_every = 100        # surfaces async failures promptly
+
+        def drain():
+            for d, g in jax.device_get(pending):
+                d_hist.append(float(d))
+                g_hist.append(float(g))
+            pending.clear()
+
         for _ in range(steps):
             real, _ = next(feed)
             self._ensure_initialized(real)
@@ -129,9 +138,9 @@ class GANEstimator:
                                      step_rng, real)
             self.global_step += 1
             pending.append((dl, gl))
-        drained = jax.device_get(pending)
-        d_hist = [float(d) for d, _ in drained]
-        g_hist = [float(g) for _, g in drained]
+            if len(pending) >= drain_every:
+                drain()
+        drain()
         return {"d_loss_history": d_hist, "g_loss_history": g_hist,
                 "iterations": self.global_step}
 

@@ -96,9 +96,9 @@ class DistributedImageSet(ImageSet):
     """Sharded image collection (reference ``DistributedImageSet:119``) —
     per-host sharding is applied by the FeatureSet it lowers into."""
 
-    def __init__(self, images, labels=None, paths=None, num_shards: int = 1):
-        super().__init__(images, labels, paths)
-        self.num_shards = num_shards
+    def transform(self, preprocessing: Preprocessing) -> "DistributedImageSet":
+        out = [preprocessing.apply(img) for img in self.images]
+        return DistributedImageSet(out, self.labels, self.paths)
 
     def to_featureset(self, **kwargs) -> FeatureSet:
         kwargs.setdefault("shard", True)

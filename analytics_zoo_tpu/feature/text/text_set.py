@@ -95,18 +95,47 @@ class TextSet:
     @staticmethod
     def from_relation_pairs(relations: Sequence[Relation],
                             corpus1: Dict[str, str],
-                            corpus2: Dict[str, str]) -> "LocalTextSet":
-        """Build (text1 ++ text2, label) records for pairwise ranking
-        (reference ``TextSet.fromRelationPairs`` feeding KNRM). The two
-        texts are kept separated by '\\n' so lengths can be shaped
-        independently downstream via ``shape_sequence`` on the concatenated
-        index array."""
+                            corpus2: Dict[str, str],
+                            text1_length: Optional[int] = None,
+                            text2_length: Optional[int] = None
+                            ) -> "LocalTextSet":
+        """(text1, text2, label) records for pairwise ranking (reference
+        ``TextSet.fromRelationPairs`` feeding KNRM).
+
+        With ``text1_length``/``text2_length`` the full pipeline runs here:
+        both corpora share one word index, each side is shaped to its own
+        length, and the returned records carry the concatenated
+        ``[text1_length + text2_length]`` index arrays KNRM consumes — call
+        ``to_featureset`` directly. Without lengths, records hold the raw
+        concatenated text and the normal pipeline ops apply to the joint
+        token sequence."""
+        if text1_length is None or text2_length is None:
+            feats = [TextFeature(corpus1[r.id1] + "\n" + corpus2[r.id2],
+                                 r.label, uri=f"{r.id1}:{r.id2}")
+                     for r in relations]
+            return LocalTextSet(feats)
+        # per-side pipeline with a shared word index over both corpora
+        both = TextSet.from_texts(
+            list(corpus1.values()) + list(corpus2.values()))
+        both.tokenize().normalize().word2idx()
+        wi = both.get_word_index()
+
+        def side(text: str, length: int) -> np.ndarray:
+            ts = TextSet.from_texts([text]).tokenize().normalize()
+            ts.word2idx(existing_map=wi)
+            ts.shape_sequence(length)
+            return ts.features[0].indices
+
         feats = []
         for r in relations:
             tf = TextFeature(corpus1[r.id1] + "\n" + corpus2[r.id2], r.label,
                              uri=f"{r.id1}:{r.id2}")
+            tf.indices = np.concatenate([side(corpus1[r.id1], text1_length),
+                                         side(corpus2[r.id2], text2_length)])
             feats.append(tf)
-        return LocalTextSet(feats)
+        out = LocalTextSet(feats)
+        out.word_index = wi
+        return out
 
     # -- pipeline ops (each returns self-type with updated features) ----------
 
@@ -187,8 +216,12 @@ class TextSet:
             xs.append(x)
             ys.append(y)
         feats = np.stack(xs)
-        labels = (None if any(y is None for y in ys)
-                  else np.asarray(ys, np.float32))
+        n_missing = sum(1 for y in ys if y is None)
+        if 0 < n_missing < len(ys):
+            raise ValueError(
+                f"{n_missing}/{len(ys)} records have no label; labels must "
+                "be all present or all absent")
+        labels = None if n_missing else np.asarray(ys, np.float32)
         return FeatureSet.from_ndarrays(feats, labels, **kwargs)
 
     def __len__(self) -> int:

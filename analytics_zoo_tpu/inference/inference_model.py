@@ -47,6 +47,13 @@ class InferenceModel:
         self._jit: Optional[Callable] = None  # jit caches per shape itself
         self._host_predict: Optional[Callable] = None  # non-XLA backends
 
+    def _set_forward(self, forward: Callable) -> None:
+        """Install the forward fn and its jit wrapper eagerly — one wrapper
+        per model, so concurrent cold predicts share XLA's compile cache
+        instead of racing to build separate wrappers."""
+        self._forward = forward
+        self._jit = jax.jit(forward)
+
     # -- loaders (doLoad* family) ---------------------------------------------
 
     def load_zoo(self, path: str) -> "InferenceModel":
@@ -60,7 +67,7 @@ class InferenceModel:
             y, _ = model.call(params, est.model_state, x, training=False)
             return y
 
-        self._forward = forward
+        self._set_forward(forward)
         self._params = est.params
         return self
 
@@ -76,20 +83,20 @@ class InferenceModel:
             y, _ = model.call(p, model_state, x, training=False)
             return y
 
-        self._forward = forward
+        self._set_forward(forward)
         self._params = params
         return self
 
     def load_jax(self, forward_fn: Callable, params: Any) -> "InferenceModel":
         """Raw ``forward(params, x)`` + params pytree (≙ doLoadTF frozen)."""
-        self._forward = forward_fn
+        self._set_forward(forward_fn)
         self._params = params
         return self
 
     def load_flax(self, module, variables: Any) -> "InferenceModel":
         def forward(vars_, x):
             return module.apply(vars_, x)
-        self._forward = forward
+        self._set_forward(forward)
         self._params = variables
         return self
 
@@ -114,7 +121,7 @@ class InferenceModel:
                 return next(iter(out.values()))
             return out
 
-        self._forward = forward
+        self._set_forward(forward)
         self._params = {}
         self._keep_alive = loaded
         return self
@@ -148,16 +155,14 @@ class InferenceModel:
         if dtype == "int8":
             def forward(qp, x):
                 return base(dequantize_params(qp), x)
-            self._forward = forward
         else:
             def forward(qp, x):
                 import jax.numpy as jnp
                 y = base(qp, x)
                 return jax.tree_util.tree_map(
                     lambda t: t.astype(jnp.float32), y)
-            self._forward = forward
+        self._set_forward(forward)
         self._params = qparams
-        self._jit = None
         return self
 
     # -- predict (doPredict) --------------------------------------------------
@@ -192,8 +197,6 @@ class InferenceModel:
                 [a, np.repeat(a[-1:], bucket - n, axis=0)]) for a in xs]
         arg = xs if isinstance(x, (list, tuple)) else xs[0]
         with self._slots:
-            if self._jit is None:
-                self._jit = jax.jit(self._forward)
             y = self._jit(self._params, arg)
         trim = lambda t: np.asarray(t)[:n]
         if isinstance(y, dict):

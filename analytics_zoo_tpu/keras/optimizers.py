@@ -135,11 +135,16 @@ _FACTORIES = {
 }
 
 
-def get(optimizer: Union[str, Optimizer]) -> Optimizer:
+def get(optimizer: Union[str, Optimizer],
+        learning_rate: Optional[float] = None) -> Optimizer:
+    """Resolve an optimizer by name/instance; ``learning_rate`` overrides the
+    named factory's default (ignored for pre-built instances)."""
     if isinstance(optimizer, Optimizer):
         return optimizer
     if isinstance(optimizer, optax.GradientTransformation):
         return Optimizer("custom", optimizer, 0.0)
-    if optimizer not in _FACTORIES:
+    key = str(optimizer).lower()
+    if key not in _FACTORIES:
         raise ValueError(f"unknown optimizer '{optimizer}'; have {sorted(_FACTORIES)}")
-    return _FACTORIES[optimizer]()
+    factory = _FACTORIES[key]
+    return factory() if learning_rate is None else factory(learning_rate)

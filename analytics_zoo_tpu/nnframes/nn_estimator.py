@@ -97,14 +97,7 @@ class NNEstimator:
     def _make_estimator(self) -> Estimator:
         opt = self.optimizer
         if isinstance(opt, str):
-            if self.learning_rate is None:
-                opt = opt_mod.get(opt)
-            else:
-                factory = opt_mod._FACTORIES.get(opt.lower())
-                if factory is None:
-                    raise ValueError(f"unknown optimizer '{opt}'; have "
-                                     f"{sorted(opt_mod._FACTORIES)}")
-                opt = factory(self.learning_rate)
+            opt = opt_mod.get(opt, learning_rate=self.learning_rate)
         return Estimator(model=self.model,
                          loss_fn=objectives.get(self.criterion),
                          optimizer=opt)

@@ -1,0 +1,7 @@
+"""Cluster Serving (reference ``serving/ClusterServing.scala:45`` +
+``pyzoo/zoo/serving/client.py``): pub/sub queue → host preprocessing →
+batched TPU inference → result write-back with backpressure."""
+from .client import InputQueue, OutputQueue  # noqa: F401
+from .config import ServingConfig  # noqa: F401
+from .queues import FileQueue, QueueBackend, RedisQueue, make_queue  # noqa: F401
+from .server import ClusterServing  # noqa: F401

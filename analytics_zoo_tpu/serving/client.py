@@ -1,0 +1,49 @@
+"""Serving client SDK (reference ``pyzoo/zoo/serving/client.py``:
+``InputQueue.enqueue_image:87``, ``OutputQueue.dequeue:135`` / ``query``)."""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .queues import FileQueue, QueueBackend, encode_image, make_queue
+
+
+class _API:
+    def __init__(self, src: str = "dir:///tmp/zoo_serving"):
+        self.queue: QueueBackend = make_queue(src)
+
+
+class InputQueue(_API):
+    def enqueue_image(self, uri: str, img) -> None:
+        """``img``: ndarray (HWC), encoded bytes, or a path string."""
+        if isinstance(img, str):
+            import cv2
+            data = cv2.imread(img)
+            if data is None:
+                raise ValueError(f"unreadable image path {img}")
+            img = data
+        self.queue.enqueue(uri, {"image": encode_image(img)})
+
+    def enqueue_tensor(self, uri: str, tensor) -> None:
+        self.queue.enqueue(uri, {"tensor": np.asarray(tensor).tolist()})
+
+
+class OutputQueue(_API):
+    def query(self, uri: str, timeout_s: float = 0.0
+              ) -> Optional[Dict[str, Any]]:
+        """Result for one uri; optionally poll up to ``timeout_s``."""
+        deadline = time.time() + timeout_s
+        while True:
+            res = self.queue.get_result(uri)
+            if res is not None or time.time() >= deadline:
+                return res
+            time.sleep(0.01)
+
+    def dequeue(self) -> Dict[str, Dict[str, Any]]:
+        """All available results keyed by uri (reference HGETALL sweep)."""
+        if isinstance(self.queue, FileQueue):
+            return self.queue.all_results()
+        raise NotImplementedError(
+            "dequeue-all needs the file queue; use query(uri) with redis")

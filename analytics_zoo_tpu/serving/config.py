@@ -1,0 +1,52 @@
+"""Serving config (reference ``scripts/cluster-serving/config.yaml`` schema
+parsed by ``ClusterServingHelper.scala``: model path, data src, image shape,
+topN filter, batch size, memory cap)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence
+
+
+@dataclass
+class ServingConfig:
+    model_path: str = ""
+    model_type: str = "zoo"  # zoo | savedmodel | torch
+    data_src: str = "dir:///tmp/zoo_serving"
+    image_shape: Sequence[int] = (224, 224, 3)
+    filter_top_n: Optional[int] = None
+    batch_size: int = 4
+    batch_wait_ms: int = 20  # micro-batch window
+    max_pending: int = 10000  # backpressure trim threshold
+    concurrent_num: int = 1
+    quantize: Optional[str] = None  # bf16 | int8
+    log_dir: Optional[str] = None  # TensorBoard serving summaries
+
+    @staticmethod
+    def from_yaml(path: str) -> "ServingConfig":
+        import yaml
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+        model = raw.get("model", {}) or {}
+        data = raw.get("data", {}) or {}
+        params = raw.get("params", {}) or {}
+        cfg = ServingConfig()
+        cfg.model_path = model.get("path", cfg.model_path)
+        cfg.model_type = model.get("type", cfg.model_type)
+        cfg.data_src = data.get("src") or cfg.data_src
+        if data.get("image_shape"):
+            shape = data["image_shape"]
+            if isinstance(shape, str):
+                shape = [int(s) for s in shape.split(",")]
+            cfg.image_shape = tuple(shape)
+        if data.get("filter"):  # "topN(5)" like the reference
+            s = str(data["filter"])
+            if s.lower().startswith("topn"):
+                cfg.filter_top_n = int(s[s.index("(") + 1:s.index(")")])
+        cfg.batch_size = int(params.get("batch_size", cfg.batch_size))
+        cfg.batch_wait_ms = int(params.get("batch_wait_ms", cfg.batch_wait_ms))
+        cfg.max_pending = int(params.get("max_pending", cfg.max_pending))
+        cfg.concurrent_num = int(params.get("concurrent_num",
+                                            cfg.concurrent_num))
+        cfg.quantize = params.get("quantize", cfg.quantize)
+        cfg.log_dir = raw.get("log_dir", cfg.log_dir)
+        return cfg

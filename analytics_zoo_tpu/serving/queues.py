@@ -1,0 +1,222 @@
+"""Queue backends for serving.
+
+The reference's data plane is a Redis stream (``image_stream`` XADD /
+consumer-group reads, results in a ``result:<uri>`` hash —
+``ClusterServing.scala:106-140,276-307``; client ``client.py:62,131``).
+Here the backend is pluggable:
+
+- :class:`FileQueue` (default): a spool directory with atomic renames —
+  zero extra dependencies, works single-host and on a shared filesystem
+  across hosts (requests claimed by rename, results as per-uri JSON files).
+- :class:`RedisQueue`: the reference's wire contract (stream + hash), gated
+  on the ``redis`` package being installed.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class QueueBackend:
+    """enqueue/claim requests; put/get results."""
+
+    def enqueue(self, uri: str, payload: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def claim_batch(self, max_items: int) -> List[Tuple[str, Dict[str, Any]]]:
+        """Atomically claim up to ``max_items`` pending requests."""
+        raise NotImplementedError
+
+    def put_result(self, uri: str, value: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def get_result(self, uri: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def pending_count(self) -> int:
+        raise NotImplementedError
+
+    def trim(self, max_pending: int) -> int:
+        """Drop oldest requests beyond ``max_pending`` (the redis maxmem
+        xtrim guard, ClusterServing.scala:134-140). Returns dropped count."""
+        raise NotImplementedError
+
+
+class FileQueue(QueueBackend):
+    def __init__(self, root: str):
+        self.root = root
+        self.req_dir = os.path.join(root, "requests")
+        self.claim_dir = os.path.join(root, "claimed")
+        self.res_dir = os.path.join(root, "results")
+        for d in (self.req_dir, self.claim_dir, self.res_dir):
+            os.makedirs(d, exist_ok=True)
+
+    def enqueue(self, uri: str, payload: Dict[str, Any]) -> None:
+        name = f"{time.time_ns():020d}-{uuid.uuid4().hex[:8]}.json"
+        tmp = os.path.join(self.req_dir, "." + name)
+        with open(tmp, "w") as f:
+            json.dump({"uri": uri, **payload}, f)
+        os.replace(tmp, os.path.join(self.req_dir, name))  # atomic publish
+
+    def claim_batch(self, max_items: int) -> List[Tuple[str, Dict[str, Any]]]:
+        out = []
+        try:
+            names = sorted(os.listdir(self.req_dir))
+        except FileNotFoundError:
+            return out
+        for name in names:
+            if name.startswith(".") or len(out) >= max_items:
+                continue
+            src = os.path.join(self.req_dir, name)
+            dst = os.path.join(self.claim_dir, name)
+            try:
+                os.replace(src, dst)  # atomic claim; loser raises
+            except OSError:
+                continue
+            try:
+                with open(dst) as f:
+                    rec = json.load(f)
+                out.append((rec["uri"], rec))
+            finally:
+                try:
+                    os.remove(dst)
+                except OSError:
+                    pass
+        return out
+
+    def put_result(self, uri: str, value: Dict[str, Any]) -> None:
+        key = hashlib.md5(uri.encode()).hexdigest()
+        tmp = os.path.join(self.res_dir, "." + key)
+        with open(tmp, "w") as f:
+            json.dump({"uri": uri, **value}, f)
+        os.replace(tmp, os.path.join(self.res_dir, key + ".json"))
+
+    def get_result(self, uri: str) -> Optional[Dict[str, Any]]:
+        key = hashlib.md5(uri.encode()).hexdigest()
+        path = os.path.join(self.res_dir, key + ".json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    def all_results(self) -> Dict[str, Dict[str, Any]]:
+        out = {}
+        for name in os.listdir(self.res_dir):
+            if name.startswith("."):
+                continue
+            with open(os.path.join(self.res_dir, name)) as f:
+                rec = json.load(f)
+            out[rec["uri"]] = rec
+        return out
+
+    def pending_count(self) -> int:
+        try:
+            return sum(1 for n in os.listdir(self.req_dir)
+                       if not n.startswith("."))
+        except FileNotFoundError:
+            return 0
+
+    def trim(self, max_pending: int) -> int:
+        names = sorted(n for n in os.listdir(self.req_dir)
+                       if not n.startswith("."))
+        dropped = 0
+        for name in names[:max(0, len(names) - max_pending)]:
+            try:
+                os.remove(os.path.join(self.req_dir, name))
+                dropped += 1
+            except OSError:
+                pass
+        return dropped
+
+
+class RedisQueue(QueueBackend):
+    """The reference wire contract: XADD to ``image_stream``, consumer-group
+    reads, results HSET at ``result:<uri>``. Needs the redis package."""
+
+    STREAM = "image_stream"
+    GROUP = "serving"
+
+    def __init__(self, host: str = "localhost", port: int = 6379):
+        import redis  # gated dependency
+        self.db = redis.StrictRedis(host=host, port=port, db=0)
+        try:
+            self.db.xgroup_create(self.STREAM, self.GROUP, mkstream=True)
+        except Exception:
+            pass  # group exists
+
+    def enqueue(self, uri: str, payload: Dict[str, Any]) -> None:
+        self.db.xadd(self.STREAM, {"uri": uri,
+                                   "data": json.dumps(payload)})
+
+    def claim_batch(self, max_items: int) -> List[Tuple[str, Dict[str, Any]]]:
+        resp = self.db.xreadgroup(self.GROUP, "consumer-0",
+                                  {self.STREAM: ">"}, count=max_items,
+                                  block=10)
+        out = []
+        for _, entries in resp or []:
+            for eid, fields in entries:
+                uri = fields[b"uri"].decode()
+                payload = json.loads(fields[b"data"].decode())
+                out.append((uri, {"uri": uri, **payload}))
+                self.db.xack(self.STREAM, self.GROUP, eid)
+        return out
+
+    def put_result(self, uri: str, value: Dict[str, Any]) -> None:
+        self.db.hset(f"result:{uri}", mapping={
+            k: json.dumps(v) for k, v in value.items()})
+
+    def get_result(self, uri: str) -> Optional[Dict[str, Any]]:
+        raw = self.db.hgetall(f"result:{uri}")
+        if not raw:
+            return None
+        return {k.decode(): json.loads(v.decode()) for k, v in raw.items()}
+
+    def pending_count(self) -> int:
+        return self.db.xlen(self.STREAM)
+
+    def trim(self, max_pending: int) -> int:
+        before = self.pending_count()
+        self.db.xtrim(self.STREAM, maxlen=max_pending)
+        return max(0, before - self.pending_count())
+
+
+def make_queue(src: str) -> QueueBackend:
+    """``dir:///path`` or a path → FileQueue; ``host:port`` → RedisQueue."""
+    if src.startswith("dir://"):
+        return FileQueue(src[len("dir://"):])
+    if ":" in src and not os.sep in src.split(":")[0]:
+        host, port = src.rsplit(":", 1)
+        try:
+            return RedisQueue(host, int(port))
+        except ImportError as e:
+            raise RuntimeError(
+                f"queue src '{src}' needs the redis package; use a "
+                f"dir:///path file queue instead") from e
+    return FileQueue(src)
+
+
+def encode_image(img) -> str:
+    """ndarray/bytes → base64 jpg string (client-side payload encoding)."""
+    import numpy as np
+    if isinstance(img, (bytes, bytearray)):
+        return base64.b64encode(bytes(img)).decode()
+    import cv2
+    ok, buf = cv2.imencode(".jpg", np.asarray(img))
+    if not ok:
+        raise ValueError("image encode failed")
+    return base64.b64encode(buf.tobytes()).decode()
+
+
+def decode_image(b64: str):
+    import cv2
+    import numpy as np
+    buf = np.frombuffer(base64.b64decode(b64), np.uint8)
+    img = cv2.imdecode(buf, cv2.IMREAD_COLOR)
+    if img is None:
+        raise ValueError("image decode failed")
+    return img

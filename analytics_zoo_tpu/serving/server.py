@@ -1,0 +1,149 @@
+"""Serving engine (reference ``serving/ClusterServing.scala:45``): the loop
+is claim micro-batch → decode base64 images → preprocess to the model shape
+→ batched ``InferenceModel.doPredict`` → top-N postprocess → result
+write-back, with a pending-queue trim guard and throughput summaries
+(``:312-331``). One process per host; the TPU executes the batched forward,
+threads only move bytes."""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..inference.inference_model import InferenceModel
+from .config import ServingConfig
+from .queues import QueueBackend, decode_image, make_queue
+
+logger = logging.getLogger("analytics_zoo_tpu.serving")
+
+
+def top_n(probs: np.ndarray, n: int) -> List[Dict[str, float]]:
+    """Per-record topN (class, prob) filter (reference
+    ``PostProcessing.scala``)."""
+    idx = np.argsort(-probs)[:n]
+    return [{"class": int(i), "prob": float(probs[i])} for i in idx]
+
+
+class ClusterServing:
+    def __init__(self, config: ServingConfig,
+                 model: Optional[InferenceModel] = None,
+                 queue: Optional[QueueBackend] = None):
+        self.config = config
+        self.queue = queue if queue is not None else make_queue(config.data_src)
+        self.model = model if model is not None else self._load_model()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.records_served = 0
+        self._writer = None
+        if config.log_dir:
+            from ..utils.tensorboard import SummaryWriter
+            self._writer = SummaryWriter(
+                os.path.join(config.log_dir, "serving"))
+
+    def _load_model(self) -> InferenceModel:
+        cfg = self.config
+        im = InferenceModel(concurrent_num=cfg.concurrent_num)
+        if cfg.model_type == "zoo":
+            im.load_zoo(cfg.model_path)
+        elif cfg.model_type == "savedmodel":
+            im.load_savedmodel(cfg.model_path)
+        elif cfg.model_type == "torch":
+            im.load_torch(cfg.model_path)
+        else:
+            raise ValueError(f"unknown model_type {cfg.model_type}")
+        if cfg.quantize:
+            im.quantize(cfg.quantize)
+        return im
+
+    # -- record prep ----------------------------------------------------------
+
+    def _prepare(self, record: Dict[str, Any]) -> np.ndarray:
+        cfg = self.config
+        if "image" in record:  # base64-encoded image bytes
+            img = decode_image(record["image"])
+            h, w = cfg.image_shape[0], cfg.image_shape[1]
+            if img.shape[:2] != (h, w):
+                import cv2
+                img = cv2.resize(img, (w, h))
+            return np.asarray(img, np.float32)
+        if "tensor" in record:  # raw numeric payload
+            return np.asarray(record["tensor"], np.float32)
+        raise ValueError(f"record has neither image nor tensor: "
+                         f"{sorted(record)}")
+
+    # -- the serve loop -------------------------------------------------------
+
+    def serve_once(self) -> int:
+        """One micro-batch; returns number of records served."""
+        cfg = self.config
+        dropped = self.queue.trim(cfg.max_pending)
+        if dropped:
+            logger.warning("backpressure: dropped %d oldest requests", dropped)
+        deadline = time.time() + cfg.batch_wait_ms / 1000.0
+        batch: List = []
+        while len(batch) < cfg.batch_size and time.time() < deadline:
+            got = self.queue.claim_batch(cfg.batch_size - len(batch))
+            if got:
+                batch.extend(got)
+            elif not batch:
+                return 0  # nothing pending at all
+            else:
+                time.sleep(0.001)
+        if not batch:
+            return 0
+        uris, arrays, errors = [], [], []
+        for uri, rec in batch:
+            try:
+                arrays.append(self._prepare(rec))
+                uris.append(uri)
+            except Exception as e:  # undecodable record → error result
+                errors.append((uri, str(e)))
+        for uri, msg in errors:
+            self.queue.put_result(uri, {"error": msg})
+        if arrays:
+            x = np.stack(arrays)
+            start = time.perf_counter()
+            probs = np.asarray(self.model.predict(x))
+            elapsed = time.perf_counter() - start
+            for uri, p in zip(uris, probs):
+                p = np.asarray(p).reshape(-1)
+                if cfg.filter_top_n:
+                    self.queue.put_result(uri, {"topN": top_n(
+                        p, cfg.filter_top_n)})
+                else:
+                    self.queue.put_result(uri, {"value": p.tolist()})
+            self.records_served += len(uris)
+            if self._writer is not None:
+                self._writer.add_scalar("Serving Throughput",
+                                        len(uris) / max(elapsed, 1e-9),
+                                        self.records_served)
+                self._writer.add_scalar("Total Records Number",
+                                        self.records_served,
+                                        self.records_served)
+        return len(batch)
+
+    def run(self, poll_interval_s: float = 0.005) -> None:
+        logger.info("serving started (src=%s batch=%d)",
+                    self.config.data_src, self.config.batch_size)
+        while not self._stop.is_set():
+            if self.serve_once() == 0:
+                time.sleep(poll_interval_s)
+        if self._writer is not None:
+            self._writer.flush()
+
+    def start(self) -> "ClusterServing":
+        """Run the loop in a background thread (the spark-submit long-running
+        job role)."""
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

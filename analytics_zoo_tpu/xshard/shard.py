@@ -35,10 +35,8 @@ class DataShards:
             return DataShards([fn(self.shards[0], *args)], self.parallelism,
                               self.use_processes)
         with self._pool() as pool:
-            out = list(pool.map(lambda s: fn(s, *args), self.shards)) \
-                if not self.use_processes else \
-                [f.result() for f in [pool.submit(fn, s, *args)
-                                      for s in self.shards]]
+            futures = [pool.submit(fn, s, *args) for s in self.shards]
+            out = [f.result() for f in futures]
         return DataShards(out, self.parallelism, self.use_processes)
 
     def transform_shard(self, fn: Callable, *args) -> "DataShards":

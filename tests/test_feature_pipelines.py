@@ -124,6 +124,25 @@ class TestTextSet:
         fs = qa.to_featureset(shuffle=False)
         assert fs.size == 2
 
+    def test_relation_pairs_shaped_for_knrm(self, ctx):
+        from analytics_zoo_tpu.feature.text import Relation, TextSet
+        rels = [Relation("q1", "d1", 1), Relation("q1", "d2", 0)]
+        qa = TextSet.from_relation_pairs(
+            rels, {"q1": "what is jax"},
+            {"d1": "jax is a nice library", "d2": "no"},
+            text1_length=4, text2_length=6)
+        assert all(len(f.indices) == 10 for f in qa.features)
+        fs = qa.to_featureset(shuffle=False)
+        x, y = next(fs.train_iterator(2))
+        assert x.shape == (2, 10) and y.tolist() == [1.0, 0.0]
+        # feeds KNRM directly
+        from analytics_zoo_tpu.models import KNRM
+        m = KNRM(4, 6, vocab_size=len(qa.get_word_index()) + 1, embed_size=4,
+                 kernel_num=3, target_mode="classification")
+        m.default_compile()
+        xt = np.tile(x.astype(np.float32), (4, 1))  # 8 rows for the 8-dev mesh
+        m.fit(xt, np.tile(y, 4), batch_size=8, nb_epoch=1)
+
     def test_truncation_modes(self):
         from analytics_zoo_tpu.feature.text import TextSet
         ts = TextSet.from_texts(["a b c d e"]).tokenize().normalize()

@@ -1,0 +1,147 @@
+"""Serving tests (reference strategy: config parsing + pre/post processing
+unit tests + an in-process end-to-end loop, SURVEY.md §4 'serving unit
+tests')."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+
+class TestQueues:
+    def test_file_queue_roundtrip(self, tmp_path):
+        from analytics_zoo_tpu.serving import FileQueue
+        q = FileQueue(str(tmp_path))
+        q.enqueue("a", {"tensor": [1, 2]})
+        q.enqueue("b", {"tensor": [3, 4]})
+        assert q.pending_count() == 2
+        batch = q.claim_batch(10)
+        assert [u for u, _ in batch] == ["a", "b"]
+        assert q.pending_count() == 0
+        q.put_result("a", {"value": [0.5]})
+        assert q.get_result("a")["value"] == [0.5]
+        assert q.get_result("missing") is None
+
+    def test_trim_backpressure(self, tmp_path):
+        from analytics_zoo_tpu.serving import FileQueue
+        q = FileQueue(str(tmp_path))
+        for i in range(10):
+            q.enqueue(f"u{i}", {"tensor": [i]})
+        dropped = q.trim(4)
+        assert dropped == 6
+        assert q.pending_count() == 4
+        # oldest were dropped; newest survive
+        uris = [u for u, _ in q.claim_batch(10)]
+        assert uris == ["u6", "u7", "u8", "u9"]
+
+    def test_make_queue_dispatch(self, tmp_path):
+        from analytics_zoo_tpu.serving import FileQueue, make_queue
+        assert isinstance(make_queue(f"dir://{tmp_path}"), FileQueue)
+        assert isinstance(make_queue(str(tmp_path)), FileQueue)
+
+    def test_image_codec(self):
+        from analytics_zoo_tpu.serving.queues import decode_image, encode_image
+        rs = np.random.RandomState(0)
+        img = rs.randint(0, 255, (16, 16, 3)).astype(np.uint8)
+        out = decode_image(encode_image(img))
+        assert out.shape == (16, 16, 3)  # jpg is lossy; shape must hold
+
+
+class TestConfig:
+    def test_from_yaml(self, tmp_path):
+        from analytics_zoo_tpu.serving import ServingConfig
+        cfg_file = tmp_path / "config.yaml"
+        cfg_file.write_text(
+            "model:\n  path: /m\n  type: zoo\n"
+            "data:\n  src: dir:///q\n  image_shape: 8,8,3\n"
+            "  filter: topN(3)\n"
+            "params:\n  batch_size: 16\n  max_pending: 100\n")
+        cfg = ServingConfig.from_yaml(str(cfg_file))
+        assert cfg.model_path == "/m"
+        assert cfg.image_shape == (8, 8, 3)
+        assert cfg.filter_top_n == 3
+        assert cfg.batch_size == 16
+        assert cfg.max_pending == 100
+
+
+class TestPostProcessing:
+    def test_top_n(self):
+        from analytics_zoo_tpu.serving.server import top_n
+        probs = np.array([0.1, 0.6, 0.3])
+        out = top_n(probs, 2)
+        assert out[0] == {"class": 1, "prob": pytest.approx(0.6)}
+        assert out[1]["class"] == 2
+
+
+class TestEndToEnd:
+    def test_serve_loop_tensor_records(self, ctx, tmp_path):
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.inference import InferenceModel
+        from analytics_zoo_tpu.serving import (
+            ClusterServing, InputQueue, OutputQueue, ServingConfig)
+        w = np.eye(4, 3).astype(np.float32)
+        im = InferenceModel().load_jax(
+            lambda p, x: jax.nn.softmax(x @ p["w"], axis=-1),
+            {"w": jnp.asarray(w)})
+        import jax
+        src = f"dir://{tmp_path}"
+        cfg = ServingConfig(data_src=src, image_shape=(4,), filter_top_n=2,
+                            batch_size=4, batch_wait_ms=5)
+        serving = ClusterServing(cfg, model=im)
+
+        inq = InputQueue(src)
+        for i in range(6):
+            inq.enqueue_tensor(f"rec{i}", np.eye(4)[i % 4] * (i + 1))
+        served = 0
+        for _ in range(10):
+            served += serving.serve_once()
+            if served >= 6:
+                break
+        assert served >= 6
+        outq = OutputQueue(src)
+        res = outq.query("rec0", timeout_s=1.0)
+        assert res is not None and len(res["topN"]) == 2
+        assert res["topN"][0]["class"] == 0
+        all_res = outq.dequeue()
+        assert len(all_res) == 6
+
+    def test_serve_loop_images_threaded(self, ctx, tmp_path):
+        import cv2
+        import jax
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.inference import InferenceModel
+        from analytics_zoo_tpu.serving import (
+            ClusterServing, InputQueue, OutputQueue, ServingConfig)
+        rs = np.random.RandomState(0)
+        im = InferenceModel().load_jax(
+            lambda p, x: x.reshape(x.shape[0], -1).mean(1, keepdims=True), {})
+        src = f"dir://{tmp_path}"
+        cfg = ServingConfig(data_src=src, image_shape=(8, 8, 3),
+                            batch_size=2, batch_wait_ms=5)
+        serving = ClusterServing(cfg, model=im).start()
+        try:
+            inq = InputQueue(src)
+            for i in range(4):
+                inq.enqueue_image(
+                    f"img{i}", rs.randint(0, 255, (10, 12, 3)).astype(np.uint8))
+            outq = OutputQueue(src)
+            res = outq.query("img3", timeout_s=10.0)
+            assert res is not None and "value" in res
+        finally:
+            serving.stop()
+
+    def test_bad_record_gets_error_result(self, ctx, tmp_path):
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.inference import InferenceModel
+        from analytics_zoo_tpu.serving import (
+            ClusterServing, FileQueue, OutputQueue, ServingConfig)
+        im = InferenceModel().load_jax(lambda p, x: x, {})
+        src = f"dir://{tmp_path}"
+        q = FileQueue(str(tmp_path))
+        q.enqueue("bad", {"image": "not-base64-image!!"})
+        cfg = ServingConfig(data_src=src, image_shape=(4, 4, 3),
+                            batch_size=1, batch_wait_ms=1)
+        serving = ClusterServing(cfg, model=im)
+        serving.serve_once()
+        res = OutputQueue(src).query("bad")
+        assert res is not None and "error" in res
