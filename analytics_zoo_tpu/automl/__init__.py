@@ -1,0 +1,16 @@
+"""AutoML (reference ``pyzoo/zoo/automl/**``, SURVEY §2.7): search-engine
+abstraction + recipes (search-space DSL) + time-sequence feature engineering
++ built-in TS models + ``TimeSequencePredictor`` → ``TimeSequencePipeline``.
+
+TPU shape: trials train through the shared Estimator on-device loop; the
+search engine itself is host-side Python. The reference's RayTune engine maps
+to :class:`~analytics_zoo_tpu.automl.search.LocalSearchEngine` (sequential /
+thread-parallel trials; a Ray engine can plug into the same ``SearchEngine``
+contract when ray is present)."""
+from . import hp  # noqa: F401
+from .common.metrics import Evaluator  # noqa: F401
+from .config.recipe import (  # noqa: F401
+    BayesRecipe, GridRandomRecipe, LSTMGridRandomRecipe, MTNetGridRandomRecipe,
+    RandomRecipe, Recipe, SmokeRecipe)
+from .regression.time_sequence_predictor import TimeSequencePredictor  # noqa: F401
+from .pipeline.time_sequence import TimeSequencePipeline  # noqa: F401

@@ -1,0 +1,80 @@
+"""Evaluation metrics (reference ``automl/common/metrics.py``: Evaluator +
+MSE/RMSE/MAE/sMAPE/MAPE/R2... with multioutput handling)."""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import numpy as np
+
+
+def _flat(y_true, y_pred):
+    y_true = np.asarray(y_true, np.float64)
+    y_pred = np.asarray(y_pred, np.float64)
+    if y_true.shape != y_pred.shape:
+        y_pred = y_pred.reshape(y_true.shape)
+    return y_true.reshape(len(y_true), -1), y_pred.reshape(len(y_pred), -1)
+
+
+def MSE(y_true, y_pred):
+    t, p = _flat(y_true, y_pred)
+    return float(np.mean((t - p) ** 2))
+
+
+def RMSE(y_true, y_pred):
+    return float(np.sqrt(MSE(y_true, y_pred)))
+
+
+def MAE(y_true, y_pred):
+    t, p = _flat(y_true, y_pred)
+    return float(np.mean(np.abs(t - p)))
+
+
+def sMAPE(y_true, y_pred):
+    t, p = _flat(y_true, y_pred)
+    return float(100.0 * np.mean(np.abs(t - p) /
+                                 np.maximum(np.abs(t) + np.abs(p), 1e-8) * 2))
+
+
+def MAPE(y_true, y_pred):
+    t, p = _flat(y_true, y_pred)
+    return float(100.0 * np.mean(np.abs((t - p) / np.maximum(np.abs(t), 1e-8))))
+
+
+def MPE(y_true, y_pred):
+    t, p = _flat(y_true, y_pred)
+    return float(100.0 * np.mean((t - p) / np.maximum(np.abs(t), 1e-8)))
+
+
+def ME(y_true, y_pred):
+    t, p = _flat(y_true, y_pred)
+    return float(np.mean(t - p))
+
+
+def R2(y_true, y_pred):
+    t, p = _flat(y_true, y_pred)
+    ss_res = np.sum((t - p) ** 2)
+    ss_tot = np.sum((t - t.mean(axis=0)) ** 2)
+    return float(1.0 - ss_res / max(ss_tot, 1e-12))
+
+
+_METRICS: Dict[str, Callable] = {
+    "mse": MSE, "rmse": RMSE, "mae": MAE, "smape": sMAPE, "mape": MAPE,
+    "mpe": MPE, "me": ME, "r2": R2, "r_squared": R2,
+}
+
+# metrics where bigger is better (everything else minimizes)
+MAXIMIZE = {"r2", "r_squared"}
+
+
+class Evaluator:
+    @staticmethod
+    def evaluate(metric: str, y_true, y_pred) -> float:
+        key = metric.lower()
+        if key not in _METRICS:
+            raise ValueError(f"unknown metric '{metric}'; have "
+                             f"{sorted(_METRICS)}")
+        return _METRICS[key](y_true, y_pred)
+
+    @staticmethod
+    def get_metric_mode(metric: str) -> str:
+        return "max" if metric.lower() in MAXIMIZE else "min"

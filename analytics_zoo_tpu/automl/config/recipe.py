@@ -1,0 +1,183 @@
+"""Recipes — named search-space presets (reference ``automl/config/recipe.py``:
+SmokeRecipe, GridRandomRecipe, LSTMGridRandomRecipe, MTNetGridRandomRecipe,
+RandomRecipe, BayesRecipe)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from .. import hp
+
+
+class Recipe:
+    num_samples: int = 1
+    training_iteration: int = 10
+
+    def search_space(self, all_available_features: Optional[Sequence[str]]
+                     ) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def runtime_params(self) -> Dict[str, Any]:
+        return {"training_iteration": self.training_iteration,
+                "num_samples": self.num_samples}
+
+    def fixed_params(self) -> Dict[str, Any]:
+        return {}
+
+    def search_algorithm(self) -> str:
+        return "random"
+
+
+class _FeatureSubset(hp.Sampler):
+    """Random feature subset drawn from the engine's seeded rng (keeps
+    searches reproducible under ``LocalSearchEngine(seed)``)."""
+
+    def __init__(self, features: Sequence[str]):
+        self.features = list(features)
+
+    def sample(self, rng):
+        k = rng.randint(0, len(self.features))
+        return list(rng.sample(self.features, k))
+
+
+def _feature_subset(features: Optional[Sequence[str]]):
+    if not features:
+        return hp.choice([[]])
+    return _FeatureSubset(features)
+
+
+class SmokeRecipe(Recipe):
+    """Tiny sanity sweep (reference SmokeRecipe)."""
+    num_samples = 1
+    training_iteration = 1
+
+    def search_space(self, all_available_features):
+        return {
+            "selected_features": hp.choice(
+                [list(all_available_features or [])]),
+            "model": "LSTM",
+            "lstm_1_units": hp.choice([16]),
+            "lstm_2_units": hp.choice([16]),
+            "dropout_1": 0.2,
+            "dropout_2": 0.2,
+            "lr": 0.001,
+            "batch_size": 32,
+            "epochs": 1,
+            "past_seq_len": 2,
+        }
+
+
+class GridRandomRecipe(Recipe):
+    """Grid over structure × random over the rest (reference
+    GridRandomRecipe)."""
+
+    def __init__(self, num_rand_samples: int = 1, look_back: int = 2,
+                 epochs: int = 5):
+        self.num_samples = num_rand_samples
+        self.training_iteration = epochs
+        self.look_back = look_back
+
+    def search_space(self, all_available_features):
+        return {
+            "selected_features": _feature_subset(all_available_features),
+            "model": "LSTM",
+            "lstm_1_units": hp.grid_search([16, 32]),
+            "lstm_2_units": hp.grid_search([16, 32]),
+            "dropout_1": hp.uniform(0.1, 0.3),
+            "dropout_2": hp.uniform(0.1, 0.3),
+            "lr": hp.loguniform(1e-4, 1e-2),
+            "batch_size": hp.choice([32, 64]),
+            "epochs": self.training_iteration,
+            "past_seq_len": self.look_back,
+        }
+
+
+class LSTMGridRandomRecipe(GridRandomRecipe):
+    """LSTM-specific structure sweep (reference LSTMGridRandomRecipe)."""
+
+    def __init__(self, num_rand_samples: int = 1, epochs: int = 5,
+                 lstm_1_units: Sequence[int] = (16, 32, 64),
+                 lstm_2_units: Sequence[int] = (16, 32, 64),
+                 batch_size: Sequence[int] = (32, 64),
+                 look_back: int = 2):
+        super().__init__(num_rand_samples, look_back, epochs)
+        self.lstm_1_units = list(lstm_1_units)
+        self.lstm_2_units = list(lstm_2_units)
+        self.batch_size = list(batch_size)
+
+    def search_space(self, all_available_features):
+        space = super().search_space(all_available_features)
+        space.update({
+            "lstm_1_units": hp.grid_search(self.lstm_1_units),
+            "lstm_2_units": hp.grid_search(self.lstm_2_units),
+            "batch_size": hp.choice(self.batch_size),
+        })
+        return space
+
+
+class MTNetGridRandomRecipe(Recipe):
+    """MTNet structure sweep (reference MTNetGridRandomRecipe)."""
+
+    def __init__(self, num_rand_samples: int = 1, epochs: int = 5,
+                 time_step: Sequence[int] = (4,),
+                 long_num: Sequence[int] = (3, 4),
+                 cnn_height: Sequence[int] = (2, 3),
+                 cnn_hid_size: Sequence[int] = (16, 32),
+                 batch_size: Sequence[int] = (32, 64)):
+        self.num_samples = num_rand_samples
+        self.training_iteration = epochs
+        self.time_step = list(time_step)
+        self.long_num = list(long_num)
+        self.cnn_height = list(cnn_height)
+        self.cnn_hid_size = list(cnn_hid_size)
+        self.batch_size = list(batch_size)
+
+    def search_space(self, all_available_features):
+        return {
+            "selected_features": _feature_subset(all_available_features),
+            "model": "MTNet",
+            "time_step": hp.grid_search(self.time_step),
+            "long_num": hp.grid_search(self.long_num),
+            "cnn_height": hp.choice(self.cnn_height),
+            "cnn_hid_size": hp.choice(self.cnn_hid_size),
+            "dropout": hp.uniform(0.0, 0.2),
+            "lr": hp.loguniform(1e-4, 1e-2),
+            "batch_size": hp.choice(self.batch_size),
+            "epochs": self.training_iteration,
+        }
+
+
+class RandomRecipe(Recipe):
+    """Pure random search (reference RandomRecipe)."""
+
+    def __init__(self, num_rand_samples: int = 1, look_back: int = 2,
+                 epochs: int = 5):
+        self.num_samples = num_rand_samples
+        self.training_iteration = epochs
+        self.look_back = look_back
+
+    def search_space(self, all_available_features):
+        return {
+            "selected_features": _feature_subset(all_available_features),
+            "model": "LSTM",
+            "lstm_1_units": hp.choice([8, 16, 32, 64]),
+            "lstm_2_units": hp.choice([8, 16, 32, 64]),
+            "dropout_1": hp.uniform(0.1, 0.5),
+            "dropout_2": hp.uniform(0.1, 0.5),
+            "lr": hp.loguniform(1e-4, 1e-2),
+            "batch_size": hp.choice([32, 64, 128]),
+            "epochs": self.training_iteration,
+            "past_seq_len": self.look_back,
+        }
+
+
+class BayesRecipe(RandomRecipe):
+    """Bayesian-optimization search over the random space (reference
+    BayesRecipe backed by BayesOpt; here a GP surrogate from sklearn drives
+    the proposal loop in the search engine)."""
+
+    def __init__(self, num_samples: int = 10, look_back: int = 2,
+                 epochs: int = 5):
+        super().__init__(num_samples, look_back, epochs)
+
+    def search_algorithm(self) -> str:
+        return "bayes"

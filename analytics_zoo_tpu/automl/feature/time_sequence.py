@@ -1,0 +1,150 @@
+"""Time-sequence feature engineering (reference
+``automl/feature/time_sequence.py:30``: datetime features + standard scaling
++ rolling windows; save/restore of scaler state)."""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_DT_FEATURES = ["hour", "day", "weekday", "month", "is_weekend"]
+
+
+class TimeSequenceFeatureTransformer:
+    def __init__(self, future_seq_len: int = 1, dt_col: str = "datetime",
+                 target_col: str = "value",
+                 extra_features_col: Optional[Sequence[str]] = None,
+                 drop_missing: bool = True):
+        self.future_seq_len = future_seq_len
+        self.dt_col = dt_col
+        self.target_col = target_col
+        self.extra_features_col = list(extra_features_col or [])
+        self.drop_missing = drop_missing
+        self.past_seq_len: Optional[int] = None
+        self.selected_features: List[str] = []
+        self._mean: Optional[np.ndarray] = None
+        self._std: Optional[np.ndarray] = None
+
+    # -- feature list (datetime-derived + extras) -----------------------------
+
+    def get_feature_list(self, input_df=None) -> List[str]:
+        return _DT_FEATURES + list(self.extra_features_col)
+
+    def _feature_matrix(self, df) -> np.ndarray:
+        import pandas as pd
+        dt = pd.to_datetime(df[self.dt_col])
+        cols = {
+            "hour": dt.dt.hour.to_numpy(np.float32),
+            "day": dt.dt.day.to_numpy(np.float32),
+            "weekday": dt.dt.weekday.to_numpy(np.float32),
+            "month": dt.dt.month.to_numpy(np.float32),
+            "is_weekend": (dt.dt.weekday >= 5).to_numpy(np.float32),
+        }
+        feats = [df[self.target_col].to_numpy(np.float32)[:, None]]
+        for name in self.selected_features:
+            if name in cols:
+                feats.append(cols[name][:, None])
+            elif name in df.columns:
+                feats.append(df[name].to_numpy(np.float32)[:, None])
+            else:
+                raise ValueError(f"unknown feature '{name}'")
+        return np.concatenate(feats, axis=1)
+
+    # -- scaling --------------------------------------------------------------
+
+    def _fit_scaler(self, data: np.ndarray) -> None:
+        self._mean = data.mean(axis=0)
+        self._std = np.maximum(data.std(axis=0), 1e-8)
+
+    def _scale(self, data: np.ndarray) -> np.ndarray:
+        return (data - self._mean) / self._std
+
+    def _unscale_target(self, y: np.ndarray) -> np.ndarray:
+        return y * self._std[0] + self._mean[0]
+
+    # -- rolling --------------------------------------------------------------
+
+    def _roll(self, data: np.ndarray, past: int, future: int
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        n = len(data) - past - future + 1
+        if n <= 0:
+            raise ValueError(f"series of {len(data)} rows too short for "
+                             f"past={past} future={future}")
+        idx = np.arange(past)[None, :] + np.arange(n)[:, None]
+        x = data[idx]
+        yidx = np.arange(future)[None, :] + np.arange(n)[:, None] + past
+        y = data[yidx][:, :, 0]  # target is column 0
+        return x.astype(np.float32), y.astype(np.float32)
+
+    # -- the fit/transform contract -------------------------------------------
+
+    def fit_transform(self, input_df, **config) -> Tuple[np.ndarray, np.ndarray]:
+        self.past_seq_len = int(config.get("past_seq_len", 2))
+        self.selected_features = list(config.get("selected_features", []))
+        dfs = input_df if isinstance(input_df, list) else [input_df]
+        xs, ys = [], []
+        fitted = False
+        for df in dfs:
+            df = self._clean(df)
+            data = self._feature_matrix(df)
+            if not fitted:
+                self._fit_scaler(data)
+                fitted = True
+            x, y = self._roll(self._scale(data), self.past_seq_len,
+                              self.future_seq_len)
+            xs.append(x)
+            ys.append(y)
+        return np.concatenate(xs), np.concatenate(ys)
+
+    def transform(self, input_df, is_train: bool = True):
+        if self.past_seq_len is None:
+            raise RuntimeError("fit_transform first")
+        df = self._clean(input_df)
+        data = self._scale(self._feature_matrix(df))
+        if is_train:
+            return self._roll(data, self.past_seq_len, self.future_seq_len)
+        # test mode: rolling windows only, no labels
+        n = len(data) - self.past_seq_len + 1
+        idx = np.arange(self.past_seq_len)[None, :] + np.arange(n)[:, None]
+        return data[idx].astype(np.float32)
+
+    def post_processing(self, input_df, y_pred, is_train: bool):
+        """Unscale predictions back to the target's units."""
+        return self._unscale_target(np.asarray(y_pred))
+
+    def _clean(self, df):
+        if df[self.target_col].isnull().any():
+            if not self.drop_missing:
+                raise ValueError("missing values in target column")
+            df = df.dropna(subset=[self.target_col])
+        return df
+
+    # -- persistence ----------------------------------------------------------
+
+    def save(self, file_path: str) -> None:
+        state = {
+            "future_seq_len": self.future_seq_len,
+            "dt_col": self.dt_col, "target_col": self.target_col,
+            "extra_features_col": self.extra_features_col,
+            "past_seq_len": self.past_seq_len,
+            "selected_features": self.selected_features,
+            "mean": None if self._mean is None else self._mean.tolist(),
+            "std": None if self._std is None else self._std.tolist(),
+        }
+        with open(file_path, "w") as f:
+            json.dump(state, f)
+
+    def restore(self, file_path: str) -> "TimeSequenceFeatureTransformer":
+        with open(file_path) as f:
+            state = json.load(f)
+        self.future_seq_len = state["future_seq_len"]
+        self.dt_col = state["dt_col"]
+        self.target_col = state["target_col"]
+        self.extra_features_col = state["extra_features_col"]
+        self.past_seq_len = state["past_seq_len"]
+        self.selected_features = state["selected_features"]
+        self._mean = None if state["mean"] is None else np.asarray(state["mean"])
+        self._std = None if state["std"] is None else np.asarray(state["std"])
+        return self

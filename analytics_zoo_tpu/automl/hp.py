@@ -1,0 +1,86 @@
+"""Search-space DSL (the ray.tune sampling vocabulary the reference recipes
+are written in: ``RandomSample``/``GridSearch`` wrappers in
+``automl/search/RayTuneSearchEngine.py``)."""
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, List, Sequence
+
+
+class Sampler:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Choice(Sampler):
+    def __init__(self, options: Sequence[Any]):
+        self.options = list(options)
+
+    def sample(self, rng):
+        return rng.choice(self.options)
+
+
+class Uniform(Sampler):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Sampler):
+    def __init__(self, low: float, high: float):
+        import math
+        self.lo, self.hi = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+        return math.exp(rng.uniform(self.lo, self.hi))
+
+
+class RandInt(Sampler):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randint(self.low, self.high)
+
+
+class Grid:
+    """Exhaustive axis: the cross product of all Grid axes is enumerated,
+    random axes are re-sampled per point (reference GridSearch)."""
+
+    def __init__(self, options: Sequence[Any]):
+        self.options = list(options)
+
+
+class Func(Sampler):
+    def __init__(self, fn: Callable[[], Any]):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn()
+
+
+def choice(options):
+    return Choice(options)
+
+
+def uniform(low, high):
+    return Uniform(low, high)
+
+
+def loguniform(low, high):
+    return LogUniform(low, high)
+
+
+def randint(low, high):
+    return RandInt(low, high)
+
+
+def grid_search(options):
+    return Grid(options)
+
+
+def sample_from(fn):
+    return Func(fn)

@@ -1,0 +1,6 @@
+from .vanilla_lstm import VanillaLSTM  # noqa: F401
+from .mtnet import MTNet  # noqa: F401
+from .time_seq2seq import TimeSeq2Seq  # noqa: F401
+
+MODEL_REGISTRY = {"LSTM": VanillaLSTM, "MTNet": MTNet,
+                  "Seq2Seq": TimeSeq2Seq}

@@ -1,0 +1,167 @@
+"""MTNet trainable (reference ``automl/model/MTNet_keras.py`` — the
+memory-network time-series model: long-term history encoded as ``long_num``
+CNN+attention memory blocks, a short-term CNN query block, attention over
+memory, plus an autoregressive linear highway).
+
+TPU notes: all blocks are encoded in one batched conv (blocks folded into
+the batch axis — one MXU-friendly conv instead of ``long_num`` small ones);
+attention is a single einsum."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...keras import Sequential
+from ...keras.engine import Layer
+from ...keras.layers import Dense
+from ...keras.optimizers import Adam
+from ..common.metrics import Evaluator
+
+
+class _MTNetCore(Layer):
+    def __init__(self, time_step: int, long_num: int, cnn_height: int,
+                 cnn_hid_size: int, ar_window: int, output_dim: int,
+                 dropout: float, name=None):
+        super().__init__(name)
+        self.time_step = time_step
+        self.long_num = long_num
+        self.cnn_height = min(cnn_height, time_step)
+        self.cnn_hid = cnn_hid_size
+        self.ar_window = min(ar_window, time_step)
+        self.output_dim = output_dim
+        self.dropout = dropout
+
+    def build(self, rng, input_shape):
+        d = input_shape[-1]
+        k = jax.random.split(rng, 6)
+        hid = self.cnn_hid
+        conv_rows = self.time_step - self.cnn_height + 1
+        params = {
+            # one conv filter bank shared by memory and query encoders
+            "conv": jax.random.normal(
+                k[0], (self.cnn_height, d, hid)) * (1.0 / np.sqrt(
+                    self.cnn_height * d)),
+            "conv_b": jnp.zeros((hid,)),
+            "attn": jax.random.normal(k[1], (hid, hid)) * (1.0 / np.sqrt(hid)),
+            "out_w": jax.random.normal(
+                k[2], (2 * hid * conv_rows, self.output_dim)) * 0.05,
+            "out_b": jnp.zeros((self.output_dim,)),
+            "ar_w": jax.random.normal(
+                k[3], (self.ar_window, self.output_dim)) * 0.05,
+            "ar_b": jnp.zeros((self.output_dim,)),
+        }
+        return params, {}
+
+    def _encode(self, params, x):
+        """[b, time_step, d] → [b, conv_rows*hid] via valid 1D conv + relu."""
+        y = jax.lax.conv_general_dilated(
+            x, params["conv"], window_strides=(1,), padding="VALID",
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        y = jax.nn.relu(y + params["conv_b"])
+        return y.reshape(y.shape[0], -1), y
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        b = inputs.shape[0]
+        n, T = self.long_num, self.time_step
+        d = inputs.shape[-1]
+        mem = inputs[:, :n * T].reshape(b * n, T, d)  # fold blocks into batch
+        query = inputs[:, n * T:n * T + T]
+
+        mem_flat, _ = self._encode(params, mem)      # [b*n, rows*hid]
+        q_flat, _ = self._encode(params, query)      # [b, rows*hid]
+        rows_hid = mem_flat.shape[-1]
+        hid = self.cnn_hid
+        mem_blocks = mem_flat.reshape(b, n, rows_hid)
+
+        # attention of query over memory blocks (dot in conv-feature space)
+        scores = jnp.einsum("bnf,bf->bn", mem_blocks, q_flat) / np.sqrt(
+            rows_hid)
+        attn = jax.nn.softmax(scores, axis=-1)
+        context = jnp.einsum("bn,bnf->bf", attn, mem_blocks)
+
+        feats = jnp.concatenate([context, q_flat], axis=-1)
+        if training and self.dropout > 0.0 and rng is not None:
+            keep = 1.0 - self.dropout
+            mask = jax.random.bernoulli(rng, keep, feats.shape)
+            feats = jnp.where(mask, feats / keep, 0.0)
+        nonlinear = feats @ params["out_w"] + params["out_b"]
+
+        # autoregressive highway over the raw target (column 0)
+        ar_in = inputs[:, -self.ar_window:, 0]
+        linear = ar_in @ params["ar_w"] + params["ar_b"]
+        return nonlinear + linear, state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], self.output_dim)
+
+
+class MTNet:
+    def __init__(self, check_optional_config: bool = False):
+        self.model: Optional[Sequential] = None
+        self.config: Dict[str, Any] = {}
+
+    def _build(self, output_dim: int, config: Dict[str, Any]) -> Sequential:
+        core = _MTNetCore(
+            time_step=int(config.get("time_step", 4)),
+            long_num=int(config.get("long_num", 3)),
+            cnn_height=int(config.get("cnn_height", 2)),
+            cnn_hid_size=int(config.get("cnn_hid_size", 16)),
+            ar_window=int(config.get("ar_window", 2)),
+            output_dim=output_dim,
+            dropout=float(config.get("dropout", 0.0)),
+            name="mtnet_core")
+        model = Sequential([core], name="mtnet")
+        model.compile(Adam(float(config.get("lr", 1e-3))), "mse")
+        return model
+
+    def required_past_seq_len(self, config: Dict[str, Any]) -> int:
+        return (int(config.get("long_num", 3)) + 1) * \
+            int(config.get("time_step", 4))
+
+    def fit_eval(self, data: Tuple, validation_data: Optional[Tuple] = None,
+                 metric: str = "mse", **config) -> float:
+        x, y = data
+        y = np.asarray(y)
+        if y.ndim == 1:
+            y = y[:, None]
+        need = self.required_past_seq_len(config)
+        if x.shape[1] < need:
+            raise ValueError(
+                f"MTNet needs past_seq_len >= (long_num+1)*time_step = "
+                f"{need}, got {x.shape[1]}")
+        x = x[:, -need:]  # trailing window
+        self.config = dict(config)
+        self.model = self._build(y.shape[-1], config)
+        batch = min(int(config.get("batch_size", 32)), len(x))
+        self.model.fit(np.asarray(x, np.float32), y.astype(np.float32),
+                       batch_size=batch,
+                       nb_epoch=int(config.get("epochs", 1)))
+        vx, vy = validation_data if validation_data is not None else (x, y)
+        pred = self.predict(vx)
+        return Evaluator.evaluate(metric, np.asarray(vy), pred)
+
+    def predict(self, x) -> np.ndarray:
+        need = self.required_past_seq_len(self.config)
+        x = np.asarray(x, np.float32)[:, -need:]
+        return np.asarray(self.model.predict(x, batch_size=128))
+
+    def evaluate(self, x, y, metrics=("mse",)) -> Dict[str, float]:
+        pred = self.predict(x)
+        return {m: Evaluator.evaluate(m, np.asarray(y), pred)
+                for m in metrics}
+
+    def save(self, model_path: str, config_path: Optional[str] = None) -> None:
+        self.model.save_model(model_path)
+
+    def restore(self, model_path: str, **config) -> None:
+        self.config = dict(config)
+        future = int(config.get("future_seq_len", 1))
+        self.model = self._build(future, config)
+        need = self.required_past_seq_len(config)
+        dummy = np.zeros((1, need, int(config.get("input_dim", 1))),
+                         np.float32)
+        self.model.get_estimator()._ensure_initialized(dummy)
+        self.model.load_weights(model_path)

@@ -1,0 +1,66 @@
+"""Seq2Seq trainable for time series (reference ``automl/model/Seq2Seq.py``:
+LSTM encoder/decoder forecaster with teacher forcing)."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ...models.seq2seq import Seq2seq
+from ..common.metrics import Evaluator
+
+
+class TimeSeq2Seq:
+    def __init__(self, check_optional_config: bool = False):
+        self.zoo: Optional[Seq2seq] = None
+        self.config: Dict[str, Any] = {}
+        self.future_seq_len = 1
+
+    def _decoder_inputs(self, x: np.ndarray, future: int) -> np.ndarray:
+        """Teacher-forcing decoder input: last encoder target step repeated
+        (inference uses the same scheme, so train/test match)."""
+        last = x[:, -1:, 0:1]
+        return np.repeat(last, future, axis=1).astype(np.float32)
+
+    def fit_eval(self, data: Tuple, validation_data: Optional[Tuple] = None,
+                 metric: str = "mse", **config) -> float:
+        x, y = data
+        y = np.asarray(y, np.float32)
+        if y.ndim == 1:
+            y = y[:, None]
+        self.future_seq_len = y.shape[1]
+        self.config = dict(config)
+        self.zoo = Seq2seq(rnn_type="lstm",
+                           num_layers=int(config.get("num_layers", 1)),
+                           hidden_size=int(config.get("latent_dim", 32)),
+                           bridge="passthrough", generator_dim=1)
+        self.zoo.default_compile()
+        dec = self._decoder_inputs(x, self.future_seq_len)
+        target = y[:, :, None]
+        batch = min(int(config.get("batch_size", 32)), len(x))
+        self.zoo.fit([np.asarray(x, np.float32), dec], target,
+                     batch_size=batch,
+                     nb_epoch=int(config.get("epochs", 1)))
+        vx, vy = validation_data if validation_data is not None else (x, y)
+        pred = self.predict(vx)
+        return Evaluator.evaluate(metric, np.asarray(vy), pred)
+
+    def predict(self, x) -> np.ndarray:
+        x = np.asarray(x, np.float32)
+        dec = self._decoder_inputs(x, self.future_seq_len)
+        out = np.asarray(self.zoo.predict([x, dec], batch_size=128))
+        return out[:, :, 0]
+
+    def evaluate(self, x, y, metrics=("mse",)) -> Dict[str, float]:
+        pred = self.predict(x)
+        return {m: Evaluator.evaluate(m, np.asarray(y), pred)
+                for m in metrics}
+
+    def save(self, model_path: str, config_path: Optional[str] = None) -> None:
+        self.zoo.save_model(model_path)
+
+    def restore(self, model_path: str, **config) -> None:
+        from ...models.common import ZooModel
+        self.config = dict(config)
+        self.future_seq_len = int(config.get("future_seq_len", 1))
+        self.zoo = ZooModel.load_model(model_path)

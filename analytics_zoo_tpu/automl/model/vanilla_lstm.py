@@ -1,0 +1,79 @@
+"""VanillaLSTM trainable (reference ``automl/model/VanillaLSTM.py``:
+LSTM→Dropout→LSTM→Dropout→Dense over rolled windows; the search engine's
+``fit_eval`` contract)."""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ...keras import Sequential
+from ...keras.layers import Dense, Dropout, LSTM
+from ...keras.optimizers import Adam
+from ..common.metrics import Evaluator
+
+
+class VanillaLSTM:
+    def __init__(self, check_optional_config: bool = False):
+        self.model: Optional[Sequential] = None
+        self.config: Dict[str, Any] = {}
+
+    def _build(self, output_dim: int, config: Dict[str, Any]) -> Sequential:
+        model = Sequential(name="vanilla_lstm")
+        model.add(LSTM(int(config.get("lstm_1_units", 32)),
+                       return_sequences=True))
+        model.add(Dropout(float(config.get("dropout_1", 0.2))))
+        model.add(LSTM(int(config.get("lstm_2_units", 32))))
+        model.add(Dropout(float(config.get("dropout_2", 0.2))))
+        model.add(Dense(output_dim))
+        model.compile(Adam(float(config.get("lr", 1e-3))), "mse")
+        return model
+
+    def fit_eval(self, data: Tuple, validation_data: Optional[Tuple] = None,
+                 metric: str = "mse", **config) -> float:
+        """``data`` = (x [n, past, d], y [n, future]); returns the validation
+        metric (train-set metric when no validation split given)."""
+        x, y = data
+        y = np.asarray(y)
+        if y.ndim == 1:
+            y = y[:, None]
+        self.config = dict(config)
+        self.model = self._build(y.shape[-1], config)
+        batch = int(config.get("batch_size", 32))
+        batch = min(batch, len(x))
+        self.model.fit(np.asarray(x, np.float32), y.astype(np.float32),
+                       batch_size=batch,
+                       nb_epoch=int(config.get("epochs", 1)))
+        vx, vy = validation_data if validation_data is not None else (x, y)
+        pred = self.predict(vx)
+        return Evaluator.evaluate(metric, np.asarray(vy), pred)
+
+    def predict(self, x) -> np.ndarray:
+        preds = self.model.predict(np.asarray(x, np.float32), batch_size=128)
+        return np.asarray(preds)
+
+    def evaluate(self, x, y, metrics=("mse",)) -> Dict[str, float]:
+        pred = self.predict(x)
+        return {m: Evaluator.evaluate(m, np.asarray(y), pred)
+                for m in metrics}
+
+    def save(self, model_path: str, config_path: Optional[str] = None) -> None:
+        self.model.save_model(model_path)
+        if config_path:
+            import json
+            with open(config_path, "w") as f:
+                json.dump({k: v for k, v in self.config.items()
+                           if isinstance(v, (int, float, str, list, bool))}, f)
+
+    def restore(self, model_path: str, **config) -> None:
+        x_dim = config.get("input_dim")
+        future = int(config.get("future_seq_len", 1))
+        self.config = dict(config)
+        self.model = self._build(future, config)
+        # materialize params with a dummy batch before loading weights
+        past = int(config.get("past_seq_len", 2))
+        dummy = np.zeros((1, past, int(x_dim or 1)), np.float32)
+        est = self.model.get_estimator()
+        est._ensure_initialized(dummy)
+        self.model.load_weights(model_path)

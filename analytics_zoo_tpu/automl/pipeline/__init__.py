@@ -1,0 +1,1 @@
+from .time_sequence import TimeSequencePipeline  # noqa: F401

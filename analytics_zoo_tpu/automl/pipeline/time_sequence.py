@@ -1,0 +1,75 @@
+"""TimeSequencePipeline (reference ``automl/pipeline/time_sequence.py:28``):
+the fitted feature-transform + model bundle with evaluate/predict/
+save/load and incremental fit."""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from ..common.metrics import Evaluator
+from ..feature.time_sequence import TimeSequenceFeatureTransformer
+
+
+class TimeSequencePipeline:
+    def __init__(self, feature_transformer: TimeSequenceFeatureTransformer,
+                 model, config: Dict[str, Any], name: str = "automl"):
+        self.ft = feature_transformer
+        self.model = model
+        self.config = dict(config)
+        self.name = name
+
+    def predict(self, input_df) -> np.ndarray:
+        x = self.ft.transform(input_df, is_train=False)
+        y = self.model.predict(x)
+        return self.ft.post_processing(input_df, y, is_train=False)
+
+    def evaluate(self, input_df, metrics: Sequence[str] = ("mse",)
+                 ) -> Dict[str, float]:
+        x, y = self.ft.transform(input_df, is_train=True)
+        pred = self.model.predict(x)
+        y_true = self.ft.post_processing(input_df, y, is_train=False)
+        y_pred = self.ft.post_processing(input_df, pred, is_train=False)
+        return {m: Evaluator.evaluate(m, y_true, y_pred) for m in metrics}
+
+    def fit(self, input_df, validation_df=None, epoch_num: int = 1) -> float:
+        """Incremental fit on new data with the fitted config (reference
+        ``TimeSequencePipeline.fit``)."""
+        x, y = self.ft.transform(input_df, is_train=True)
+        config = dict(self.config, epochs=epoch_num)
+        val = None
+        if validation_df is not None:
+            val = self.ft.transform(validation_df, is_train=True)
+        return self.model.fit_eval((x, y), validation_data=val, **config)
+
+    # -- persistence ----------------------------------------------------------
+
+    def save(self, pipeline_path: str) -> None:
+        os.makedirs(pipeline_path, exist_ok=True)
+        self.ft.save(os.path.join(pipeline_path, "feature_transformer.json"))
+        self.model.save(os.path.join(pipeline_path, "model"))
+        meta = {"name": self.name,
+                "model_class": type(self.model).__name__,
+                "config": {k: v for k, v in self.config.items()
+                           if isinstance(v, (int, float, str, bool, list))}}
+        with open(os.path.join(pipeline_path, "pipeline.json"), "w") as f:
+            json.dump(meta, f)
+
+    @staticmethod
+    def load(pipeline_path: str) -> "TimeSequencePipeline":
+        from ..model import MODEL_REGISTRY, MTNet, TimeSeq2Seq, VanillaLSTM
+        with open(os.path.join(pipeline_path, "pipeline.json")) as f:
+            meta = json.load(f)
+        ft = TimeSequenceFeatureTransformer().restore(
+            os.path.join(pipeline_path, "feature_transformer.json"))
+        classes = {"VanillaLSTM": VanillaLSTM, "MTNet": MTNet,
+                   "TimeSeq2Seq": TimeSeq2Seq}
+        model = classes[meta["model_class"]]()
+        config = dict(meta["config"])
+        config.setdefault("future_seq_len", ft.future_seq_len)
+        config.setdefault("past_seq_len", ft.past_seq_len)
+        config.setdefault("input_dim", 1 + len(ft.selected_features))
+        model.restore(os.path.join(pipeline_path, "model"), **config)
+        return TimeSequencePipeline(ft, model, config, name=meta["name"])

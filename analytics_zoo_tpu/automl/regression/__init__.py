@@ -1,0 +1,1 @@
+from .time_sequence_predictor import TimeSequencePredictor  # noqa: F401

@@ -1,0 +1,81 @@
+"""TimeSequencePredictor (reference
+``automl/regression/time_sequence_predictor.py:37``): hyper-parameter search
+over (feature transform × model config), returning the fitted
+``TimeSequencePipeline``."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from ..common.metrics import Evaluator
+from ..config.recipe import Recipe, SmokeRecipe
+from ..feature.time_sequence import TimeSequenceFeatureTransformer
+from ..model import MODEL_REGISTRY
+from ..pipeline.time_sequence import TimeSequencePipeline
+from ..search.local_search import LocalSearchEngine
+
+
+class TimeSequencePredictor:
+    def __init__(self, name: str = "automl", future_seq_len: int = 1,
+                 dt_col: str = "datetime", target_col: str = "value",
+                 extra_features_col: Optional[Sequence[str]] = None,
+                 drop_missing: bool = True):
+        self.name = name
+        self.future_seq_len = future_seq_len
+        self.dt_col = dt_col
+        self.target_col = target_col
+        self.extra_features_col = extra_features_col
+        self.drop_missing = drop_missing
+        self.pipeline: Optional[TimeSequencePipeline] = None
+
+    def _trial(self, config: Dict[str, Any], data) -> float:
+        train_df, val_df, metric = data
+        ft = TimeSequenceFeatureTransformer(
+            self.future_seq_len, self.dt_col, self.target_col,
+            self.extra_features_col, self.drop_missing)
+        model_cls = MODEL_REGISTRY[config.get("model", "LSTM")]
+        model = model_cls()
+        if hasattr(model, "required_past_seq_len"):
+            config = dict(config,
+                          past_seq_len=model.required_past_seq_len(config))
+        x, y = ft.fit_transform(train_df, **config)
+        val = None
+        if val_df is not None:
+            vx, vy = ft.transform(val_df, is_train=True)
+            val = (vx, vy)
+        score = model.fit_eval((x, y), validation_data=val, metric=metric,
+                               **config)
+        self._last = (ft, model)  # engine runs trials sequentially
+        if self._best_score is None or self._is_better(score):
+            self._best_score = score
+            self._best = (ft, model, dict(config))
+        return score
+
+    def _is_better(self, score: float) -> bool:
+        if self._mode == "max":
+            return score > self._best_score
+        return score < self._best_score
+
+    def fit(self, input_df, validation_df=None,
+            recipe: Optional[Recipe] = None, metric: str = "mse",
+            ) -> TimeSequencePipeline:
+        recipe = recipe or SmokeRecipe()
+        self._best = None
+        self._best_score = None
+        self._mode = Evaluator.get_metric_mode(metric)
+        engine = LocalSearchEngine()
+        ft_probe = TimeSequenceFeatureTransformer(
+            self.future_seq_len, self.dt_col, self.target_col,
+            self.extra_features_col)
+        engine.compile(data=(input_df, validation_df, metric),
+                       model_create_fn=None, recipe=recipe, metric=metric,
+                       feature_cols=ft_probe.get_feature_list(),
+                       fit_fn=self._trial)
+        engine.run()
+        if self._best is None:
+            raise RuntimeError("no successful trials")
+        ft, model, config = self._best
+        self.pipeline = TimeSequencePipeline(ft, model, config,
+                                             name=self.name)
+        return self.pipeline

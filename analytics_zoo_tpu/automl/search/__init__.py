@@ -1,0 +1,2 @@
+from .abstract import SearchEngine, TrialOutput  # noqa: F401
+from .local_search import LocalSearchEngine  # noqa: F401

@@ -1,0 +1,26 @@
+"""SearchEngine abstraction (reference ``automl/search/abstract.py``:
+``SearchEngine.compile/run/get_best_trials`` + ``TrialOutput``)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+
+@dataclass
+class TrialOutput:
+    config: Dict[str, Any]
+    metric: float
+    model_path: str = ""
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+class SearchEngine:
+    def compile(self, data, model_create_fn, recipe, metric: str = "mse",
+                **kwargs) -> None:
+        raise NotImplementedError
+
+    def run(self) -> List[TrialOutput]:
+        raise NotImplementedError
+
+    def get_best_trials(self, k: int = 1) -> List[TrialOutput]:
+        raise NotImplementedError

@@ -1,0 +1,130 @@
+"""LocalSearchEngine — the RayTuneSearchEngine role
+(``automl/search/RayTuneSearchEngine.py:28``) without a Ray dependency:
+trial configs are generated from the recipe's space (grid cross-product ×
+random samples, or a GP-surrogate Bayes loop), each trial calls the
+user-provided trainable and the engine ranks results. Trials run
+sequentially by default: one TPU, one process — the accelerator is already
+saturated by a single trial's batched training."""
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import hp
+from ..common.metrics import Evaluator
+from ..config.recipe import Recipe
+from .abstract import SearchEngine, TrialOutput
+
+
+def _expand_grid(space: Dict[str, Any]) -> List[Dict[str, Any]]:
+    grid_keys = [k for k, v in space.items() if isinstance(v, hp.Grid)]
+    if not grid_keys:
+        return [dict(space)]
+    combos = itertools.product(*[space[k].options for k in grid_keys])
+    out = []
+    for combo in combos:
+        point = dict(space)
+        point.update(dict(zip(grid_keys, combo)))
+        out.append(point)
+    return out
+
+
+def _materialize(point: Dict[str, Any], rng: random.Random) -> Dict[str, Any]:
+    return {k: (v.sample(rng) if isinstance(v, hp.Sampler) else v)
+            for k, v in point.items()}
+
+
+class LocalSearchEngine(SearchEngine):
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+        self.trials: List[TrialOutput] = []
+        self._compiled = False
+
+    def compile(self, data, model_create_fn: Callable[[], Any],
+                recipe: Recipe, metric: str = "mse",
+                feature_cols: Optional[Sequence[str]] = None,
+                fit_fn: Optional[Callable] = None) -> None:
+        """``model_create_fn() -> model`` with the trainable contract
+        ``model.fit_eval(data, validation_data, metric, **config) -> float``;
+        or pass ``fit_fn(config, data) -> float`` directly."""
+        self.data = data
+        self.model_create_fn = model_create_fn
+        self.recipe = recipe
+        self.metric = metric
+        self.mode = Evaluator.get_metric_mode(metric)
+        self.space = recipe.search_space(feature_cols)
+        self.fit_fn = fit_fn
+        self._compiled = True
+
+    def _run_trial(self, config: Dict[str, Any]) -> TrialOutput:
+        if self.fit_fn is not None:
+            score = self.fit_fn(config, self.data)
+        else:
+            model = self.model_create_fn()
+            score = model.fit_eval(self.data, metric=self.metric, **config)
+        return TrialOutput(config=config, metric=float(score))
+
+    def run(self) -> List[TrialOutput]:
+        if not self._compiled:
+            raise RuntimeError("compile first")
+        if self.recipe.search_algorithm() == "bayes":
+            self.trials = self._run_bayes()
+            return self.trials
+        points = _expand_grid(self.space)
+        n_samples = max(1, self.recipe.runtime_params()["num_samples"])
+        for point in points:
+            for _ in range(n_samples):
+                config = _materialize(point, self.rng)
+                self.trials.append(self._run_trial(config))
+        return self.trials
+
+    # -- GP-surrogate bayes loop (the BayesOpt role) --------------------------
+
+    def _numeric_keys(self) -> List[str]:
+        keys = []
+        for k, v in self.space.items():
+            if isinstance(v, (hp.Uniform, hp.LogUniform, hp.RandInt)):
+                keys.append(k)
+            elif isinstance(v, hp.Choice) and all(
+                    isinstance(o, (int, float)) for o in v.options):
+                keys.append(k)
+        return keys
+
+    def _run_bayes(self, n_init: int = 3) -> List[TrialOutput]:
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        num_keys = self._numeric_keys()
+        n_total = max(n_init + 1,
+                      self.recipe.runtime_params()["num_samples"])
+        trials: List[TrialOutput] = []
+        configs: List[Dict[str, Any]] = []
+        for i in range(n_total):
+            if i < n_init or not num_keys:
+                config = _materialize(self.space, self.rng)
+            else:
+                # fit GP on numeric projection; pick best of random candidates
+                X = np.asarray([[float(c[k]) for k in num_keys]
+                                for c in configs])
+                y = np.asarray([t.metric for t in trials])
+                if self.mode == "max":
+                    y = -y
+                gp = GaussianProcessRegressor(normalize_y=True).fit(X, y)
+                cands = [_materialize(self.space, self.rng)
+                         for _ in range(32)]
+                Xc = np.asarray([[float(c[k]) for k in num_keys]
+                                 for c in cands])
+                mu, sigma = gp.predict(Xc, return_std=True)
+                best = float(y.min())
+                ei = (best - mu) + 1.0 * sigma  # exploration bonus
+                config = cands[int(np.argmax(ei))]
+            out = self._run_trial(config)
+            trials.append(out)
+            configs.append(config)
+        return trials
+
+    def get_best_trials(self, k: int = 1) -> List[TrialOutput]:
+        reverse = self.mode == "max"
+        return sorted(self.trials, key=lambda t: t.metric,
+                      reverse=reverse)[:k]

@@ -50,8 +50,11 @@ class FnEstimator:
         if isinstance(data, FeatureSet):
             return data
         if mode == ModeKeys.PREDICT:
-            if isinstance(data, tuple) and len(data) == 2:
-                data = data[0]  # shared input_fn returning (x, y): drop labels
+            # contract: PREDICT input_fn returns features only — a LIST for
+            # multi-input models; a 2-TUPLE is read as (features, labels)
+            # from a mode-shared input_fn and the labels are dropped
+            if type(data) is tuple and len(data) == 2:
+                data = data[0]
             # predictions must cover every row on every host — no sharding
             return FeatureSet.from_ndarrays(data, None, shuffle=False,
                                             shard=False)

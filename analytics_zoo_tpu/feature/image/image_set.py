@@ -3,10 +3,10 @@
 ``transform`` chaining + ``toDataSet``).
 
 TPU-host shape: a LocalImageSet holds host images (list of HWC arrays,
-possibly ragged before resize); a DistributedImageSet additionally records a
-shard count for multi-host splits (per-host sharding happens in the
-FeatureSet it lowers into). ``to_featureset`` is the ``ImageSetToSample →
-FeatureSet`` lowering that feeds the device."""
+possibly ragged before resize); a DistributedImageSet lowers with per-host
+sharding enabled (the split itself happens in the FeatureSet).
+``to_featureset`` is the ``ImageSetToSample → FeatureSet`` lowering that
+feeds the device."""
 from __future__ import annotations
 
 import glob
@@ -94,11 +94,8 @@ class LocalImageSet(ImageSet):
 
 class DistributedImageSet(ImageSet):
     """Sharded image collection (reference ``DistributedImageSet:119``) —
-    per-host sharding is applied by the FeatureSet it lowers into."""
-
-    def transform(self, preprocessing: Preprocessing) -> "DistributedImageSet":
-        out = [preprocessing.apply(img) for img in self.images]
-        return DistributedImageSet(out, self.labels, self.paths)
+    per-host sharding is applied by the FeatureSet it lowers into
+    (``transform`` preserves the type via the base's ``type(self)``)."""
 
     def to_featureset(self, **kwargs) -> FeatureSet:
         kwargs.setdefault("shard", True)

@@ -114,24 +114,29 @@ class TextSet:
                                  r.label, uri=f"{r.id1}:{r.id2}")
                      for r in relations]
             return LocalTextSet(feats)
-        # per-side pipeline with a shared word index over both corpora
+        # per-side pipeline with a shared word index over both corpora;
+        # each unique corpus entry is indexed ONCE (queries repeat across
+        # hundreds of relations in ranking datasets)
         both = TextSet.from_texts(
             list(corpus1.values()) + list(corpus2.values()))
         both.tokenize().normalize().word2idx()
         wi = both.get_word_index()
 
-        def side(text: str, length: int) -> np.ndarray:
-            ts = TextSet.from_texts([text]).tokenize().normalize()
-            ts.word2idx(existing_map=wi)
+        def index_corpus(corpus: Dict[str, str], length: int
+                         ) -> Dict[str, np.ndarray]:
+            ids = list(corpus)
+            ts = TextSet.from_texts([corpus[i] for i in ids])
+            ts.tokenize().normalize().word2idx(existing_map=wi)
             ts.shape_sequence(length)
-            return ts.features[0].indices
+            return {i: f.indices for i, f in zip(ids, ts.features)}
 
+        idx1 = index_corpus(corpus1, text1_length)
+        idx2 = index_corpus(corpus2, text2_length)
         feats = []
         for r in relations:
             tf = TextFeature(corpus1[r.id1] + "\n" + corpus2[r.id2], r.label,
                              uri=f"{r.id1}:{r.id2}")
-            tf.indices = np.concatenate([side(corpus1[r.id1], text1_length),
-                                         side(corpus2[r.id2], text2_length)])
+            tf.indices = np.concatenate([idx1[r.id1], idx2[r.id2]])
             feats.append(tf)
         out = LocalTextSet(feats)
         out.word_index = wi

@@ -82,6 +82,12 @@ class FileQueue(QueueBackend):
                 with open(dst) as f:
                     rec = json.load(f)
                 out.append((rec["uri"], rec))
+            except (ValueError, KeyError, OSError):
+                # malformed request file (partial write / foreign producer):
+                # skip it, keep the batch and the serve loop alive
+                import logging
+                logging.getLogger("analytics_zoo_tpu.serving").warning(
+                    "dropping malformed request file %s", name)
             finally:
                 try:
                     os.remove(dst)

@@ -1,0 +1,6 @@
+"""Zouwu — time-series productization of AutoML (reference
+``pyzoo/zoo/zouwu/**``): Forecasters, anomaly detectors, AutoTS."""
+from .model.forecast import (  # noqa: F401
+    Forecaster, LSTMForecaster, MTNetForecaster, Seq2SeqForecaster)
+from .model.anomaly import ThresholdDetector, ThresholdEstimator  # noqa: F401
+from .autots.forecast import AutoTSTrainer, TSPipeline  # noqa: F401

@@ -1,0 +1,47 @@
+"""AutoTS (reference ``zouwu/autots/forecast.py:22,81``): AutoTSTrainer
+drives the AutoML TimeSequencePredictor; TSPipeline wraps the fitted
+pipeline."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ...automl.config.recipe import Recipe, SmokeRecipe
+from ...automl.pipeline.time_sequence import TimeSequencePipeline
+from ...automl.regression.time_sequence_predictor import TimeSequencePredictor
+
+
+class TSPipeline:
+    def __init__(self, internal: TimeSequencePipeline):
+        self.internal = internal
+
+    def predict(self, input_df):
+        return self.internal.predict(input_df)
+
+    def evaluate(self, input_df, metrics: Sequence[str] = ("mse",)):
+        return self.internal.evaluate(input_df, metrics)
+
+    def fit(self, input_df, validation_df=None, epoch_num: int = 1):
+        return self.internal.fit(input_df, validation_df, epoch_num)
+
+    def save(self, path: str) -> None:
+        self.internal.save(path)
+
+    @staticmethod
+    def load(path: str) -> "TSPipeline":
+        return TSPipeline(TimeSequencePipeline.load(path))
+
+
+class AutoTSTrainer:
+    def __init__(self, dt_col: str = "datetime", target_col: str = "value",
+                 horizon: int = 1,
+                 extra_features_col: Optional[Sequence[str]] = None):
+        self.internal = TimeSequencePredictor(
+            dt_col=dt_col, target_col=target_col, future_seq_len=horizon,
+            extra_features_col=extra_features_col)
+
+    def fit(self, train_df, validation_df=None,
+            recipe: Optional[Recipe] = None, metric: str = "mse"
+            ) -> TSPipeline:
+        pipeline = self.internal.fit(train_df, validation_df,
+                                     recipe or SmokeRecipe(), metric)
+        return TSPipeline(pipeline)

@@ -1,0 +1,54 @@
+"""Anomaly detection on forecasts (reference ``zouwu/model/anomaly.py``:
+``ThresholdEstimator.fit`` picks a distance threshold from a target anomaly
+ratio; ``ThresholdDetector.detect`` flags forecast-vs-actual deviations or
+absolute-range violations)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _distance(y: np.ndarray, yhat: np.ndarray) -> np.ndarray:
+    y = np.asarray(y, np.float64).reshape(len(y), -1)
+    yhat = np.asarray(yhat, np.float64).reshape(len(yhat), -1)
+    return np.sqrt(((y - yhat) ** 2).sum(axis=1))
+
+
+class ThresholdEstimator:
+    """Pick the distance threshold matching a target anomaly ratio."""
+
+    def fit(self, y, yhat, mode: str = "default", ratio: float = 0.01
+            ) -> float:
+        dist = _distance(y, yhat)
+        k = max(1, int(round(len(dist) * ratio)))
+        self.th = float(np.sort(dist)[-k])
+        return self.th
+
+
+class ThresholdDetector:
+    """Flag anomalies by forecast distance or absolute range."""
+
+    def __init__(self):
+        self.threshold = None
+
+    def detect(self, y, yhat: Optional[np.ndarray] = None,
+               threshold=None) -> np.ndarray:
+        """Returns indices of anomalous records.
+
+        - with ``yhat``: distance(y, yhat) > threshold (scalar).
+        - without: range check; ``threshold`` = (min, max) bounds.
+        """
+        threshold = threshold if threshold is not None else self.threshold
+        if threshold is None:
+            raise ValueError("no threshold given or fitted")
+        y = np.asarray(y)
+        if yhat is not None:
+            # >= so a ThresholdEstimator-fitted threshold (the k-th largest
+            # distance) flags exactly its target ratio of records
+            dist = _distance(y, yhat)
+            return np.nonzero(dist >= float(threshold))[0]
+        lo, hi = threshold
+        flat = y.reshape(len(y), -1)
+        bad = (flat < lo) | (flat > hi)
+        return np.nonzero(bad.any(axis=1))[0]

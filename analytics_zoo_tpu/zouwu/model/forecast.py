@@ -1,0 +1,89 @@
+"""Forecasters (reference ``zouwu/model/forecast.py``: ``Forecaster`` base
+over TFPark KerasModel, ``LSTMForecaster:49``, ``MTNetForecaster:108``) —
+thin user-facing wrappers over the AutoML trainables with fixed configs."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from ...automl.model import MTNet, TimeSeq2Seq, VanillaLSTM
+
+
+class Forecaster:
+    """fit(x, y) / evaluate / predict over rolled windows."""
+
+    def __init__(self):
+        self.internal = None
+        self.config: Dict[str, Any] = {}
+
+    def fit(self, x, y, validation_data=None, batch_size: int = 32,
+            epochs: int = 1, metric: str = "mse", **kwargs) -> float:
+        config = dict(self.config, batch_size=batch_size, epochs=epochs,
+                      **kwargs)
+        return self.internal.fit_eval(
+            (np.asarray(x, np.float32), np.asarray(y, np.float32)),
+            validation_data=validation_data, metric=metric, **config)
+
+    def evaluate(self, x, y, metrics: Sequence[str] = ("mse",)):
+        return self.internal.evaluate(x, y, metrics=metrics)
+
+    def predict(self, x) -> np.ndarray:
+        return self.internal.predict(x)
+
+    def save(self, path: str) -> None:
+        self.internal.save(path)
+
+    def restore(self, path: str, **config) -> None:
+        self.internal.restore(path, **{**self.config, **config})
+
+
+class LSTMForecaster(Forecaster):
+    def __init__(self, target_dim: int = 1, feature_dim: int = 1,
+                 lstm_1_units: int = 16, dropout_1: float = 0.2,
+                 lstm_2_units: int = 8, dropout_2: float = 0.2,
+                 lr: float = 0.001):
+        super().__init__()
+        self.internal = VanillaLSTM()
+        self.config = {
+            "lstm_1_units": lstm_1_units, "dropout_1": dropout_1,
+            "lstm_2_units": lstm_2_units, "dropout_2": dropout_2,
+            "lr": lr, "future_seq_len": target_dim,
+            "input_dim": feature_dim,
+        }
+
+
+class MTNetForecaster(Forecaster):
+    def __init__(self, target_dim: int = 1, feature_dim: int = 1,
+                 long_series_num: int = 1, series_length: int = 1,
+                 ar_window_size: int = 1, cnn_height: int = 1,
+                 cnn_hid_size: int = 32, lr: float = 0.001):
+        super().__init__()
+        self.internal = MTNet()
+        self.config = {
+            "long_num": long_series_num, "time_step": series_length,
+            "ar_window": ar_window_size, "cnn_height": cnn_height,
+            "cnn_hid_size": cnn_hid_size, "lr": lr,
+            "future_seq_len": target_dim, "input_dim": feature_dim,
+        }
+
+    def preprocess_input(self, x: np.ndarray) -> np.ndarray:
+        """Check/trim the rolled window to (long_num+1)*time_step rows
+        (reference ``MTNetForecaster.preprocess_input``)."""
+        need = self.internal.required_past_seq_len(self.config)
+        x = np.asarray(x, np.float32)
+        if x.shape[1] < need:
+            raise ValueError(f"need past_seq_len >= {need}, got {x.shape[1]}")
+        return x[:, -need:]
+
+
+class Seq2SeqForecaster(Forecaster):
+    def __init__(self, future_seq_len: int = 1, feature_dim: int = 1,
+                 latent_dim: int = 32, num_layers: int = 1,
+                 lr: float = 0.001):
+        super().__init__()
+        self.internal = TimeSeq2Seq()
+        self.config = {
+            "latent_dim": latent_dim, "num_layers": num_layers, "lr": lr,
+            "future_seq_len": future_seq_len, "input_dim": feature_dim,
+        }

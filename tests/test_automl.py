@@ -1,0 +1,212 @@
+"""AutoML + Zouwu tests (reference test strategy: recipes + transformer unit
+tests, small end-to-end searches)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+
+def make_ts_df(n=120, freq_h=1):
+    t = pd.date_range("2025-01-01", periods=n, freq="h")
+    value = (np.sin(np.arange(n) / 8) * 5 + 20
+             + np.random.RandomState(0).rand(n) * 0.1)
+    return pd.DataFrame({"datetime": t, "value": value})
+
+
+class TestMetrics:
+    def test_evaluator(self):
+        from analytics_zoo_tpu.automl import Evaluator
+        y = np.array([1.0, 2.0, 3.0])
+        p = np.array([1.0, 2.0, 4.0])
+        assert Evaluator.evaluate("mse", y, p) == pytest.approx(1 / 3)
+        assert Evaluator.evaluate("rmse", y, p) == pytest.approx(
+            np.sqrt(1 / 3))
+        assert Evaluator.evaluate("mae", y, p) == pytest.approx(1 / 3)
+        assert Evaluator.evaluate("r2", y, y) == pytest.approx(1.0)
+        assert Evaluator.get_metric_mode("r2") == "max"
+        assert Evaluator.get_metric_mode("mse") == "min"
+        with pytest.raises(ValueError):
+            Evaluator.evaluate("nope", y, p)
+
+
+class TestFeatureTransformer:
+    def test_fit_transform_shapes_and_unscale(self):
+        from analytics_zoo_tpu.automl.feature import (
+            TimeSequenceFeatureTransformer)
+        df = make_ts_df(50)
+        ft = TimeSequenceFeatureTransformer(future_seq_len=2)
+        x, y = ft.fit_transform(df, past_seq_len=5,
+                                selected_features=["hour", "is_weekend"])
+        assert x.shape == (44, 5, 3)  # target + 2 features
+        assert y.shape == (44, 2)
+        # unscale round-trips the target
+        raw = ft.post_processing(df, y, is_train=False)
+        np.testing.assert_allclose(raw[0, 0], df["value"].iloc[5], atol=1e-4)
+
+    def test_save_restore(self, tmp_path):
+        from analytics_zoo_tpu.automl.feature import (
+            TimeSequenceFeatureTransformer)
+        df = make_ts_df(30)
+        ft = TimeSequenceFeatureTransformer()
+        ft.fit_transform(df, past_seq_len=4, selected_features=["hour"])
+        path = str(tmp_path / "ft.json")
+        ft.save(path)
+        ft2 = TimeSequenceFeatureTransformer().restore(path)
+        x1, y1 = ft.transform(df)
+        x2, y2 = ft2.transform(df)
+        np.testing.assert_allclose(x1, x2)
+
+    def test_test_mode_windows(self):
+        from analytics_zoo_tpu.automl.feature import (
+            TimeSequenceFeatureTransformer)
+        df = make_ts_df(20)
+        ft = TimeSequenceFeatureTransformer()
+        ft.fit_transform(df, past_seq_len=4)
+        xt = ft.transform(df, is_train=False)
+        assert xt.shape == (17, 4, 1)
+
+
+class TestSearchEngine:
+    def test_grid_and_random_expansion(self, ctx):
+        from analytics_zoo_tpu.automl import hp
+        from analytics_zoo_tpu.automl.config.recipe import Recipe
+        from analytics_zoo_tpu.automl.search import LocalSearchEngine
+
+        class ToyRecipe(Recipe):
+            num_samples = 2
+
+            def search_space(self, feats):
+                return {"a": hp.grid_search([1, 2]),
+                        "b": hp.uniform(0.0, 1.0), "c": 7}
+
+        seen = []
+
+        def fit_fn(config, data):
+            seen.append(config)
+            return (config["a"] - 1.5) ** 2 + config["b"]
+
+        eng = LocalSearchEngine(seed=1)
+        eng.compile(data=None, model_create_fn=None, recipe=ToyRecipe(),
+                    metric="mse", fit_fn=fit_fn)
+        trials = eng.run()
+        assert len(trials) == 4  # 2 grid points x 2 samples
+        assert all(t.config["c"] == 7 for t in trials)
+        best = eng.get_best_trials(1)[0]
+        assert best.metric == min(t.metric for t in trials)
+
+    def test_bayes_engine(self, ctx):
+        from analytics_zoo_tpu.automl import hp
+        from analytics_zoo_tpu.automl.config.recipe import Recipe
+        from analytics_zoo_tpu.automl.search import LocalSearchEngine
+
+        class BayesToy(Recipe):
+            num_samples = 8
+
+            def search_space(self, feats):
+                return {"x": hp.uniform(-2.0, 2.0)}
+
+            def search_algorithm(self):
+                return "bayes"
+
+        def fit_fn(config, data):
+            return (config["x"] - 1.0) ** 2
+
+        eng = LocalSearchEngine(seed=2)
+        eng.compile(data=None, model_create_fn=None, recipe=BayesToy(),
+                    metric="mse", fit_fn=fit_fn)
+        trials = eng.run()
+        assert len(trials) == 8
+        assert eng.get_best_trials(1)[0].metric < 1.0
+
+
+class TestTimeSequencePredictor:
+    def test_smoke_fit_predict_evaluate(self, ctx):
+        from analytics_zoo_tpu.automl import (
+            SmokeRecipe, TimeSequencePredictor)
+        df = make_ts_df(80)
+        tsp = TimeSequencePredictor(future_seq_len=1)
+        pipeline = tsp.fit(df, recipe=SmokeRecipe(), metric="mse")
+        res = pipeline.evaluate(df, metrics=["mse", "smape"])
+        assert "mse" in res and "smape" in res
+        preds = pipeline.predict(df)
+        assert len(preds) > 0
+
+    def test_pipeline_save_load(self, ctx, tmp_path):
+        from analytics_zoo_tpu.automl import (
+            SmokeRecipe, TimeSequencePipeline, TimeSequencePredictor)
+        df = make_ts_df(60)
+        tsp = TimeSequencePredictor(future_seq_len=1)
+        pipeline = tsp.fit(df, recipe=SmokeRecipe(), metric="mse")
+        p1 = pipeline.predict(df)
+        path = str(tmp_path / "pipe")
+        pipeline.save(path)
+        loaded = TimeSequencePipeline.load(path)
+        p2 = loaded.predict(df)
+        np.testing.assert_allclose(p1, p2, atol=1e-4)
+
+
+class TestForecasters:
+    def roll(self, n=80, past=8, future=1):
+        rs = np.random.RandomState(0)
+        series = np.sin(np.arange(n) / 6).astype(np.float32)
+        idx = np.arange(past)[None, :] + np.arange(n - past - future + 1)[:, None]
+        x = series[idx][:, :, None]
+        y = series[idx[:, -1] + future][:, None]
+        return x, y
+
+    def test_lstm_forecaster(self, ctx):
+        from analytics_zoo_tpu.zouwu import LSTMForecaster
+        x, y = self.roll()
+        f = LSTMForecaster(target_dim=1, feature_dim=1, lstm_1_units=8,
+                           lstm_2_units=4)
+        score = f.fit(x, y, batch_size=16, epochs=2)
+        assert np.isfinite(score)
+        assert f.predict(x).shape == (len(x), 1)
+
+    def test_mtnet_forecaster(self, ctx):
+        from analytics_zoo_tpu.zouwu import MTNetForecaster
+        x, y = self.roll(past=8)
+        f = MTNetForecaster(target_dim=1, feature_dim=1, long_series_num=3,
+                            series_length=2, ar_window_size=2, cnn_height=2)
+        score = f.fit(x, y, batch_size=16, epochs=2)
+        assert np.isfinite(score)
+        assert f.predict(x).shape == (len(x), 1)
+
+    def test_seq2seq_forecaster(self, ctx):
+        from analytics_zoo_tpu.zouwu import Seq2SeqForecaster
+        x, y = self.roll(future=1)
+        f = Seq2SeqForecaster(future_seq_len=1, feature_dim=1, latent_dim=8)
+        score = f.fit(x, y, batch_size=16, epochs=2)
+        assert np.isfinite(score)
+        assert f.predict(x).shape == (len(x), 1)
+
+
+class TestAnomaly:
+    def test_threshold_estimator_and_detector(self):
+        from analytics_zoo_tpu.zouwu import (
+            ThresholdDetector, ThresholdEstimator)
+        rs = np.random.RandomState(0)
+        y = rs.rand(100, 1)
+        yhat = y.copy()
+        yhat[7] += 5.0  # one big forecast miss
+        th = ThresholdEstimator().fit(y, yhat, ratio=0.01)
+        det = ThresholdDetector()
+        hits = det.detect(y, yhat, threshold=th)
+        assert 7 in hits and len(hits) == 1
+        # range mode
+        hits2 = det.detect(np.array([[0.5], [9.0], [0.2]]),
+                           threshold=(0.0, 1.0))
+        assert hits2.tolist() == [1]
+
+
+class TestAutoTS:
+    def test_autots_trainer(self, ctx, tmp_path):
+        from analytics_zoo_tpu.zouwu import AutoTSTrainer, TSPipeline
+        df = make_ts_df(70)
+        trainer = AutoTSTrainer(horizon=1)
+        pipe = trainer.fit(df)
+        res = pipe.evaluate(df, metrics=["mse"])
+        assert "mse" in res
+        path = str(tmp_path / "ts")
+        pipe.save(path)
+        loaded = TSPipeline.load(path)
+        assert len(loaded.predict(df)) > 0
