@@ -1,0 +1,4 @@
+from .imageclassification import ImageClassifier, mobilenet, resnet  # noqa: F401
+from .objectdetection import (  # noqa: F401
+    ObjectDetector, SSD, Visualizer, decode_detections)
+from .evaluation import MeanAveragePrecision  # noqa: F401

@@ -1,0 +1,181 @@
+"""Image classification zoo (reference
+``models/image/imageclassification/ImageClassifier.scala`` + per-model
+configs): ResNet / MobileNet-v1 builders in the native Keras layer system,
+an ``ImageClassifier`` ZooModel wrapping any backbone with its preprocessing
+config, top-k labeled predictions over ImageSets.
+
+TPU notes: NHWC convs (MXU-friendly), BatchNorm state in the model-state
+pytree, bf16-ready. ResNet-50 here is the north-star training benchmark
+(BASELINE.json config #2)."""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common import ZooModel, register_zoo_model
+from ...keras import Input, Model
+from ...keras.layers import (
+    Activation, AveragePooling2D, BatchNormalization, Convolution2D, Dense,
+    Flatten, GlobalAveragePooling2D, Lambda, MaxPooling2D, merge)
+
+_RESNET_BLOCKS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3),
+                  101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+
+
+def _conv_bn(x, filters, k, stride=1, activation="relu", name=""):
+    x = Convolution2D(filters, k, k, subsample=(stride, stride),
+                      border_mode="same", bias=False, name=f"{name}_conv")(x)
+    x = BatchNormalization(name=f"{name}_bn")(x)
+    if activation:
+        x = Activation(activation, name=f"{name}_act")(x)
+    return x
+
+
+def _basic_block(x, filters, stride, name):
+    shortcut = x
+    y = _conv_bn(x, filters, 3, stride, "relu", f"{name}_a")
+    y = _conv_bn(y, filters, 3, 1, None, f"{name}_b")
+    if stride != 1 or x.shape[-1] != filters:
+        shortcut = _conv_bn(x, filters, 1, stride, None, f"{name}_sc")
+    return Activation("relu", name=f"{name}_out")(
+        merge([y, shortcut], mode="sum"))
+
+
+def _bottleneck_block(x, filters, stride, name):
+    shortcut = x
+    y = _conv_bn(x, filters, 1, 1, "relu", f"{name}_a")
+    y = _conv_bn(y, filters, 3, stride, "relu", f"{name}_b")
+    y = _conv_bn(y, filters * 4, 1, 1, None, f"{name}_c")
+    if stride != 1 or x.shape[-1] != filters * 4:
+        shortcut = _conv_bn(x, filters * 4, 1, stride, None, f"{name}_sc")
+    return Activation("relu", name=f"{name}_out")(
+        merge([y, shortcut], mode="sum"))
+
+
+def resnet(depth: int = 50, num_classes: int = 1000,
+           input_shape: Tuple[int, int, int] = (224, 224, 3),
+           include_top: bool = True) -> Model:
+    """ResNet-v1 (18/34/50/101/152)."""
+    if depth not in _RESNET_BLOCKS:
+        raise ValueError(f"unsupported depth {depth}; have "
+                         f"{sorted(_RESNET_BLOCKS)}")
+    blocks = _RESNET_BLOCKS[depth]
+    block_fn = _basic_block if depth < 50 else _bottleneck_block
+    inp = Input(input_shape, name="image")
+    x = _conv_bn(inp, 64, 7, 2, "relu", "stem")
+    x = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same",
+                     name="stem_pool")(x)
+    filters = 64
+    for stage, n in enumerate(blocks):
+        for i in range(n):
+            stride = 2 if (i == 0 and stage > 0) else 1
+            x = block_fn(x, filters, stride, f"stage{stage + 1}_block{i + 1}")
+        filters *= 2
+    if not include_top:
+        return Model(inp, x, name=f"resnet{depth}_features")
+    x = GlobalAveragePooling2D(name="avg_pool")(x)
+    out = Dense(num_classes, activation="softmax", name="logits")(x)
+    return Model(inp, out, name=f"resnet{depth}")
+
+
+def mobilenet(num_classes: int = 1000,
+              input_shape: Tuple[int, int, int] = (224, 224, 3),
+              alpha: float = 1.0, include_top: bool = True) -> Model:
+    """MobileNet-v1: depthwise-separable conv stack (depthwise = grouped
+    conv with groups == channels; XLA lowers it onto the VPU/MXU)."""
+    def dw_sep(x, filters, stride, name):
+        cin = x.shape[-1]
+        x = Convolution2D(cin, 3, 3, subsample=(stride, stride),
+                          border_mode="same", bias=False, groups=cin,
+                          name=f"{name}_dw")(x)
+        x = BatchNormalization(name=f"{name}_dw_bn")(x)
+        x = Activation("relu", name=f"{name}_dw_act")(x)
+        return _conv_bn(x, filters, 1, 1, "relu", f"{name}_pw")
+
+    def c(f):
+        return max(8, int(f * alpha))
+
+    inp = Input(input_shape, name="image")
+    x = _conv_bn(inp, c(32), 3, 2, "relu", "stem")
+    cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+           (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+           (1024, 1)]
+    for i, (f, s) in enumerate(cfg):
+        x = dw_sep(x, c(f), s, f"block{i + 1}")
+    if not include_top:
+        return Model(inp, x, name="mobilenet_features")
+    x = GlobalAveragePooling2D(name="avg_pool")(x)
+    out = Dense(num_classes, activation="softmax", name="logits")(x)
+    return Model(inp, out, name="mobilenet")
+
+
+_BACKBONES: Dict[str, Callable] = {
+    "resnet18": lambda n, s: resnet(18, n, s),
+    "resnet34": lambda n, s: resnet(34, n, s),
+    "resnet50": lambda n, s: resnet(50, n, s),
+    "resnet101": lambda n, s: resnet(101, n, s),
+    "mobilenet": lambda n, s: mobilenet(n, s),
+}
+
+
+@register_zoo_model
+class ImageClassifier(ZooModel):
+    """Config-driven classifier (reference ``ImageClassifier`` + label maps).
+
+    ``predict_image_set`` runs the model's preprocessing chain over an
+    ImageSet and returns top-k (label, prob) per image."""
+
+    def __init__(self, model_name: str = "resnet50", num_classes: int = 1000,
+                 input_shape: Sequence[int] = (224, 224, 3),
+                 labels: Optional[List[str]] = None):
+        super().__init__()
+        if model_name not in _BACKBONES:
+            raise ValueError(f"unknown model_name {model_name}; have "
+                             f"{sorted(_BACKBONES)}")
+        self.model_name = model_name
+        self.num_classes = num_classes
+        self.input_shape = tuple(input_shape)
+        self.labels = labels
+
+    def get_config(self) -> Dict[str, Any]:
+        return {"model_name": self.model_name,
+                "num_classes": self.num_classes,
+                "input_shape": list(self.input_shape),
+                "labels": self.labels}
+
+    def build_model(self) -> Model:
+        return _BACKBONES[self.model_name](self.num_classes, self.input_shape)
+
+    def default_compile(self):
+        self.compile(optimizer="adam",
+                     loss="sparse_categorical_crossentropy",
+                     metrics=["accuracy"])
+
+    def preprocessing(self):
+        """The model's input chain (reference per-model configs)."""
+        from ...feature.image import (
+            ChannelNormalize, ImageSetToSample, Resize)
+        h, w, _ = self.input_shape
+        return (Resize(h, w)
+                >> ChannelNormalize([123.68, 116.78, 103.94], [58.4, 57.1, 57.4])
+                >> ImageSetToSample())
+
+    def predict_image_set(self, image_set, top_k: int = 5,
+                          batch_size: int = 32):
+        """Top-k labeled predictions per image (reference
+        ``ImageClassifier.predictImageSet`` + label map output)."""
+        fs = image_set.transform(self.preprocessing()).to_featureset(
+            shuffle=False, shard=False)
+        probs = np.asarray(self.predict(None, batch_size=batch_size,
+                                        featureset=fs)
+                           if False else
+                           self._ensure_built().get_estimator().predict(
+                               fs, batch_size=batch_size))
+        top = np.argsort(-probs, axis=1)[:, :top_k]
+        out = []
+        for row, p in zip(top, probs):
+            labeled = [((self.labels[i] if self.labels else int(i)),
+                        float(p[i])) for i in row]
+            out.append(labeled)
+        return out
