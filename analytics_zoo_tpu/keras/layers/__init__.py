@@ -1,12 +1,30 @@
 from .core import (  # noqa: F401
     Activation, Dense, Dropout, ElementwiseOp, Flatten, Lambda, Merge, Permute,
     RepeatVector, Reshape, Select, Squeeze, get_activation, merge)
-from .embedding import Embedding, WordEmbedding  # noqa: F401
+from .embedding import (  # noqa: F401
+    Embedding, SparseDense, SparseEmbedding, WordEmbedding)
 from .norm import BatchNormalization, LayerNormalization  # noqa: F401
-from .recurrent import GRU, LSTM, Bidirectional, SimpleRNN  # noqa: F401
+from .recurrent import (  # noqa: F401
+    GRU, LSTM, Bidirectional, ConvLSTM2D, SimpleRNN)
 from .conv import (  # noqa: F401
     AveragePooling2D, Conv1D, Conv2D, Convolution1D, Convolution2D,
     GlobalAveragePooling1D, GlobalAveragePooling2D, GlobalMaxPooling1D,
     GlobalMaxPooling2D, MaxPooling1D, MaxPooling2D, ZeroPadding2D)
+from .conv_extended import (  # noqa: F401
+    AtrousConvolution1D, AtrousConvolution2D, AveragePooling1D,
+    AveragePooling3D, Conv3D, Convolution3D, Cropping1D, Cropping2D,
+    Cropping3D, Deconvolution2D, GlobalAveragePooling3D, GlobalMaxPooling3D,
+    LocallyConnected1D, LocallyConnected2D, LRN2D, MaxPooling3D,
+    ResizeBilinear, SeparableConvolution2D, ShareConvolution2D, UpSampling1D,
+    UpSampling2D, UpSampling3D, WithinChannelLRN2D, ZeroPadding1D,
+    ZeroPadding3D)
+from .advanced import (  # noqa: F401
+    AddConstant, BinaryThreshold, CAdd, CMul, ELU, Exp, Expand, ExpandDim,
+    GaussianDropout, GaussianNoise, GaussianSampler, HardShrink, HardTanh,
+    Highway, Identity, LeakyReLU, Log, Masking, Max, MaxoutDense, Mul,
+    MulConstant, Narrow, Negative, Power, PReLU, RReLU, Scale, SelectTable,
+    Softmax, SoftShrink, SpatialDropout1D, SpatialDropout2D, SpatialDropout3D,
+    SplitTensor, Sqrt, Square, SReLU, Threshold, ThresholdedReLU,
+    TimeDistributed)
 from .attention import (  # noqa: F401
     BERT, MultiHeadAttention, TransformerLayer)

@@ -1,0 +1,561 @@
+"""Advanced activations, noise, and tensor-manipulation layers.
+
+Reference: ``zoo/src/main/scala/com/intel/analytics/zoo/pipeline/api/keras/
+layers/{ELU,LeakyReLU,PReLU,SReLU,RReLU,ThresholdedReLU,Threshold,
+BinaryThreshold,HardTanh,HardShrink,SoftShrink,Softmax,GaussianDropout,
+GaussianNoise,GaussianSampler,SpatialDropout1D,SpatialDropout2D,
+SpatialDropout3D,Masking,Highway,MaxoutDense,TimeDistributed,SelectTable,
+SplitTensor,Narrow,Expand,ExpandDim,AddConstant,MulConstant,CAdd,CMul,Mul,
+Scale,Exp,Log,Sqrt,Square,Power,Negative,Identity,Max}.scala``.
+
+All layers are pure elementwise/reshape ops XLA fuses into adjacent matmuls;
+stochastic layers draw from the per-call ``rng`` so they stay functional.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..engine import Layer
+
+
+# -- parametric / fixed activations ------------------------------------------
+
+
+class _UnaryOp(Layer):
+    """Base for stateless unary elementwise layers."""
+
+    def _fn(self, x):
+        raise NotImplementedError
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return self._fn(inputs), state
+
+
+class ELU(_UnaryOp):
+    def __init__(self, alpha: float = 1.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.alpha = alpha
+
+    def _fn(self, x):
+        return jnp.where(x > 0, x, self.alpha * jnp.expm1(x))
+
+
+class LeakyReLU(_UnaryOp):
+    def __init__(self, alpha: float = 0.01, name: Optional[str] = None):
+        super().__init__(name)
+        self.alpha = alpha
+
+    def _fn(self, x):
+        return jnp.where(x > 0, x, self.alpha * x)
+
+
+class ThresholdedReLU(_UnaryOp):
+    def __init__(self, theta: float = 1.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.theta = theta
+
+    def _fn(self, x):
+        return jnp.where(x > self.theta, x, 0.0)
+
+
+class Threshold(_UnaryOp):
+    def __init__(self, th: float = 1e-6, v: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.th, self.v = th, v
+
+    def _fn(self, x):
+        return jnp.where(x > self.th, x, self.v)
+
+
+class BinaryThreshold(_UnaryOp):
+    def __init__(self, value: float = 1e-6, name: Optional[str] = None):
+        super().__init__(name)
+        self.value = value
+
+    def _fn(self, x):
+        return (x > self.value).astype(x.dtype)
+
+
+class HardTanh(_UnaryOp):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.min_value, self.max_value = min_value, max_value
+
+    def _fn(self, x):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class HardShrink(_UnaryOp):
+    def __init__(self, value: float = 0.5, name: Optional[str] = None):
+        super().__init__(name)
+        self.value = value
+
+    def _fn(self, x):
+        return jnp.where(jnp.abs(x) > self.value, x, 0.0)
+
+
+class SoftShrink(_UnaryOp):
+    def __init__(self, value: float = 0.5, name: Optional[str] = None):
+        super().__init__(name)
+        self.value = value
+
+    def _fn(self, x):
+        return jnp.sign(x) * jnp.maximum(jnp.abs(x) - self.value, 0.0)
+
+
+class Softmax(_UnaryOp):
+    def _fn(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+
+class Exp(_UnaryOp):
+    def _fn(self, x):
+        return jnp.exp(x)
+
+
+class Log(_UnaryOp):
+    def _fn(self, x):
+        return jnp.log(x)
+
+
+class Sqrt(_UnaryOp):
+    def _fn(self, x):
+        return jnp.sqrt(x)
+
+
+class Square(_UnaryOp):
+    def _fn(self, x):
+        return x * x
+
+
+class Negative(_UnaryOp):
+    def _fn(self, x):
+        return -x
+
+
+class Identity(_UnaryOp):
+    def _fn(self, x):
+        return x
+
+
+class Power(_UnaryOp):
+    """(shift + scale * x) ** power (reference ``Power.scala``)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def _fn(self, x):
+        return jnp.power(self.shift + self.scale * x, self.power)
+
+
+class AddConstant(_UnaryOp):
+    def __init__(self, constant: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.constant = constant
+
+    def _fn(self, x):
+        return x + self.constant
+
+
+class MulConstant(_UnaryOp):
+    def __init__(self, constant: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.constant = constant
+
+    def _fn(self, x):
+        return x * self.constant
+
+
+class PReLU(Layer):
+    """Learned per-channel leak (reference ``PReLU.scala``)."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+
+    def build(self, rng, input_shape):
+        return {"alpha": jnp.full((input_shape[-1],), 0.25)}, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        a = params["alpha"].astype(inputs.dtype)
+        return jnp.where(inputs > 0, inputs, a * inputs), state
+
+
+class SReLU(Layer):
+    """S-shaped ReLU with four learned per-channel params
+    (reference ``SReLU.scala``)."""
+
+    def build(self, rng, input_shape):
+        c = input_shape[-1]
+        return {"t_left": jnp.zeros((c,)), "a_left": jnp.full((c,), 0.2),
+                "t_right": jnp.ones((c,)), "a_right": jnp.ones((c,))}, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        tl = params["t_left"].astype(inputs.dtype)
+        al = params["a_left"].astype(inputs.dtype)
+        tr = params["t_right"].astype(inputs.dtype)
+        ar = params["a_right"].astype(inputs.dtype)
+        y = jnp.where(inputs < tl, tl + al * (inputs - tl), inputs)
+        return jnp.where(inputs > tr, tr + ar * (inputs - tr), y), state
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU: leak ~ U(lower, upper) in training, fixed mean
+    at inference (reference ``RReLU.scala``)."""
+
+    def __init__(self, lower: float = 1 / 8., upper: float = 1 / 3.,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.lower, self.upper = lower, upper
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        if training and rng is not None:
+            a = jax.random.uniform(rng, inputs.shape, inputs.dtype,
+                                   self.lower, self.upper)
+        else:
+            a = (self.lower + self.upper) / 2
+        return jnp.where(inputs >= 0, inputs, a * inputs), state
+
+
+# -- stochastic regularisers --------------------------------------------------
+
+
+class GaussianDropout(Layer):
+    def __init__(self, p: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.rate = p
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        if not training or rng is None or self.rate <= 0:
+            return inputs, state
+        stddev = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + stddev * jax.random.normal(rng, inputs.shape, inputs.dtype)
+        return inputs * noise, state
+
+
+class GaussianNoise(Layer):
+    def __init__(self, sigma: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.sigma = sigma
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        if not training or rng is None:
+            return inputs, state
+        return inputs + self.sigma * jax.random.normal(
+            rng, inputs.shape, inputs.dtype), state
+
+
+class GaussianSampler(Layer):
+    """Samples from N(mean, exp(log_var/2)) given [mean, log_var]
+    (reference ``GaussianSampler.scala``, the VAE reparam trick)."""
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        mean, log_var = inputs
+        if rng is None:
+            raise ValueError(
+                "GaussianSampler needs an rng (pass rng= to call/fit); "
+                "a fixed seed would make every 'sample' identical")
+        eps = jax.random.normal(rng, mean.shape, mean.dtype)
+        return mean + jnp.exp(log_var / 2) * eps, state
+
+    def compute_output_shape(self, input_shape):
+        return input_shape[0]
+
+
+class _SpatialDropout(Layer):
+    """Drops whole feature maps (reference ``SpatialDropout{1,2,3}D.scala``)."""
+
+    _spatial_axes: Tuple[int, ...] = ()
+
+    def __init__(self, p: float = 0.5, name: Optional[str] = None):
+        super().__init__(name)
+        self.rate = p
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        if not training or rng is None or self.rate <= 0:
+            return inputs, state
+        shape = list(inputs.shape)
+        for ax in self._spatial_axes:
+            shape[ax] = 1
+        keep = jax.random.bernoulli(rng, 1.0 - self.rate, tuple(shape))
+        return inputs * keep.astype(inputs.dtype) / (1.0 - self.rate), state
+
+
+class SpatialDropout1D(_SpatialDropout):
+    _spatial_axes = (1,)
+
+
+class SpatialDropout2D(_SpatialDropout):
+    _spatial_axes = (1, 2)
+
+
+class SpatialDropout3D(_SpatialDropout):
+    _spatial_axes = (1, 2, 3)
+
+
+# -- structural layers --------------------------------------------------------
+
+
+class Masking(Layer):
+    """Zeroes timesteps equal to ``mask_value`` (reference ``Masking.scala``)."""
+
+    def __init__(self, mask_value: float = 0.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.mask_value = mask_value
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        keep = jnp.any(inputs != self.mask_value, axis=-1, keepdims=True)
+        return inputs * keep.astype(inputs.dtype), state
+
+
+class Highway(Layer):
+    """Dense highway: y = t * h(x) + (1 - t) * x (reference ``Highway.scala``)."""
+
+    def __init__(self, activation="tanh", bias: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        from .core import get_activation
+        self.activation = get_activation(activation)
+        self.use_bias = bias
+
+    def build(self, rng, input_shape):
+        from .. import initializers
+        d = input_shape[-1]
+        k1, k2 = jax.random.split(rng)
+        init = initializers.get("glorot_uniform")
+        params = {"kernel": init(k1, (d, d)), "gate_kernel": init(k2, (d, d))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((d,))
+            # negative gate bias: start as identity-carry (standard highway init)
+            params["gate_bias"] = jnp.full((d,), -2.0)
+        return params, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        h = inputs @ params["kernel"].astype(inputs.dtype)
+        t = inputs @ params["gate_kernel"].astype(inputs.dtype)
+        if self.use_bias:
+            h = h + params["bias"].astype(h.dtype)
+            t = t + params["gate_bias"].astype(t.dtype)
+        t = jax.nn.sigmoid(t)
+        return t * self.activation(h) + (1 - t) * inputs, state
+
+
+class MaxoutDense(Layer):
+    """Max over ``nb_feature`` linear maps (reference ``MaxoutDense.scala``).
+    One [D, P*F] matmul then a reshape+max — a single MXU tile."""
+
+    def __init__(self, output_dim: int, nb_feature: int = 4, bias: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.output_dim = output_dim
+        self.nb_feature = nb_feature
+        self.use_bias = bias
+
+    def build(self, rng, input_shape):
+        from .. import initializers
+        d = input_shape[-1]
+        init = initializers.get("glorot_uniform")
+        params = {"kernel": init(rng, (d, self.nb_feature * self.output_dim))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.nb_feature * self.output_dim,))
+        return params, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        y = inputs @ params["kernel"].astype(inputs.dtype)
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        y = y.reshape(y.shape[:-1] + (self.nb_feature, self.output_dim))
+        return jnp.max(y, axis=-2), state
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
+class TimeDistributed(Layer):
+    """Applies an inner layer to every timestep (reference
+    ``TimeDistributed.scala``) by folding time into batch — no scan needed,
+    one big fused call."""
+
+    def __init__(self, layer: Layer, name: Optional[str] = None):
+        super().__init__(name)
+        self.inner = layer
+
+    def build(self, rng, input_shape):
+        inner_shape = (input_shape[0],) + tuple(input_shape[2:])
+        return self.inner.build(rng, inner_shape)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        b, t = inputs.shape[0], inputs.shape[1]
+        flat = inputs.reshape((b * t,) + inputs.shape[2:])
+        y, new_state = self.inner.call(params, state, flat,
+                                       training=training, rng=rng)
+        return y.reshape((b, t) + y.shape[1:]), new_state
+
+    def compute_output_shape(self, input_shape):
+        inner_shape = (input_shape[0],) + tuple(input_shape[2:])
+        out = self.inner.compute_output_shape(inner_shape)
+        return (input_shape[0], input_shape[1]) + tuple(out[1:])
+
+
+class SelectTable(Layer):
+    """Picks the i-th tensor from a list input (reference
+    ``SelectTable.scala``)."""
+
+    def __init__(self, index: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.index = index
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return inputs[self.index], state
+
+    def compute_output_shape(self, input_shape):
+        return input_shape[self.index]
+
+
+class SplitTensor(Layer):
+    """Splits along an axis into ``num_split`` outputs (reference
+    ``SplitTensor.scala``)."""
+
+    def __init__(self, split_dim: int, num_split: int,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.split_dim = split_dim
+        self.num_split = num_split
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return list(jnp.split(inputs, self.num_split, axis=self.split_dim)), state
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        if shape[self.split_dim] is not None:
+            shape[self.split_dim] //= self.num_split
+        return [tuple(shape)] * self.num_split
+
+
+class Narrow(Layer):
+    """Slice [offset, offset+length) along ``dim`` (reference
+    ``Narrow.scala``)."""
+
+    def __init__(self, dim: int, offset: int, length: int = 1,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dim, self.offset, self.length = dim, offset, length
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        idx = [slice(None)] * inputs.ndim
+        idx[self.dim] = slice(self.offset, self.offset + self.length)
+        return inputs[tuple(idx)], state
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        shape[self.dim] = self.length
+        return tuple(shape)
+
+
+class Expand(Layer):
+    """Broadcast singleton dims to ``shape`` (reference ``InternalExpand``)."""
+
+    def __init__(self, shape: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.target = tuple(shape)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        target = (inputs.shape[0],) + self.target
+        return jnp.broadcast_to(inputs, target), state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + self.target
+
+
+class ExpandDim(Layer):
+    def __init__(self, dim: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return jnp.expand_dims(inputs, self.dim), state
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        d = self.dim if self.dim >= 0 else len(shape) + 1 + self.dim
+        shape.insert(d, 1)
+        return tuple(shape)
+
+
+class Max(Layer):
+    """Max over ``dim``, optionally keeping it (reference ``Max.scala``)."""
+
+    def __init__(self, dim: int, keep_dim: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dim, self.keep_dim = dim, keep_dim
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return jnp.max(inputs, axis=self.dim, keepdims=self.keep_dim), state
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        if self.keep_dim:
+            shape[self.dim] = 1
+        else:
+            del shape[self.dim]
+        return tuple(shape)
+
+
+class CAdd(Layer):
+    """Learned bias of arbitrary broadcast shape (reference ``CAdd.scala``)."""
+
+    def __init__(self, size: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def build(self, rng, input_shape):
+        return {"bias": jnp.zeros(self.size)}, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return inputs + params["bias"].astype(inputs.dtype), state
+
+
+class CMul(Layer):
+    """Learned scale of arbitrary broadcast shape (reference ``CMul.scala``)."""
+
+    def __init__(self, size: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def build(self, rng, input_shape):
+        return {"weight": jnp.ones(self.size)}, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return inputs * params["weight"].astype(inputs.dtype), state
+
+
+class Mul(Layer):
+    """Single learned scalar multiplier (reference ``Mul.scala``)."""
+
+    def build(self, rng, input_shape):
+        return {"weight": jnp.ones(())}, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return inputs * params["weight"].astype(inputs.dtype), state
+
+
+class Scale(Layer):
+    """Per-channel affine: x * w + b (reference ``Scale.scala``)."""
+
+    def __init__(self, size: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def build(self, rng, input_shape):
+        return {"weight": jnp.ones(self.size), "bias": jnp.zeros(self.size)}, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return (inputs * params["weight"].astype(inputs.dtype)
+                + params["bias"].astype(inputs.dtype)), state

@@ -1,0 +1,646 @@
+"""Extended convolution / pooling / resampling layers.
+
+Reference: ``zoo/src/main/scala/com/intel/analytics/zoo/pipeline/api/keras/
+layers/{Convolution3D,Deconvolution2D,SeparableConvolution2D,
+AtrousConvolution1D,AtrousConvolution2D,LocallyConnected1D,LocallyConnected2D,
+ShareConvolution2D,AveragePooling1D,AveragePooling3D,MaxPooling3D,
+GlobalAveragePooling3D,GlobalMaxPooling3D,Cropping1D,Cropping2D,Cropping3D,
+UpSampling1D,UpSampling2D,UpSampling3D,ZeroPadding1D,ZeroPadding3D,
+ResizeBilinear,LRN2D,WithinChannelLRN2D}.scala``.
+
+TPU design notes: all convs go through ``lax.conv_general_dilated`` in
+channels-last layouts so XLA tiles onto the MXU; 3D uses NDHWC. Transposed
+conv uses ``lax.conv_transpose``. Locally-connected layers materialise a
+position-indexed kernel and contract with ``einsum`` (one big MXU matmul,
+not a Python loop over positions).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import initializers
+from ..engine import Layer
+from .conv import _conv_out, _pair
+from .core import get_activation
+
+
+def _triple(v) -> Tuple[int, int, int]:
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v, v, v)
+
+
+class Convolution3D(Layer):
+    """3D conv over NDHWC volumes (reference ``Convolution3D.scala``)."""
+
+    def __init__(self, nb_filter: int, kernel_dim1: int, kernel_dim2: int,
+                 kernel_dim3: int, activation=None, subsample=(1, 1, 1),
+                 border_mode="valid", init="glorot_uniform", bias: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = nb_filter
+        self.kernel_size = (kernel_dim1, kernel_dim2, kernel_dim3)
+        self.strides = _triple(subsample)
+        self.padding = "SAME" if border_mode == "same" else "VALID"
+        self.activation = get_activation(activation)
+        self.init = initializers.get(init)
+        self.use_bias = bias
+
+    def build(self, rng, input_shape):
+        cin = input_shape[-1]
+        kd, kh, kw = self.kernel_size
+        params = {"kernel": self.init(rng, (kd, kh, kw, cin, self.filters))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,))
+        return params, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        y = lax.conv_general_dilated(
+            inputs, params["kernel"].astype(inputs.dtype),
+            window_strides=self.strides, padding=self.padding,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        n, d, h, w, _ = input_shape
+        kd, kh, kw = self.kernel_size
+        sd, sh, sw = self.strides
+        return (n, _conv_out(d, kd, sd, self.padding),
+                _conv_out(h, kh, sh, self.padding),
+                _conv_out(w, kw, sw, self.padding), self.filters)
+
+
+Conv3D = Convolution3D
+
+
+class Deconvolution2D(Layer):
+    """Transposed 2D conv (reference ``Deconvolution2D.scala``)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample=(1, 1), border_mode="valid",
+                 init="glorot_uniform", bias: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = nb_filter
+        self.kernel_size = (nb_row, nb_col)
+        self.strides = _pair(subsample)
+        self.padding = "SAME" if border_mode == "same" else "VALID"
+        self.activation = get_activation(activation)
+        self.init = initializers.get(init)
+        self.use_bias = bias
+
+    def build(self, rng, input_shape):
+        cin = input_shape[-1]
+        kh, kw = self.kernel_size
+        params = {"kernel": self.init(rng, (kh, kw, cin, self.filters))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,))
+        return params, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        y = lax.conv_transpose(
+            inputs, params["kernel"].astype(inputs.dtype),
+            strides=self.strides, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        n, h, w, _ = input_shape
+        kh, kw = self.kernel_size
+        sh, sw = self.strides
+
+        def up(size, k, s):
+            if size is None:
+                return None
+            if self.padding == "SAME":
+                return size * s
+            return size * s + max(k - s, 0)
+
+        return (n, up(h, kh, sh), up(w, kw, sw), self.filters)
+
+
+class SeparableConvolution2D(Layer):
+    """Depthwise + pointwise conv (reference ``SeparableConvolution2D.scala``).
+
+    Depthwise = grouped conv with ``feature_group_count=cin``; the pointwise
+    1x1 is a plain MXU matmul over channels.
+    """
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample=(1, 1), border_mode="valid",
+                 depth_multiplier: int = 1, init="glorot_uniform",
+                 bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = nb_filter
+        self.kernel_size = (nb_row, nb_col)
+        self.strides = _pair(subsample)
+        self.padding = "SAME" if border_mode == "same" else "VALID"
+        self.depth_multiplier = depth_multiplier
+        self.activation = get_activation(activation)
+        self.init = initializers.get(init)
+        self.use_bias = bias
+
+    def build(self, rng, input_shape):
+        cin = input_shape[-1]
+        kh, kw = self.kernel_size
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "depthwise": self.init(k1, (kh, kw, 1, cin * self.depth_multiplier)),
+            "pointwise": self.init(k2, (1, 1, cin * self.depth_multiplier,
+                                        self.filters)),
+        }
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,))
+        return params, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        cin = inputs.shape[-1]
+        y = lax.conv_general_dilated(
+            inputs, params["depthwise"].astype(inputs.dtype),
+            window_strides=self.strides, padding=self.padding,
+            feature_group_count=cin,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = lax.conv_general_dilated(
+            y, params["pointwise"].astype(y.dtype),
+            window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        n, h, w, _ = input_shape
+        kh, kw = self.kernel_size
+        sh, sw = self.strides
+        return (n, _conv_out(h, kh, sh, self.padding),
+                _conv_out(w, kw, sw, self.padding), self.filters)
+
+
+class AtrousConvolution2D(Layer):
+    """Dilated 2D conv (reference ``AtrousConvolution2D.scala``)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample=(1, 1), atrous_rate=(1, 1),
+                 border_mode="valid", init="glorot_uniform", bias: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        from .conv import Convolution2D
+        self._conv = Convolution2D(
+            nb_filter, nb_row, nb_col, activation=activation,
+            subsample=subsample, border_mode=border_mode, init=init,
+            bias=bias, dilation=_pair(atrous_rate), name=(name or self.name) + "_inner")
+        self.atrous_rate = _pair(atrous_rate)
+
+    def build(self, rng, input_shape):
+        return self._conv.build(rng, input_shape)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return self._conv.call(params, state, inputs, training=training, rng=rng)
+
+    def compute_output_shape(self, input_shape):
+        n, h, w, _ = input_shape
+        kh, kw = self._conv.kernel_size
+        dh, dw = self.atrous_rate
+        sh, sw = self._conv.strides
+        eff_kh = kh + (kh - 1) * (dh - 1)
+        eff_kw = kw + (kw - 1) * (dw - 1)
+        return (n, _conv_out(h, eff_kh, sh, self._conv.padding),
+                _conv_out(w, eff_kw, sw, self._conv.padding), self._conv.filters)
+
+
+class AtrousConvolution1D(Layer):
+    """Dilated 1D conv (reference ``AtrousConvolution1D.scala``)."""
+
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 subsample_length: int = 1, atrous_rate: int = 1,
+                 border_mode="valid", init="glorot_uniform", bias: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = nb_filter
+        self.kernel_size = filter_length
+        self.stride = subsample_length
+        self.rate = atrous_rate
+        self.padding = "SAME" if border_mode == "same" else "VALID"
+        self.activation = get_activation(activation)
+        self.init = initializers.get(init)
+        self.use_bias = bias
+
+    def build(self, rng, input_shape):
+        cin = input_shape[-1]
+        params = {"kernel": self.init(rng, (self.kernel_size, cin, self.filters))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,))
+        return params, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        y = lax.conv_general_dilated(
+            inputs, params["kernel"].astype(inputs.dtype),
+            window_strides=(self.stride,), padding=self.padding,
+            rhs_dilation=(self.rate,),
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        n, l, _ = input_shape
+        eff_k = self.kernel_size + (self.kernel_size - 1) * (self.rate - 1)
+        return (n, _conv_out(l, eff_k, self.stride, self.padding), self.filters)
+
+
+class ShareConvolution2D(Layer):
+    """Weight-shared conv used by SSD heads (reference
+    ``ShareConvolution2D.scala``); functionally a Convolution2D here since
+    JAX params are shared by passing the same pytree."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(kwargs.pop("name", None))
+        from .conv import Convolution2D
+        self._conv = Convolution2D(*args, **kwargs)
+
+    def build(self, rng, input_shape):
+        return self._conv.build(rng, input_shape)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return self._conv.call(params, state, inputs, training=training, rng=rng)
+
+    def compute_output_shape(self, input_shape):
+        return self._conv.compute_output_shape(input_shape)
+
+
+class LocallyConnected1D(Layer):
+    """Per-position (unshared) 1D conv (reference ``LocallyConnected1D.scala``).
+
+    Materialised as an einsum over [L_out, K*Cin, F] position-kernels — a
+    single batched matmul on the MXU rather than per-position loops.
+    """
+
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 subsample_length: int = 1, border_mode="valid",
+                 init="glorot_uniform", bias: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        if border_mode != "valid":
+            raise ValueError("LocallyConnected1D only supports border_mode='valid'")
+        self.filters = nb_filter
+        self.kernel_size = filter_length
+        self.stride = subsample_length
+        self.activation = get_activation(activation)
+        self.init = initializers.get(init)
+        self.use_bias = bias
+
+    def _out_len(self, l):
+        return (l - self.kernel_size) // self.stride + 1
+
+    def build(self, rng, input_shape):
+        _, l, cin = input_shape
+        lo = self._out_len(l)
+        params = {"kernel": self.init(
+            rng, (lo, self.kernel_size * cin, self.filters))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((lo, self.filters))
+        return params, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        b, l, cin = inputs.shape
+        lo = self._out_len(l)
+        idx = (jnp.arange(lo)[:, None] * self.stride
+               + jnp.arange(self.kernel_size)[None, :])  # [Lo, K]
+        patches = inputs[:, idx, :].reshape(b, lo, self.kernel_size * cin)
+        y = jnp.einsum("blk,lkf->blf", patches,
+                       params["kernel"].astype(inputs.dtype))
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        n, l, _ = input_shape
+        return (n, None if l is None else self._out_len(l), self.filters)
+
+
+class LocallyConnected2D(Layer):
+    """Per-position (unshared) 2D conv (reference ``LocallyConnected2D.scala``)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample=(1, 1), border_mode="valid",
+                 init="glorot_uniform", bias: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        if border_mode != "valid":
+            raise ValueError("LocallyConnected2D only supports border_mode='valid'")
+        self.filters = nb_filter
+        self.kernel_size = (nb_row, nb_col)
+        self.strides = _pair(subsample)
+        self.activation = get_activation(activation)
+        self.init = initializers.get(init)
+        self.use_bias = bias
+
+    def _out_hw(self, h, w):
+        kh, kw = self.kernel_size
+        sh, sw = self.strides
+        return (h - kh) // sh + 1, (w - kw) // sw + 1
+
+    def build(self, rng, input_shape):
+        _, h, w, cin = input_shape
+        ho, wo = self._out_hw(h, w)
+        kh, kw = self.kernel_size
+        params = {"kernel": self.init(
+            rng, (ho * wo, kh * kw * cin, self.filters))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((ho * wo, self.filters))
+        return params, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        b, h, w, cin = inputs.shape
+        ho, wo = self._out_hw(h, w)
+        kh, kw = self.kernel_size
+        sh, sw = self.strides
+        ridx = jnp.arange(ho)[:, None] * sh + jnp.arange(kh)[None, :]  # [Ho,Kh]
+        cidx = jnp.arange(wo)[:, None] * sw + jnp.arange(kw)[None, :]  # [Wo,Kw]
+        # gather patches -> [B, Ho, Kh, Wo, Kw, C] -> [B, Ho*Wo, Kh*Kw*C]
+        patches = inputs[:, ridx, :, :][:, :, :, cidx, :]
+        patches = patches.transpose(0, 1, 3, 2, 4, 5).reshape(
+            b, ho * wo, kh * kw * cin)
+        y = jnp.einsum("blk,lkf->blf", patches,
+                       params["kernel"].astype(inputs.dtype))
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return self.activation(y).reshape(b, ho, wo, self.filters), state
+
+    def compute_output_shape(self, input_shape):
+        n, h, w, _ = input_shape
+        if h is None or w is None:
+            return (n, None, None, self.filters)
+        ho, wo = self._out_hw(h, w)
+        return (n, ho, wo, self.filters)
+
+
+# -- pooling extras ----------------------------------------------------------
+
+
+class AveragePooling1D(Layer):
+    def __init__(self, pool_length=2, stride=None, border_mode="valid",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.pool = pool_length
+        self.stride = stride or pool_length
+        self.padding = "SAME" if border_mode == "same" else "VALID"
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        y = lax.reduce_window(inputs, 0.0, lax.add, (1, self.pool, 1),
+                              (1, self.stride, 1), self.padding)
+        return y / self.pool, state
+
+    def compute_output_shape(self, input_shape):
+        n, l, c = input_shape
+        return (n, _conv_out(l, self.pool, self.stride, self.padding), c)
+
+
+class _Pool3D(Layer):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, border_mode="valid",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.pool_size = _triple(pool_size)
+        self.strides = _triple(strides) if strides is not None else self.pool_size
+        self.padding = "SAME" if border_mode == "same" else "VALID"
+
+    def compute_output_shape(self, input_shape):
+        n, d, h, w, c = input_shape
+        pd, ph, pw = self.pool_size
+        sd, sh, sw = self.strides
+        return (n, _conv_out(d, pd, sd, self.padding),
+                _conv_out(h, ph, sh, self.padding),
+                _conv_out(w, pw, sw, self.padding), c)
+
+    def _reduce(self, inputs, init, op):
+        pd, ph, pw = self.pool_size
+        sd, sh, sw = self.strides
+        return lax.reduce_window(inputs, init, op, (1, pd, ph, pw, 1),
+                                 (1, sd, sh, sw, 1), self.padding)
+
+
+class MaxPooling3D(_Pool3D):
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return self._reduce(inputs, -jnp.inf, lax.max), state
+
+
+class AveragePooling3D(_Pool3D):
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        pd, ph, pw = self.pool_size
+        return self._reduce(inputs, 0.0, lax.add) / (pd * ph * pw), state
+
+
+class GlobalMaxPooling3D(Layer):
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return jnp.max(inputs, axis=(1, 2, 3)), state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], input_shape[4])
+
+
+class GlobalAveragePooling3D(Layer):
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return jnp.mean(inputs, axis=(1, 2, 3)), state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], input_shape[4])
+
+
+# -- cropping / padding / upsampling ----------------------------------------
+
+
+class Cropping1D(Layer):
+    def __init__(self, cropping=(1, 1), name: Optional[str] = None):
+        super().__init__(name)
+        self.crop = _pair(cropping)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        a, b = self.crop
+        return inputs[:, a:inputs.shape[1] - b, :], state
+
+    def compute_output_shape(self, input_shape):
+        n, l, c = input_shape
+        return (n, None if l is None else l - sum(self.crop), c)
+
+
+class Cropping2D(Layer):
+    def __init__(self, cropping=((0, 0), (0, 0)), name: Optional[str] = None):
+        super().__init__(name)
+        self.crop = tuple(_pair(c) for c in cropping)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        (t, b), (l, r) = self.crop
+        return inputs[:, t:inputs.shape[1] - b, l:inputs.shape[2] - r, :], state
+
+    def compute_output_shape(self, input_shape):
+        n, h, w, c = input_shape
+        (t, b), (l, r) = self.crop
+        return (n, None if h is None else h - t - b,
+                None if w is None else w - l - r, c)
+
+
+class Cropping3D(Layer):
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)),
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.crop = tuple(_pair(c) for c in cropping)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        (a1, b1), (a2, b2), (a3, b3) = self.crop
+        return inputs[:, a1:inputs.shape[1] - b1, a2:inputs.shape[2] - b2,
+                      a3:inputs.shape[3] - b3, :], state
+
+    def compute_output_shape(self, input_shape):
+        n, d, h, w, c = input_shape
+        (a1, b1), (a2, b2), (a3, b3) = self.crop
+        return (n, None if d is None else d - a1 - b1,
+                None if h is None else h - a2 - b2,
+                None if w is None else w - a3 - b3, c)
+
+
+class UpSampling1D(Layer):
+    def __init__(self, length: int = 2, name: Optional[str] = None):
+        super().__init__(name)
+        self.length = length
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return jnp.repeat(inputs, self.length, axis=1), state
+
+    def compute_output_shape(self, input_shape):
+        n, l, c = input_shape
+        return (n, None if l is None else l * self.length, c)
+
+
+class UpSampling2D(Layer):
+    def __init__(self, size=(2, 2), name: Optional[str] = None):
+        super().__init__(name)
+        self.size = _pair(size)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        y = jnp.repeat(inputs, self.size[0], axis=1)
+        return jnp.repeat(y, self.size[1], axis=2), state
+
+    def compute_output_shape(self, input_shape):
+        n, h, w, c = input_shape
+        return (n, None if h is None else h * self.size[0],
+                None if w is None else w * self.size[1], c)
+
+
+class UpSampling3D(Layer):
+    def __init__(self, size=(2, 2, 2), name: Optional[str] = None):
+        super().__init__(name)
+        self.size = _triple(size)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        y = jnp.repeat(inputs, self.size[0], axis=1)
+        y = jnp.repeat(y, self.size[1], axis=2)
+        return jnp.repeat(y, self.size[2], axis=3), state
+
+    def compute_output_shape(self, input_shape):
+        n, d, h, w, c = input_shape
+        return (n, None if d is None else d * self.size[0],
+                None if h is None else h * self.size[1],
+                None if w is None else w * self.size[2], c)
+
+
+class ZeroPadding1D(Layer):
+    def __init__(self, padding=1, name: Optional[str] = None):
+        super().__init__(name)
+        self.pad = _pair(padding)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        a, b = self.pad
+        return jnp.pad(inputs, ((0, 0), (a, b), (0, 0))), state
+
+    def compute_output_shape(self, input_shape):
+        n, l, c = input_shape
+        return (n, None if l is None else l + sum(self.pad), c)
+
+
+class ZeroPadding3D(Layer):
+    def __init__(self, padding=(1, 1, 1), name: Optional[str] = None):
+        super().__init__(name)
+        self.pad = _triple(padding)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        pd, ph, pw = self.pad
+        return jnp.pad(inputs, ((0, 0), (pd, pd), (ph, ph), (pw, pw),
+                                (0, 0))), state
+
+    def compute_output_shape(self, input_shape):
+        n, d, h, w, c = input_shape
+        pd, ph, pw = self.pad
+        return (n, None if d is None else d + 2 * pd,
+                None if h is None else h + 2 * ph,
+                None if w is None else w + 2 * pw, c)
+
+
+class ResizeBilinear(Layer):
+    """Bilinear image resize (reference ``ResizeBilinear.scala``)."""
+
+    def __init__(self, output_height: int, output_width: int,
+                 align_corners: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self.out_hw = (output_height, output_width)
+        self.align_corners = align_corners
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        b, _, _, c = inputs.shape
+        method = "bilinear"
+        y = jax.image.resize(inputs, (b, self.out_hw[0], self.out_hw[1], c),
+                             method=method)
+        return y, state
+
+    def compute_output_shape(self, input_shape):
+        n, _, _, c = input_shape
+        return (n, self.out_hw[0], self.out_hw[1], c)
+
+
+class LRN2D(Layer):
+    """Local response normalization across channels (reference
+    ``LRN2D.scala``), NHWC."""
+
+    def __init__(self, alpha: float = 1e-4, k: float = 1.0, beta: float = 0.75,
+                 n: int = 5, name: Optional[str] = None):
+        super().__init__(name)
+        self.alpha, self.k, self.beta, self.n = alpha, k, beta, n
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        sq = inputs * inputs
+        half = self.n // 2
+        # channel-window sum via reduce_window over the last axis
+        summed = lax.reduce_window(sq, 0.0, lax.add, (1, 1, 1, self.n),
+                                   (1, 1, 1, 1),
+                                   [(0, 0), (0, 0), (0, 0),
+                                    (half, self.n - 1 - half)])
+        denom = jnp.power(self.k + self.alpha / self.n * summed, self.beta)
+        return inputs / denom, state
+
+
+class WithinChannelLRN2D(Layer):
+    """LRN over a spatial window within each channel (reference
+    ``WithinChannelLRN2D.scala``)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.size, self.alpha, self.beta = size, alpha, beta
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        sq = inputs * inputs
+        half = self.size // 2
+        summed = lax.reduce_window(
+            sq, 0.0, lax.add, (1, self.size, self.size, 1), (1, 1, 1, 1),
+            [(0, 0), (half, self.size - 1 - half),
+             (half, self.size - 1 - half), (0, 0)])
+        denom = jnp.power(1.0 + self.alpha / (self.size ** 2) * summed,
+                          self.beta)
+        return inputs / denom, state

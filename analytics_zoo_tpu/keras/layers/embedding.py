@@ -64,3 +64,100 @@ class WordEmbedding(Embedding):
         weights = np.asarray(weights)
         super().__init__(weights.shape[0], weights.shape[1],
                          weights=weights, trainable=trainable, name=name)
+
+
+class SparseEmbedding(Embedding):
+    """Embedding over sparse one-hot-style inputs (reference
+    ``SparseEmbedding.scala``).
+
+    The reference takes a BigDL SparseTensor; the TPU-native contract is
+    integer index arrays (the COO indices), identical to ``Embedding`` —
+    gradients are scatter-adds, never a densified [vocab, dim] one-hot
+    matmul, so Criteo-scale vocabularies stay HBM-friendly. Supports
+    ``combiner`` pooling over a trailing "bag" axis for multi-hot fields.
+    """
+
+    def __init__(self, input_dim: int, output_dim: int, combiner: str = "sum",
+                 init="uniform", weights=None, trainable: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(input_dim, output_dim, init=init, weights=weights,
+                         trainable=trainable, name=name)
+        if combiner not in ("sum", "mean", "sqrtn", None):
+            raise ValueError(f"unknown combiner {combiner}")
+        self.combiner = combiner
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        # inputs: [..., bag] int indices; negative ids mean padding
+        idx = inputs.astype(jnp.int32)
+        table = params["embeddings"] if self.trainable else state["embeddings"]
+        valid = (idx >= 0).astype(table.dtype)[..., None]
+        emb = jnp.take(table, jnp.maximum(idx, 0), axis=0) * valid
+        if self.combiner is None:
+            return emb, state
+        total = jnp.sum(emb, axis=-2)
+        if self.combiner == "sum":
+            return total, state
+        n = jnp.maximum(jnp.sum(valid, axis=-2), 1.0)
+        if self.combiner == "mean":
+            return total / n, state
+        return total / jnp.sqrt(n), state  # sqrtn
+
+    def compute_output_shape(self, input_shape):
+        if self.combiner is None:
+            return tuple(input_shape) + (self.output_dim,)
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
+class SparseDense(Layer):
+    """Dense layer applied to sparse (index, value) inputs (reference
+    ``SparseDense.scala``).
+
+    TPU-native contract: inputs are (indices [..., nnz], values [..., nnz])
+    over a logical feature dim; computes sum_j v_j * W[i_j] + b by gathering
+    kernel rows — one gather + batched matmul instead of a [B, vocab]
+    densification.
+    """
+
+    def __init__(self, output_dim: int, activation=None,
+                 init="glorot_uniform", bias: bool = True,
+                 input_dim: Optional[int] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        from .core import get_activation
+        self.output_dim = output_dim
+        self.activation = get_activation(activation)
+        self.init = initializers.get(init)
+        self.use_bias = bias
+        self.input_dim = input_dim
+
+    def build(self, rng, input_shape):
+        if isinstance(input_shape, list):  # (indices, values) pair
+            in_dim = self.input_dim
+            if in_dim is None:
+                raise ValueError("SparseDense with (indices, values) input "
+                                 "needs input_dim")
+        else:
+            in_dim = self.input_dim or input_shape[-1]
+        params = {"kernel": self.init(rng, (in_dim, self.output_dim))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.output_dim,))
+        return params, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        kernel = params["kernel"]
+        if isinstance(inputs, (list, tuple)):
+            idx, vals = inputs
+            idx = idx.astype(jnp.int32)
+            rows = jnp.take(kernel, jnp.maximum(idx, 0), axis=0)
+            rows = rows * (idx >= 0).astype(rows.dtype)[..., None]
+            y = jnp.einsum("...n,...nd->...d", vals.astype(rows.dtype), rows)
+        else:  # dense fallback
+            y = inputs @ kernel.astype(inputs.dtype)
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        if isinstance(input_shape, list):
+            return tuple(input_shape[0][:-1]) + (self.output_dim,)
+        return tuple(input_shape[:-1]) + (self.output_dim,)

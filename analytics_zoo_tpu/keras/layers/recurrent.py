@@ -220,3 +220,84 @@ class Bidirectional(Layer):
         if self.merge_mode == "concat":
             return tuple(shape[:-1]) + (shape[-1] * 2,)
         return shape
+
+
+class ConvLSTM2D(Layer):
+    """Convolutional LSTM over [B, T, H, W, C] (reference ``ConvLSTM2D.scala``).
+
+    TPU design: one ``lax.scan`` over time whose body does a SINGLE fused
+    conv producing all four gates ([kh, kw, cin+units, 4*units]) — the same
+    fused-gate trick as LSTM, keeping the MXU tile large per step.
+    """
+
+    def __init__(self, nb_filter: int, nb_kernel: int, subsample=(1, 1),
+                 border_mode: str = "same", return_sequences: bool = False,
+                 go_backwards: bool = False, init="glorot_uniform",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = nb_filter
+        self.kernel_size = (nb_kernel, nb_kernel) if isinstance(nb_kernel, int) \
+            else tuple(nb_kernel)
+        self.strides = (subsample, subsample) if isinstance(subsample, int) \
+            else tuple(subsample)
+        if border_mode != "same":
+            raise ValueError("ConvLSTM2D supports border_mode='same' only "
+                             "(state and input must share spatial dims)")
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+        self.init = initializers.get(init)
+
+    def build(self, rng, input_shape):
+        # input_shape: (B, T, H, W, C)
+        cin = input_shape[-1]
+        kh, kw = self.kernel_size
+        u = self.filters
+        kernel = self.init(rng, (kh, kw, cin + u, 4 * u))
+        bias = jnp.zeros((4 * u,)).at[u:2 * u].set(1.0)  # forget bias 1
+        return {"kernel": kernel, "bias": bias}, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        from jax import lax
+        u = self.filters
+        kernel = params["kernel"].astype(inputs.dtype)
+        bias = params["bias"].astype(inputs.dtype)
+        B, T, H, W, C = inputs.shape
+        sh, sw = self.strides
+        Ho, Wo = -(-H // sh), -(-W // sw)
+
+        def step(carry, x_t):
+            h, c = carry
+            # state is at output resolution; upsample back if strided so the
+            # concat shares spatial dims with the input
+            if (sh, sw) != (1, 1):
+                h_in = jnp.repeat(jnp.repeat(h, sh, axis=1), sw, axis=2)[:, :H, :W]
+            else:
+                h_in = h
+            z = lax.conv_general_dilated(
+                jnp.concatenate([x_t, h_in], axis=-1), kernel,
+                window_strides=self.strides, padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC")) + bias
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+
+        xs = jnp.swapaxes(inputs, 0, 1)  # [T, B, H, W, C]
+        if self.go_backwards:
+            xs = xs[::-1]
+        zeros = jnp.zeros((B, Ho, Wo, u), inputs.dtype)
+        (h, c), ys = jax.lax.scan(step, (zeros, zeros), xs)
+        if self.go_backwards:
+            ys = ys[::-1]
+        if self.return_sequences:
+            return jnp.swapaxes(ys, 0, 1), state
+        return h, state
+
+    def compute_output_shape(self, input_shape):
+        n, t, h, w, _ = input_shape
+        sh, sw = self.strides
+        ho = None if h is None else -(-h // sh)
+        wo = None if w is None else -(-w // sw)
+        if self.return_sequences:
+            return (n, t, ho, wo, self.filters)
+        return (n, ho, wo, self.filters)

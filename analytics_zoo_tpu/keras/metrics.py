@@ -151,3 +151,115 @@ def get(metric: Union[str, Metric]) -> Metric:
     if metric not in _REGISTRY:
         raise ValueError(f"unknown metric '{metric}'; have {sorted(_REGISTRY)}")
     return _REGISTRY[metric]()
+
+
+# -- ranking metrics (reference models/common/Ranker.scala:108-175) ----------
+
+
+def _rank_by_pred(y_true, y_pred):
+    """Sort each row's labels by descending prediction. [Q, L] -> [Q, L]."""
+    order = jnp.argsort(-y_pred, axis=-1)
+    return jnp.take_along_axis(y_true, order, axis=-1)
+
+
+def ndcg_score(y_true, y_pred, k: int, threshold: float = 0.0):
+    """Per-query NDCG@k, vectorized over [Q, L] groups.
+
+    Matches ``Ranker.ndcg`` (Ranker.scala:114-146): gain ``2^g / ln(2+i)``
+    counted only where ``g > threshold``; ideal ranking sorts by label.
+    """
+    g_pred = _rank_by_pred(y_true, y_pred)
+    g_ideal = -jnp.sort(-y_true, axis=-1)
+    i = jnp.arange(y_true.shape[-1])
+    disc = jnp.where(i < k, 1.0 / jnp.log(2.0 + i), 0.0)
+
+    def dcg(g):
+        gain = jnp.where(g > threshold, jnp.power(2.0, g), 0.0)
+        return jnp.sum(gain * disc, axis=-1)
+
+    idcg = dcg(g_ideal)
+    return jnp.where(idcg > 0, dcg(g_pred) / jnp.maximum(idcg, 1e-12), 0.0)
+
+
+def map_score(y_true, y_pred, threshold: float = 0.0):
+    """Per-query average precision over [Q, L] groups
+    (``Ranker.map``, Ranker.scala:148-174)."""
+    g = _rank_by_pred(y_true, y_pred)
+    pos = (g > threshold).astype(jnp.float32)
+    cum_pos = jnp.cumsum(pos, axis=-1)
+    ranks = jnp.arange(1, y_true.shape[-1] + 1)
+    prec_at_hit = pos * cum_pos / ranks
+    n_pos = jnp.sum(pos, axis=-1)
+    return jnp.where(n_pos > 0,
+                     jnp.sum(prec_at_hit, axis=-1) / jnp.maximum(n_pos, 1.0),
+                     0.0)
+
+
+def hit_ratio_score(y_true, y_pred, k: int, threshold: float = 0.0):
+    """Per-query HitRatio@k over [Q, L] groups (BigDL ``HitRatio``, used by
+    the reference NCF example): 1 if any positive lands in the top-k."""
+    g = _rank_by_pred(y_true, y_pred)
+    topk_pos = jnp.any(g[..., :k] > threshold, axis=-1)
+    return topk_pos.astype(jnp.float32)
+
+
+class _GroupedRankingMetric(Metric):
+    """Streams a per-query ranking score over [Q, L]-shaped batches: each
+    batch row is one query's candidate list (the reference's 'each Sample is
+    a batch of records with both positive and negative labels')."""
+
+    def _score(self, y_true, y_pred):
+        raise NotImplementedError
+
+    def update(self, state, y_true, y_pred, mask):
+        q = mask.shape[0]
+        y_true = y_true.reshape(q, -1)
+        l = y_true.shape[1]
+        if y_pred.size % (q * l) != 0:
+            raise ValueError(
+                f"ranking metric needs [Q, L(, C)] predictions matching "
+                f"labels [Q, L]; got pred {y_pred.shape} vs true {y_true.shape}")
+        # multi-class outputs rank by positive-class (last column) probability
+        y_pred = y_pred.reshape(q, l, -1)[..., -1]
+        score = self._score(y_true, y_pred)
+        return {"sum": state["sum"] + jnp.sum(score * mask),
+                "count": state["count"] + jnp.sum(mask)}
+
+
+class NDCG(_GroupedRankingMetric):
+    def __init__(self, k: int = 10, threshold: float = 0.0):
+        if k <= 0:
+            raise ValueError(f"k for NDCG must be positive, got {k}")
+        self.k, self.threshold = k, threshold
+        self.name = f"ndcg@{k}"
+
+    def _score(self, y_true, y_pred):
+        return ndcg_score(y_true, y_pred, self.k, self.threshold)
+
+
+class MAP(_GroupedRankingMetric):
+    name = "map"
+
+    def __init__(self, threshold: float = 0.0):
+        self.threshold = threshold
+
+    def _score(self, y_true, y_pred):
+        return map_score(y_true, y_pred, self.threshold)
+
+
+class HitRatio(_GroupedRankingMetric):
+    def __init__(self, k: int = 10, threshold: float = 0.0):
+        if k <= 0:
+            raise ValueError(f"k for HitRatio must be positive, got {k}")
+        self.k, self.threshold = k, threshold
+        self.name = f"hit_ratio@{k}"
+
+    def _score(self, y_true, y_pred):
+        return hit_ratio_score(y_true, y_pred, self.k, self.threshold)
+
+
+_REGISTRY.update({
+    "ndcg": NDCG,
+    "map": MAP,
+    "hit_ratio": HitRatio,
+})

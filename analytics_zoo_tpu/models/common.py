@@ -87,7 +87,59 @@ class ZooModel:
         self.compile(optimizer="adam", loss="mse")
 
 
-class Recommender(ZooModel):
+class Ranker:
+    """Mixin for ranking models (reference ``models/common/Ranker.scala:33``):
+    ``evaluate_ndcg`` / ``evaluate_map`` over grouped candidate lists.
+
+    The TPU-native contract replaces the reference's one-Sample-per-query
+    TextSet with arrays: ``x`` grouped as [Q, L, ...] (one row per query's
+    candidate list) and ``y`` as [Q, L] relevance labels — the whole
+    evaluation is a single batched forward + vectorized metric instead of
+    per-record Spark tasks.
+    """
+
+    def _group_scores(self, x: np.ndarray, batch_size: int = 128) -> np.ndarray:
+        x = np.asarray(x)
+        q, l = x.shape[0], x.shape[1]
+        flat = x.reshape((q * l,) + x.shape[2:])
+        scores = np.asarray(self.predict(flat, batch_size=batch_size))
+        scores = scores.reshape(q, l, -1)
+        # multi-class outputs rank by the positive-class probability
+        # (last column); single-score models pass through unchanged
+        return scores[..., -1]
+
+    def evaluate_ndcg(self, x, y, k: int, threshold: float = 0.0,
+                      batch_size: int = 128) -> float:
+        """Mean NDCG@k over queries (``Ranker.evaluateNDCG``)."""
+        import jax.numpy as jnp
+        from ..keras.metrics import ndcg_score
+        scores = self._group_scores(x, batch_size)
+        vals = ndcg_score(jnp.asarray(np.asarray(y, np.float32)),
+                          jnp.asarray(scores), k, threshold)
+        return float(jnp.mean(vals))
+
+    def evaluate_map(self, x, y, threshold: float = 0.0,
+                     batch_size: int = 128) -> float:
+        """Mean average precision over queries (``Ranker.evaluateMAP``)."""
+        import jax.numpy as jnp
+        from ..keras.metrics import map_score
+        scores = self._group_scores(x, batch_size)
+        vals = map_score(jnp.asarray(np.asarray(y, np.float32)),
+                         jnp.asarray(scores), threshold)
+        return float(jnp.mean(vals))
+
+    def evaluate_hit_ratio(self, x, y, k: int = 10, threshold: float = 0.0,
+                           batch_size: int = 128) -> float:
+        """Mean HitRatio@k over queries (BigDL ``HitRatio`` role)."""
+        import jax.numpy as jnp
+        from ..keras.metrics import hit_ratio_score
+        scores = self._group_scores(x, batch_size)
+        vals = hit_ratio_score(jnp.asarray(np.asarray(y, np.float32)),
+                               jnp.asarray(scores), k, threshold)
+        return float(jnp.mean(vals))
+
+
+class Recommender(ZooModel, Ranker):
     """Adds ranking helpers over (user, item) pair predictions."""
 
     def _pair_probs(self, user_ids: np.ndarray, item_ids: np.ndarray,

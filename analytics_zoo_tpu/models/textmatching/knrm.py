@@ -15,7 +15,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 import jax.numpy as jnp
 
-from ..common import ZooModel, register_zoo_model
+from ..common import Ranker, ZooModel, register_zoo_model
 from ...keras import Input, Model
 from ...keras.engine import Layer
 from ...keras.layers import Dense, Embedding
@@ -72,7 +72,7 @@ class _TranslationMatrix(Layer):
 
 
 @register_zoo_model
-class KNRM(ZooModel):
+class KNRM(ZooModel, Ranker):
     def __init__(self, text1_length: int, text2_length: int, vocab_size: int,
                  embed_size: int = 300,
                  embed_weights: Optional[np.ndarray] = None,

@@ -1,0 +1,374 @@
+"""Tests for the extended layer library (conv_extended, advanced,
+sparse embedding/dense, ConvLSTM2D) — forward shapes + golden values,
+mirroring the reference's per-layer spec strategy (SURVEY.md §4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.keras.layers import (
+    AddConstant, AtrousConvolution1D, AtrousConvolution2D, AveragePooling1D,
+    AveragePooling3D, BinaryThreshold, CAdd, CMul, Convolution3D, ConvLSTM2D,
+    Cropping1D, Cropping2D, Cropping3D, Deconvolution2D, ELU, Exp, ExpandDim,
+    GaussianDropout, GaussianNoise, GaussianSampler, GlobalAveragePooling3D,
+    GlobalMaxPooling3D, HardShrink, HardTanh, Highway, Identity, LeakyReLU,
+    LocallyConnected1D, LocallyConnected2D, Log, LRN2D, Masking, Max,
+    MaxoutDense, MaxPooling3D, Mul, MulConstant, Narrow, Negative, Power,
+    PReLU, ResizeBilinear, RReLU, Scale, SelectTable, SeparableConvolution2D,
+    Softmax, SoftShrink, SparseDense, SparseEmbedding, SpatialDropout1D,
+    SpatialDropout2D, SplitTensor, Sqrt, Square, SReLU, Threshold,
+    ThresholdedReLU, TimeDistributed, UpSampling1D, UpSampling2D,
+    UpSampling3D, WithinChannelLRN2D, ZeroPadding1D, ZeroPadding3D, Dense)
+
+RNG = jax.random.PRNGKey(0)
+
+
+def run_layer(layer, x, training=False, rng=None):
+    shape = ([(None,) + np.asarray(a).shape[1:] for a in x]
+             if isinstance(x, list) else (None,) + np.asarray(x).shape[1:])
+    params, state = layer.build(RNG, shape)
+    xs = [jnp.asarray(a) for a in x] if isinstance(x, list) else jnp.asarray(x)
+    y, new_state = layer.call(params, state, xs, training=training, rng=rng)
+    return y, params, new_state
+
+
+class TestConvExtended:
+    def test_conv3d(self):
+        x = np.zeros((2, 6, 8, 8, 3), np.float32)
+        layer = Convolution3D(4, 3, 3, 3)
+        y, _, _ = run_layer(layer, x)
+        assert y.shape == (2, 4, 6, 6, 4)
+        assert layer.compute_output_shape((None, 6, 8, 8, 3)) == (None, 4, 6, 6, 4)
+
+    def test_conv3d_known_value(self):
+        x = np.ones((1, 2, 2, 2, 1), np.float32)
+        layer = Convolution3D(1, 2, 2, 2, init="ones", bias=False)
+        y, _, _ = run_layer(layer, x)
+        np.testing.assert_allclose(y, 8 * np.ones((1, 1, 1, 1, 1)), rtol=1e-6)
+
+    def test_deconv2d(self):
+        x = np.ones((1, 4, 4, 2), np.float32)
+        layer = Deconvolution2D(3, 3, 3, subsample=(2, 2), border_mode="same")
+        y, _, _ = run_layer(layer, x)
+        assert y.shape == (1, 8, 8, 3)
+        assert layer.compute_output_shape((None, 4, 4, 2)) == (None, 8, 8, 3)
+
+    def test_separable_conv(self):
+        x = np.random.RandomState(0).randn(2, 8, 8, 3).astype(np.float32)
+        layer = SeparableConvolution2D(6, 3, 3, depth_multiplier=2)
+        y, params, _ = run_layer(layer, x)
+        assert y.shape == (2, 6, 6, 6)
+        assert params["depthwise"].shape == (3, 3, 1, 6)
+        assert params["pointwise"].shape == (1, 1, 6, 6)
+
+    def test_atrous_conv2d(self):
+        x = np.zeros((1, 10, 10, 2), np.float32)
+        layer = AtrousConvolution2D(4, 3, 3, atrous_rate=(2, 2))
+        y, _, _ = run_layer(layer, x)
+        assert y.shape == (1, 6, 6, 4)  # effective kernel 5
+        assert layer.compute_output_shape((None, 10, 10, 2)) == (None, 6, 6, 4)
+
+    def test_atrous_conv1d(self):
+        x = np.zeros((1, 10, 2), np.float32)
+        y, _, _ = run_layer(AtrousConvolution1D(4, 3, atrous_rate=2), x)
+        assert y.shape == (1, 6, 4)
+
+    def test_locally_connected1d(self):
+        x = np.ones((2, 6, 3), np.float32)
+        layer = LocallyConnected1D(5, 3)
+        y, params, _ = run_layer(layer, x)
+        assert y.shape == (2, 4, 5)
+        assert params["kernel"].shape == (4, 9, 5)
+
+    def test_locally_connected2d_matches_conv_when_shared(self):
+        # with a constant kernel, locally-connected == conv
+        x = np.random.RandomState(0).randn(1, 5, 5, 2).astype(np.float32)
+        lc = LocallyConnected2D(3, 2, 2, bias=False)
+        params, _ = lc.build(RNG, (None, 5, 5, 2))
+        k = np.asarray(params["kernel"][0])  # [K*K*C, F]
+        params = {"kernel": jnp.broadcast_to(jnp.asarray(k), params["kernel"].shape)}
+        y, _ = lc.call(params, {}, jnp.asarray(x))
+        from jax import lax
+        kern = k.reshape(2, 2, 2, 3)
+        want = lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(kern), (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        np.testing.assert_allclose(y, want, rtol=1e-4, atol=1e-5)
+
+    def test_pool3d(self):
+        x = np.arange(64, dtype=np.float32).reshape(1, 4, 4, 4, 1)
+        y, _, _ = run_layer(MaxPooling3D((2, 2, 2)), x)
+        assert y.shape == (1, 2, 2, 2, 1)
+        y2, _, _ = run_layer(AveragePooling3D((2, 2, 2)), x)
+        np.testing.assert_allclose(float(y2[0, 0, 0, 0, 0]),
+                                   np.mean([0, 1, 4, 5, 16, 17, 20, 21]))
+        assert run_layer(GlobalMaxPooling3D(), x)[0].shape == (1, 1)
+        assert run_layer(GlobalAveragePooling3D(), x)[0].shape == (1, 1)
+
+    def test_avg_pool1d(self):
+        x = np.arange(8, dtype=np.float32).reshape(1, 8, 1)
+        y, _, _ = run_layer(AveragePooling1D(2), x)
+        np.testing.assert_allclose(y[0, :, 0], [0.5, 2.5, 4.5, 6.5])
+
+    def test_crops(self):
+        x = np.zeros((1, 8, 8, 2), np.float32)
+        assert run_layer(Cropping2D(((1, 2), (2, 1))), x)[0].shape == (1, 5, 5, 2)
+        x1 = np.zeros((1, 8, 2), np.float32)
+        assert run_layer(Cropping1D((1, 1)), x1)[0].shape == (1, 6, 2)
+        x3 = np.zeros((1, 6, 6, 6, 2), np.float32)
+        assert run_layer(Cropping3D(), x3)[0].shape == (1, 4, 4, 4, 2)
+
+    def test_upsampling_padding(self):
+        x = np.arange(4, dtype=np.float32).reshape(1, 2, 2, 1)
+        y, _, _ = run_layer(UpSampling2D((2, 2)), x)
+        assert y.shape == (1, 4, 4, 1)
+        np.testing.assert_allclose(
+            y[0, :, :, 0],
+            [[0, 0, 1, 1], [0, 0, 1, 1], [2, 2, 3, 3], [2, 2, 3, 3]])
+        x1 = np.zeros((1, 3, 2), np.float32)
+        assert run_layer(UpSampling1D(3), x1)[0].shape == (1, 9, 2)
+        x3 = np.zeros((1, 2, 2, 2, 1), np.float32)
+        assert run_layer(UpSampling3D(), x3)[0].shape == (1, 4, 4, 4, 1)
+        assert run_layer(ZeroPadding1D(2), x1)[0].shape == (1, 7, 2)
+        assert run_layer(ZeroPadding3D(1), x3)[0].shape == (1, 4, 4, 4, 1)
+
+    def test_resize_bilinear(self):
+        x = np.arange(4, dtype=np.float32).reshape(1, 2, 2, 1)
+        y, _, _ = run_layer(ResizeBilinear(4, 4), x)
+        assert y.shape == (1, 4, 4, 1)
+
+    def test_lrn(self):
+        x = np.ones((1, 4, 4, 8), np.float32)
+        y, _, _ = run_layer(LRN2D(), x)
+        assert y.shape == (1, 4, 4, 8)
+        assert float(y[0, 0, 0, 4]) < 1.0  # normalized down
+        y2, _, _ = run_layer(WithinChannelLRN2D(), x)
+        assert y2.shape == (1, 4, 4, 8)
+
+
+class TestAdvancedActivations:
+    def test_unary_golden(self):
+        x = np.array([[-2.0, -0.3, 0.0, 0.5, 2.0]], np.float32)
+        cases = [
+            (ELU(1.0), np.where(x > 0, x, np.expm1(x))),
+            (LeakyReLU(0.1), np.where(x > 0, x, 0.1 * x)),
+            (ThresholdedReLU(0.4), np.where(x > 0.4, x, 0)),
+            (Threshold(0.0, -1.0), np.where(x > 0, x, -1.0)),
+            (BinaryThreshold(0.0), (x > 0).astype(np.float32)),
+            (HardTanh(), np.clip(x, -1, 1)),
+            (HardShrink(0.5), np.where(np.abs(x) > 0.5, x, 0)),
+            (SoftShrink(0.5), np.sign(x) * np.maximum(np.abs(x) - 0.5, 0)),
+            (Negative(), -x),
+            (Square(), x * x),
+            (AddConstant(3.0), x + 3),
+            (MulConstant(2.0), x * 2),
+            (Identity(), x),
+        ]
+        for layer, want in cases:
+            y, _, _ = run_layer(layer, x)
+            np.testing.assert_allclose(y, want, rtol=1e-5, atol=1e-6,
+                                       err_msg=type(layer).__name__)
+
+    def test_exp_log_sqrt_power(self):
+        x = np.array([[0.5, 1.0, 4.0]], np.float32)
+        np.testing.assert_allclose(run_layer(Exp(), x)[0], np.exp(x), rtol=1e-5)
+        np.testing.assert_allclose(run_layer(Log(), x)[0], np.log(x), rtol=1e-5)
+        np.testing.assert_allclose(run_layer(Sqrt(), x)[0], np.sqrt(x), rtol=1e-5)
+        np.testing.assert_allclose(run_layer(Power(2.0, 2.0, 1.0), x)[0],
+                                   (1 + 2 * x) ** 2, rtol=1e-5)
+
+    def test_softmax(self):
+        x = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+        y, _, _ = run_layer(Softmax(), x)
+        np.testing.assert_allclose(np.sum(y, -1), 1.0, rtol=1e-5)
+
+    def test_prelu_srelu(self):
+        x = np.array([[-1.0, 2.0]], np.float32)
+        y, params, _ = run_layer(PReLU(), x)
+        np.testing.assert_allclose(y, [[-0.25, 2.0]], rtol=1e-6)
+        y2, _, _ = run_layer(SReLU(), x)
+        assert y2.shape == x.shape
+
+    def test_rrelu(self):
+        x = np.array([[-4.0, 4.0]], np.float32)
+        y, _, _ = run_layer(RReLU(), x)  # inference: mean leak
+        np.testing.assert_allclose(y, [[-4 * (1 / 8 + 1 / 3) / 2, 4.0]], rtol=1e-5)
+        y_tr, _, _ = run_layer(RReLU(), x, training=True,
+                               rng=jax.random.PRNGKey(3))
+        assert -4 * (1 / 3) <= float(y_tr[0, 0]) <= -4 * (1 / 8)
+
+
+class TestStochastic:
+    def test_gaussian_dropout_noise(self):
+        x = np.ones((512, 8), np.float32)
+        y, _, _ = run_layer(GaussianDropout(0.3), x, training=True,
+                            rng=jax.random.PRNGKey(0))
+        assert abs(float(jnp.mean(y)) - 1.0) < 0.05
+        assert float(jnp.std(y)) > 0.1
+        y_inf, _, _ = run_layer(GaussianDropout(0.3), x)
+        np.testing.assert_array_equal(y_inf, x)
+        y2, _, _ = run_layer(GaussianNoise(0.5), x, training=True,
+                             rng=jax.random.PRNGKey(1))
+        assert abs(float(jnp.std(y2)) - 0.5) < 0.05
+
+    def test_gaussian_sampler(self):
+        mean = np.zeros((1000, 2), np.float32)
+        log_var = np.zeros((1000, 2), np.float32)
+        layer = GaussianSampler()
+        y, _ = layer.call({}, {}, [jnp.asarray(mean), jnp.asarray(log_var)],
+                          rng=jax.random.PRNGKey(0))
+        assert abs(float(jnp.std(y)) - 1.0) < 0.1
+
+    def test_spatial_dropout(self):
+        x = np.ones((4, 10, 8), np.float32)
+        y, _, _ = run_layer(SpatialDropout1D(0.5), x, training=True,
+                            rng=jax.random.PRNGKey(0))
+        # whole channels dropped: each [b, :, c] slice all-zero or all-scaled
+        arr = np.asarray(y)
+        for b in range(4):
+            for c in range(8):
+                col = arr[b, :, c]
+                assert np.all(col == 0) or np.all(col == 2.0)
+        x2 = np.ones((2, 5, 5, 3), np.float32)
+        y2, _, _ = run_layer(SpatialDropout2D(0.5), x2, training=True,
+                             rng=jax.random.PRNGKey(1))
+        assert y2.shape == x2.shape
+
+
+class TestStructural:
+    def test_masking(self):
+        x = np.array([[[0.0, 0.0], [1.0, 2.0]]], np.float32)
+        y, _, _ = run_layer(Masking(0.0), x)
+        np.testing.assert_allclose(y[0, 0], [0, 0])
+        np.testing.assert_allclose(y[0, 1], [1, 2])
+
+    def test_highway(self):
+        x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+        y, _, _ = run_layer(Highway(), x)
+        assert y.shape == (4, 6)
+
+    def test_maxout(self):
+        x = np.ones((3, 5), np.float32)
+        layer = MaxoutDense(4, nb_feature=3)
+        y, params, _ = run_layer(layer, x)
+        assert y.shape == (3, 4)
+        assert params["kernel"].shape == (5, 12)
+        assert layer.compute_output_shape((None, 5)) == (None, 4)
+
+    def test_time_distributed(self):
+        x = np.random.RandomState(0).randn(2, 4, 3).astype(np.float32)
+        layer = TimeDistributed(Dense(6))
+        y, _, _ = run_layer(layer, x)
+        assert y.shape == (2, 4, 6)
+        assert layer.compute_output_shape((None, 4, 3)) == (None, 4, 6)
+
+    def test_select_split_narrow(self):
+        a, b = jnp.ones((2, 3)), 2 * jnp.ones((2, 3))
+        y, _ = SelectTable(1).call({}, {}, [a, b])
+        np.testing.assert_allclose(y, b)
+        parts, _ = SplitTensor(1, 3).call({}, {}, jnp.arange(6.0).reshape(1, 6))
+        assert len(parts) == 3 and parts[0].shape == (1, 2)
+        y2, _ = Narrow(1, 2, 3).call({}, {}, jnp.arange(8.0).reshape(1, 8))
+        np.testing.assert_allclose(y2, [[2, 3, 4]])
+
+    def test_expand_dims_max(self):
+        x = jnp.ones((2, 3))
+        y, _ = ExpandDim(1).call({}, {}, x)
+        assert y.shape == (2, 1, 3)
+        y2, _ = Max(1).call({}, {}, x)
+        assert y2.shape == (2,)
+
+    def test_cadd_cmul_mul_scale(self):
+        x = np.ones((2, 3), np.float32)
+        y, params, _ = run_layer(CAdd((3,)), x)
+        np.testing.assert_allclose(y, x)  # bias starts 0
+        y2, _, _ = run_layer(CMul((3,)), x)
+        np.testing.assert_allclose(y2, x)  # weight starts 1
+        y3, _, _ = run_layer(Mul(), x)
+        np.testing.assert_allclose(y3, x)
+        y4, _, _ = run_layer(Scale((3,)), x)
+        np.testing.assert_allclose(y4, x)
+
+
+class TestSparse:
+    def test_sparse_embedding_combiners(self):
+        table = np.arange(20, dtype=np.float32).reshape(5, 4)
+        idx = np.array([[0, 2, -1]], np.int32)  # -1 = padding
+        for combiner, want in [
+            ("sum", table[0] + table[2]),
+            ("mean", (table[0] + table[2]) / 2),
+            ("sqrtn", (table[0] + table[2]) / np.sqrt(2)),
+        ]:
+            layer = SparseEmbedding(5, 4, combiner=combiner, weights=table)
+            y, _, _ = run_layer(layer, idx)
+            np.testing.assert_allclose(y[0], want, rtol=1e-5,
+                                       err_msg=combiner)
+
+    def test_sparse_embedding_grad_is_sparse_shape(self):
+        layer = SparseEmbedding(100, 8)
+        params, _ = layer.build(RNG, (None, 3))
+        idx = jnp.array([[1, 5, 7]], jnp.int32)
+        g = jax.grad(lambda p: layer.call(p, {}, idx)[0].sum())(params)
+        assert g["embeddings"].shape == (100, 8)
+        # only touched rows have gradient
+        nz = np.nonzero(np.any(np.asarray(g["embeddings"]) != 0, axis=1))[0]
+        np.testing.assert_array_equal(nz, [1, 5, 7])
+
+    def test_sparse_dense(self):
+        layer = SparseDense(3, input_dim=10, bias=False)
+        shape = [(None, 2), (None, 2)]
+        params, _ = layer.build(RNG, shape)
+        idx = jnp.array([[0, 4]], jnp.int32)
+        vals = jnp.array([[2.0, 1.0]], jnp.float32)
+        y, _ = layer.call(params, {}, [idx, vals])
+        k = np.asarray(params["kernel"])
+        np.testing.assert_allclose(y[0], 2 * k[0] + k[4], rtol=1e-5)
+
+
+class TestConvLSTM:
+    def test_conv_lstm_shapes(self):
+        x = np.random.RandomState(0).randn(2, 3, 8, 8, 4).astype(np.float32)
+        layer = ConvLSTM2D(6, 3)
+        y, params, _ = run_layer(layer, x)
+        assert y.shape == (2, 8, 8, 6)
+        assert params["kernel"].shape == (3, 3, 10, 24)
+        y2, _, _ = run_layer(ConvLSTM2D(6, 3, return_sequences=True), x)
+        assert y2.shape == (2, 3, 8, 8, 6)
+
+    def test_conv_lstm_grad(self):
+        x = jnp.ones((1, 2, 4, 4, 2))
+        layer = ConvLSTM2D(3, 3)
+        params, _ = layer.build(RNG, (None, 2, 4, 4, 2))
+        g = jax.grad(lambda p: layer.call(p, {}, x)[0].sum())(params)
+        assert g["kernel"].shape == params["kernel"].shape
+
+
+class TestReviewRegressions:
+    def test_lrn_even_window(self):
+        x = np.ones((1, 4, 4, 8), np.float32)
+        y, _, _ = run_layer(LRN2D(n=4), x)
+        assert y.shape == x.shape
+        y2, _, _ = run_layer(WithinChannelLRN2D(size=4), x)
+        assert y2.shape == x.shape
+
+    def test_gaussian_sampler_requires_rng(self):
+        layer = GaussianSampler()
+        with pytest.raises(ValueError, match="rng"):
+            layer.call({}, {}, [jnp.zeros((2, 2)), jnp.zeros((2, 2))])
+
+    def test_grouped_ranking_metric_multiclass(self):
+        from analytics_zoo_tpu.keras.metrics import NDCG
+        m = NDCG(k=1)
+        st = m.init_state()
+        y_true = jnp.asarray([[1.0, 0.0]])
+        # [Q, L, C] softmax output: positive-class prob ranks list correctly
+        y_pred = jnp.asarray([[[0.1, 0.9], [0.8, 0.2]]])
+        st = m.update(st, y_true, y_pred, jnp.ones(1))
+        assert abs(m.compute(st) - 1.0) < 1e-6
+
+    def test_grouped_ranking_metric_bad_shape(self):
+        from analytics_zoo_tpu.keras.metrics import NDCG
+        m = NDCG(k=1)
+        with pytest.raises(ValueError, match="ranking metric"):
+            m.update(m.init_state(), jnp.ones((2, 3)), jnp.ones((2, 4)),
+                     jnp.ones(2))
