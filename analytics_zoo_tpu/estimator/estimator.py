@@ -52,19 +52,27 @@ class Estimator:
                  mesh=None, param_sharding_rules: Optional[Sequence] = None,
                  direct_loss_fn: Optional[Callable] = None,
                  direct_eval_loss_fn: Optional[Callable] = None,
+                 compute_dtype=None,
                  seed: int = 42):
         """``direct_loss_fn(params, model_state, rng, x, y) -> (loss,
         new_state)`` bypasses the model.call→loss_fn(y, y_pred) convention —
         the capture-style API hook (≙ TFOptimizer.from_loss, where the user
         hands over the whole loss graph instead of a model).
         ``direct_eval_loss_fn`` is the eval-mode variant (no dropout etc.);
-        defaults to ``direct_loss_fn``."""
+        defaults to ``direct_loss_fn``.
+
+        ``compute_dtype`` (e.g. ``jnp.bfloat16``) enables mixed precision:
+        float inputs are cast to it before the forward pass (layers follow
+        activation dtype, so matmuls hit the MXU in bf16) while params, the
+        optimizer state, and the loss stay float32 — the standard TPU
+        mixed-precision policy."""
         self.model = model
         self.loss_fn = loss_fn
         self.direct_loss_fn = direct_loss_fn
         self.direct_eval_loss_fn = direct_eval_loss_fn or direct_loss_fn
         self.optimizer = optimizer
         self.metrics = [metrics_mod.get(m) for m in (metrics or [])]
+        self.compute_dtype = compute_dtype
         self.ctx = get_context()
         self.mesh = mesh if mesh is not None else self.ctx.mesh
         self.param_rules = param_sharding_rules
@@ -132,17 +140,30 @@ class Estimator:
 
     # -- compiled steps -------------------------------------------------------
 
+    def _cast_inputs(self, x):
+        """Mixed precision: float inputs -> compute_dtype (ints untouched)."""
+        if self.compute_dtype is None:
+            return x
+        dtype = self.compute_dtype
+        return jax.tree_util.tree_map(
+            lambda t: t.astype(dtype)
+            if jnp.issubdtype(jnp.asarray(t).dtype, jnp.floating) else t, x)
+
     def _build_train_step(self):
         model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
         direct = self.direct_loss_fn
         clip = self._clip_transform()
+        cast = self._cast_inputs
 
         def train_step(params, opt_state, model_state, rng, x, y):
             def compute_loss(p):
                 if direct is not None:
                     return direct(p, model_state, rng, x, y)
-                y_pred, new_state = model.call(p, model_state, x,
+                y_pred, new_state = model.call(p, model_state, cast(x),
                                                training=True, rng=rng)
+                # loss in float32 regardless of activation dtype
+                y_pred = jax.tree_util.tree_map(
+                    lambda t: t.astype(jnp.float32), y_pred)
                 return loss_fn(y, y_pred), new_state
 
             (loss, new_state), grads = jax.value_and_grad(
@@ -158,8 +179,12 @@ class Estimator:
     def _build_eval_step(self):
         model, metrics = self.model, self.metrics
 
+        cast = self._cast_inputs
+
         def eval_step(params, model_state, metric_states, x, y, mask):
-            y_pred, _ = model.call(params, model_state, x, training=False)
+            y_pred, _ = model.call(params, model_state, cast(x), training=False)
+            y_pred = jax.tree_util.tree_map(
+                lambda t: t.astype(jnp.float32), y_pred)
             return [m.update(s, y, y_pred, mask)
                     for m, s in zip(metrics, metric_states)]
 
@@ -168,9 +193,12 @@ class Estimator:
     def _build_predict_step(self):
         model = self.model
 
+        cast = self._cast_inputs
+
         def predict_step(params, model_state, x):
-            y_pred, _ = model.call(params, model_state, x, training=False)
-            return y_pred
+            y_pred, _ = model.call(params, model_state, cast(x), training=False)
+            return jax.tree_util.tree_map(
+                lambda t: t.astype(jnp.float32), y_pred)
 
         return jax.jit(predict_step)
 

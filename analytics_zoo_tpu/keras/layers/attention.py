@@ -27,7 +27,7 @@ def _dense_params(rng, d_in, d_out, init_range):
 
 
 def _dense(p, x):
-    return x @ p["kernel"] + p["bias"]
+    return x @ p["kernel"].astype(x.dtype) + p["bias"].astype(x.dtype)
 
 
 def _layer_norm_params(dim):
@@ -35,9 +35,11 @@ def _layer_norm_params(dim):
 
 
 def _layer_norm(p, x, eps=1e-5):
-    mean = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    return (x - mean) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+    x32 = x.astype(jnp.float32)  # stable moments in bf16 pipelines
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+    return y.astype(x.dtype)
 
 
 def _dropout(x, rate, rng, training):
@@ -99,7 +101,8 @@ class MultiHeadAttention(Layer):
             b, x_kv.shape[1], h, dh).transpose(0, 2, 1, 3)
         bias = None
         if mask is not None:
-            bias = (1.0 - mask[:, None, None, :].astype(jnp.float32)) * -1e9
+            bias = ((1.0 - mask[:, None, None, :].astype(jnp.float32))
+                    * -1e9).astype(x_q.dtype)
         drop_rng = None
         if training and self.attn_drop > 0.0 and rng is not None:
             rng, drop_rng = jax.random.split(rng)
@@ -136,8 +139,12 @@ class _TransformerBase(Layer):
     def __init__(self, n_block: int, n_head: int, hidden_size: int,
                  intermediate_size: int, hidden_drop: float, attn_drop: float,
                  init_range: float, causal: bool, output_all_block: bool,
-                 use_flash: bool = True, name: Optional[str] = None):
+                 use_flash: bool = True, compute_dtype=None,
+                 name: Optional[str] = None):
         super().__init__(name)
+        # mixed precision: embeddings cast to this dtype so every block's
+        # matmuls hit the MXU in bf16; layer norms still reduce in f32
+        self.compute_dtype = compute_dtype
         self.n_block = n_block
         self.n_head = n_head
         self.hidden_size = hidden_size
@@ -200,12 +207,14 @@ class TransformerLayer(_TransformerBase):
                  intermediate_size: int = 0, hidden_p_drop: float = 0.1,
                  attn_p_drop: float = 0.1, initializer_range: float = 0.02,
                  bidirectional: bool = False, output_all_block: bool = True,
-                 use_flash: bool = True, name: Optional[str] = None):
+                 use_flash: bool = True, compute_dtype=None,
+                 name: Optional[str] = None):
         super().__init__(n_block, n_head, hidden_size, intermediate_size,
                          hidden_p_drop, attn_p_drop, initializer_range,
                          causal=not bidirectional,
                          output_all_block=output_all_block,
-                         use_flash=use_flash, name=name)
+                         use_flash=use_flash, compute_dtype=compute_dtype,
+                         name=name)
         self.vocab = vocab
         self.seq_len = seq_len
 
@@ -236,6 +245,8 @@ class TransformerLayer(_TransformerBase):
         tokens = tokens.astype(jnp.int32)
         positions = positions.astype(jnp.int32)
         x = params["word_emb"][tokens] + params["pos_emb"][positions]
+        if self.compute_dtype is not None:
+            x = x.astype(self.compute_dtype)
         all_states = []
         for i in range(self.n_block):
             sub = None
@@ -259,11 +270,12 @@ class BERT(_TransformerBase):
                  hidden_p_drop: float = 0.1, attn_p_drop: float = 0.1,
                  initializer_range: float = 0.02,
                  output_all_block: bool = True, use_flash: bool = True,
-                 name: Optional[str] = None):
+                 compute_dtype=None, name: Optional[str] = None):
         super().__init__(n_block, n_head, hidden_size, intermediate_size,
                          hidden_p_drop, attn_p_drop, initializer_range,
                          causal=False, output_all_block=output_all_block,
-                         use_flash=use_flash, name=name)
+                         use_flash=use_flash, compute_dtype=compute_dtype,
+                         name=name)
         self.vocab = vocab
         self.max_position_len = max_position_len
 
@@ -298,6 +310,8 @@ class BERT(_TransformerBase):
         positions = positions.astype(jnp.int32)
         x = (params["word_emb"][tokens] + params["pos_emb"][positions]
              + params["type_emb"][types])
+        if self.compute_dtype is not None:
+            x = x.astype(self.compute_dtype)
         x = _layer_norm(params["emb_ln"], x)
         if rng is not None:
             rng, sub = jax.random.split(rng)

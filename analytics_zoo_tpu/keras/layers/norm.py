@@ -38,9 +38,10 @@ class BatchNormalization(Layer):
         reduce_axes = tuple(i for i in range(inputs.ndim)
                             if i != (inputs.ndim + self.axis if self.axis < 0
                                      else self.axis))
+        x32 = inputs.astype(jnp.float32)  # stable moments in bf16 pipelines
         if training:
-            mean = jnp.mean(inputs, axis=reduce_axes)
-            var = jnp.var(inputs, axis=reduce_axes)
+            mean = jnp.mean(x32, axis=reduce_axes)
+            var = jnp.var(x32, axis=reduce_axes)
             m = self.momentum
             new_state = {
                 "moving_mean": m * state["moving_mean"] + (1 - m) * mean,
@@ -50,7 +51,7 @@ class BatchNormalization(Layer):
             mean, var = state["moving_mean"], state["moving_var"]
             new_state = state
         inv = jnp.reciprocal(jnp.sqrt(var + self.epsilon))
-        y = (inputs - mean) * inv * params["gamma"] + params["beta"]
+        y = (x32 - mean) * inv * params["gamma"] + params["beta"]
         return y.astype(inputs.dtype), new_state
 
 

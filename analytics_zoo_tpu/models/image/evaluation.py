@@ -1,0 +1,101 @@
+"""Detection evaluation — mean average precision (reference
+``models/image/objectdetection/common/evaluation/MeanAveragePrecision.scala:1``
++ ``EvalUtil.scala`` / ``PascalVocEvaluator.scala``).
+
+Pascal-VOC protocol: detections matched to ground truth greedily by score at
+an IoU threshold; AP per class from the precision/recall curve (VOC-2007
+11-point interpolation or the continuous area under the interpolated curve);
+mAP = mean over classes with ground truth. Host-side numpy — evaluation
+aggregates tiny per-image lists, not a device-bound workload.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .objectdetection import iou_matrix
+
+
+class MeanAveragePrecision:
+    """Streaming mAP accumulator.
+
+    ``add(boxes, scores, classes, gt_boxes, gt_labels)`` per image (corner
+    boxes, classes in 1..C-1, zero-score detection rows ignored), then
+    ``compute()`` -> {"mAP": float, "ap_per_class": {cls: ap}}.
+    """
+
+    def __init__(self, num_classes: int, iou_threshold: float = 0.5,
+                 use_voc2007: bool = False):
+        self.num_classes = num_classes
+        self.iou_threshold = iou_threshold
+        self.use_voc2007 = use_voc2007
+        # per class: list of (score, is_tp); gt counts
+        self._dets: Dict[int, List] = {c: [] for c in range(1, num_classes)}
+        self._n_gt = np.zeros(num_classes, np.int64)
+
+    def add(self, boxes: np.ndarray, scores: np.ndarray, classes: np.ndarray,
+            gt_boxes: np.ndarray, gt_labels: np.ndarray) -> None:
+        boxes = np.asarray(boxes, np.float32)
+        scores = np.asarray(scores, np.float32)
+        classes = np.asarray(classes)
+        gt_boxes = np.asarray(gt_boxes, np.float32).reshape(-1, 4)
+        gt_labels = np.asarray(gt_labels).reshape(-1)
+        for c in np.unique(gt_labels):
+            self._n_gt[int(c)] += int((gt_labels == c).sum())
+        for c in range(1, self.num_classes):
+            sel = (classes == c) & (scores > 0)
+            if not sel.any():
+                continue
+            det_b = boxes[sel]
+            det_s = scores[sel]
+            order = np.argsort(-det_s)
+            det_b, det_s = det_b[order], det_s[order]
+            gsel = gt_labels == c
+            gts = gt_boxes[gsel]
+            matched = np.zeros(len(gts), bool)
+            for b, s in zip(det_b, det_s):
+                if len(gts) == 0:
+                    self._dets[c].append((float(s), 0))
+                    continue
+                ious = iou_matrix(b[None, :], gts)[0]
+                j = int(ious.argmax())
+                if ious[j] >= self.iou_threshold and not matched[j]:
+                    matched[j] = True
+                    self._dets[c].append((float(s), 1))
+                else:
+                    self._dets[c].append((float(s), 0))
+
+    def _ap(self, recalls: np.ndarray, precisions: np.ndarray) -> float:
+        if self.use_voc2007:
+            # 11-point interpolation (EvalUtil.computeAP voc2007 branch)
+            ap = 0.0
+            for t in np.linspace(0, 1, 11):
+                mask = recalls >= t
+                ap += (precisions[mask].max() if mask.any() else 0.0) / 11
+            return float(ap)
+        # continuous: area under the monotone precision envelope
+        mrec = np.concatenate([[0.0], recalls, [1.0]])
+        mpre = np.concatenate([[0.0], precisions, [0.0]])
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        idx = np.where(mrec[1:] != mrec[:-1])[0]
+        return float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+
+    def compute(self) -> Dict[str, object]:
+        aps = {}
+        for c in range(1, self.num_classes):
+            n_gt = self._n_gt[c]
+            if n_gt == 0:
+                continue
+            dets = sorted(self._dets[c], key=lambda t: -t[0])
+            if not dets:
+                aps[c] = 0.0
+                continue
+            tp = np.cumsum([d[1] for d in dets]).astype(np.float64)
+            fp = np.cumsum([1 - d[1] for d in dets]).astype(np.float64)
+            recalls = tp / n_gt
+            precisions = tp / np.maximum(tp + fp, 1e-10)
+            aps[c] = self._ap(recalls, precisions)
+        mAP = float(np.mean(list(aps.values()))) if aps else 0.0
+        return {"mAP": mAP, "ap_per_class": aps}

@@ -1,0 +1,557 @@
+"""SSD object detection (reference
+``models/image/objectdetection/ObjectDetector.scala:37``, ``ssd/SSD.scala:79``,
+``ssd/SSDGraph.scala``, ``common/BboxUtil.scala``, ``Postprocessor.scala:1``,
+``common/loss/MultiBoxLoss.scala``).
+
+TPU-first redesign:
+
+- The SSD graph is a native Keras-engine ``Model`` with two static-shape
+  outputs: box-regression ``[B, A, 4]`` and class logits ``[B, A, C]`` over
+  all ``A`` anchors — all feature-map heads are fused into one concat, so a
+  forward pass is one XLA program with MXU-tiled NHWC convs.
+- Anchor (prior-box) generation is host-side numpy, computed once per config
+  and closed over as a constant (the reference recomputes priors in-graph
+  per forward, ``ssd/SSD.scala:111-180``).
+- Target matching/encoding (``BboxUtil.matchBboxes/encodeBboxes``) happens in
+  the input pipeline (numpy, per record); the device loss consumes
+  pre-encoded static-shape targets — no dynamic shapes under jit.
+- MultiBox loss runs fully vectorized on device, with hard-negative mining
+  as a masked top-k (the reference sorts indices per image in Scala,
+  ``MultiBoxLoss.scala``).
+- Decode + NMS (``Postprocessor.scala``) is a jitted, static-shape greedy NMS
+  over the top-``max_detections`` candidates per class.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..common import ZooModel, register_zoo_model
+from ...keras import Input, Model
+from ...keras.engine import Layer
+from ...keras.layers import (
+    Activation, BatchNormalization, Convolution2D, MaxPooling2D, Merge,
+    Reshape, ZeroPadding2D, merge)
+
+
+# ---------------------------------------------------------------------------
+# Anchor (PriorBox) generation — host-side, once per config
+# ---------------------------------------------------------------------------
+
+
+def generate_anchors(fmap_sizes: Sequence[int],
+                     image_size: int,
+                     min_sizes: Sequence[float],
+                     max_sizes: Sequence[Optional[float]],
+                     aspect_ratios: Sequence[Sequence[float]],
+                     clip: bool = True) -> np.ndarray:
+    """Prior boxes for every feature map, concatenated: [A, 4] as
+    (cx, cy, w, h), normalized to [0, 1] (reference ``PriorBox`` layers
+    instantiated in ``ssd/SSD.scala:131-180``).
+
+    Per cell: 1 box at min_size, 1 at sqrt(min*max) (if max), plus 2 per
+    extra aspect ratio (r and 1/r) — the standard SSD prior family.
+
+    Ordering is CELL-MAJOR (all k anchors of cell 0, then cell 1, ...) to
+    match the head convention: ``Reshape((fsize*fsize*k, 4))`` over an
+    NHWC conv output puts the k per-cell predictions contiguously.
+    """
+    all_priors = []
+    for fsize, mn, mx, ratios in zip(fmap_sizes, min_sizes, max_sizes,
+                                     aspect_ratios):
+        step = image_size / fsize
+        sizes = [(mn, mn)]
+        if mx:
+            s = float(np.sqrt(mn * mx))
+            sizes.append((s, s))
+        for r in ratios:
+            if r == 1.0:
+                continue
+            sr = float(np.sqrt(r))
+            sizes.append((mn * sr, mn / sr))
+            sizes.append((mn / sr, mn * sr))
+        ys, xs = np.meshgrid(np.arange(fsize), np.arange(fsize), indexing="ij")
+        cx = ((xs + 0.5) * step / image_size).reshape(-1)  # [cells]
+        cy = ((ys + 0.5) * step / image_size).reshape(-1)
+        wh = np.asarray([(w / image_size, h / image_size) for w, h in sizes],
+                        np.float32)  # [k, 2]
+        k = len(sizes)
+        cells = np.stack([cx, cy], axis=1)  # [cells, 2]
+        per_cell = np.concatenate([
+            np.broadcast_to(cells[:, None, :], (len(cx), k, 2)),
+            np.broadcast_to(wh[None, :, :], (len(cx), k, 2)),
+        ], axis=-1)  # [cells, k, 4] cell-major
+        all_priors.append(per_cell.reshape(-1, 4))
+    priors = np.concatenate(all_priors, axis=0).astype(np.float32)
+    if clip:
+        priors = np.clip(priors, 0.0, 1.0)
+    return priors
+
+
+def _corner_form(cchw: np.ndarray) -> np.ndarray:
+    """(cx, cy, w, h) -> (xmin, ymin, xmax, ymax)."""
+    cx, cy, w, h = np.split(np.asarray(cchw), 4, axis=-1)
+    return np.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                          axis=-1)
+
+
+def iou_matrix(boxes_a: np.ndarray, boxes_b: np.ndarray) -> np.ndarray:
+    """Pairwise IoU over corner-form boxes: [Na, Nb]
+    (reference ``BboxUtil.jaccardOverlap``)."""
+    a = np.asarray(boxes_a)[:, None, :]
+    b = np.asarray(boxes_b)[None, :, :]
+    lt = np.maximum(a[..., :2], b[..., :2])
+    rb = np.minimum(a[..., 2:], b[..., 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = ((a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1]))
+    area_b = ((b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1]))
+    return inter / np.clip(area_a + area_b - inter, 1e-10, None)
+
+
+_VARIANCES = (0.1, 0.1, 0.2, 0.2)
+
+
+def encode_targets(gt_boxes: np.ndarray, gt_labels: np.ndarray,
+                   anchors: np.ndarray, iou_threshold: float = 0.5
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Match ground-truth to anchors and encode regression targets
+    (reference ``BboxUtil.matchBboxes`` + ``encodeBboxes``).
+
+    gt_boxes: [G, 4] corner form normalized; gt_labels: [G] in 1..C-1
+    (0 = background). Returns (loc_targets [A, 4], cls_targets [A]).
+    Runs in the input pipeline — numpy, per record.
+    """
+    A = anchors.shape[0]
+    loc_t = np.zeros((A, 4), np.float32)
+    cls_t = np.zeros((A,), np.int32)
+    if len(gt_boxes) == 0:
+        return loc_t, cls_t
+    anchors_corner = _corner_form(anchors)
+    ious = iou_matrix(anchors_corner, gt_boxes)  # [A, G]
+    best_gt = ious.argmax(axis=1)
+    best_gt_iou = ious.max(axis=1)
+    # force-match: every gt owns its best anchor regardless of threshold
+    best_anchor = ious.argmax(axis=0)
+    best_gt[best_anchor] = np.arange(len(gt_boxes))
+    best_gt_iou[best_anchor] = 1.0
+    pos = best_gt_iou >= iou_threshold
+    matched = gt_boxes[best_gt]
+    # corner -> center form of matched gt
+    mw = matched[:, 2] - matched[:, 0]
+    mh = matched[:, 3] - matched[:, 1]
+    mcx = matched[:, 0] + mw / 2
+    mcy = matched[:, 1] + mh / 2
+    vx, vy, vw, vh = _VARIANCES
+    loc = np.stack([
+        (mcx - anchors[:, 0]) / anchors[:, 2] / vx,
+        (mcy - anchors[:, 1]) / anchors[:, 3] / vy,
+        np.log(np.clip(mw, 1e-8, None) / anchors[:, 2]) / vw,
+        np.log(np.clip(mh, 1e-8, None) / anchors[:, 3]) / vh,
+    ], axis=1).astype(np.float32)
+    loc_t[pos] = loc[pos]
+    cls_t[pos] = gt_labels[best_gt[pos]].astype(np.int32)
+    return loc_t, cls_t
+
+
+def decode_boxes(loc: jnp.ndarray, anchors: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of ``encode_targets``: loc [.., A, 4] -> corner boxes
+    (reference ``BboxUtil.decodeBboxes``). jnp, jit-safe."""
+    vx, vy, vw, vh = _VARIANCES
+    cx = loc[..., 0] * vx * anchors[:, 2] + anchors[:, 0]
+    cy = loc[..., 1] * vy * anchors[:, 3] + anchors[:, 1]
+    w = jnp.exp(loc[..., 2] * vw) * anchors[:, 2]
+    h = jnp.exp(loc[..., 3] * vh) * anchors[:, 3]
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# MultiBox loss (reference common/loss/MultiBoxLoss.scala) — on-device
+# ---------------------------------------------------------------------------
+
+
+def multibox_loss(neg_pos_ratio: float = 3.0):
+    """Returns ``loss_fn(y, y_pred)`` over pre-encoded targets.
+
+    y = (loc_targets [B, A, 4], cls_targets [B, A]); y_pred = [loc, logits].
+    Smooth-L1 on positives + softmax CE with hard-negative mining at
+    ``neg_pos_ratio`` negatives per positive, fully vectorized (the mining
+    top-k is a sort over the anchor axis — no host sync).
+    """
+    def loss_fn(y, y_pred):
+        loc_t, cls_t = y
+        loc_p, logits = y_pred
+        cls_t = cls_t.astype(jnp.int32)
+        pos = (cls_t > 0).astype(jnp.float32)  # [B, A]
+        n_pos = jnp.maximum(pos.sum(axis=1), 1.0)  # [B]
+
+        # smooth L1 over positive anchors
+        diff = jnp.abs(loc_p - loc_t)
+        sl1 = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5).sum(-1)
+        loc_loss = (sl1 * pos).sum(axis=1) / n_pos
+
+        # per-anchor CE
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ce = -jnp.take_along_axis(logp, cls_t[..., None], axis=-1)[..., 0]
+        # hard-negative mining: rank background anchors by CE, keep top
+        # neg_pos_ratio * n_pos per image
+        neg_ce = jnp.where(pos > 0, -jnp.inf, ce)
+        order = jnp.argsort(-neg_ce, axis=1)
+        ranks = jnp.argsort(order, axis=1).astype(jnp.float32)  # rank per anchor
+        n_neg = jnp.minimum(neg_pos_ratio * n_pos,
+                            (1 - pos).sum(axis=1))  # [B]
+        neg = ((ranks < n_neg[:, None]) & (pos == 0)).astype(jnp.float32)
+        cls_loss = (ce * (pos + neg)).sum(axis=1) / n_pos
+        return jnp.mean(loc_loss + cls_loss)
+
+    return loss_fn
+
+
+# ---------------------------------------------------------------------------
+# Decode + NMS postprocessor (reference Postprocessor.scala) — jitted
+# ---------------------------------------------------------------------------
+
+
+def _nms_mask(boxes, scores, iou_threshold, max_out):
+    """Greedy NMS over top-``max_out`` candidates; returns (boxes, scores)
+    padded to max_out with score 0 — static shapes throughout."""
+    k = min(max_out, scores.shape[0])
+    top_scores, top_idx = jax.lax.top_k(scores, k)
+    top_boxes = boxes[top_idx]
+
+    lt = jnp.maximum(top_boxes[:, None, :2], top_boxes[None, :, :2])
+    rb = jnp.minimum(top_boxes[:, None, 2:], top_boxes[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area = ((top_boxes[:, 2] - top_boxes[:, 0])
+            * (top_boxes[:, 3] - top_boxes[:, 1]))
+    iou = inter / jnp.clip(area[:, None] + area[None, :] - inter, 1e-10, None)
+
+    def body(i, keep):
+        # suppress i if any kept higher-scored j overlaps it
+        overlap = (iou[i] > iou_threshold) & keep & (jnp.arange(k) < i)
+        return keep.at[i].set(~jnp.any(overlap) & keep[i])
+
+    keep = jax.lax.fori_loop(0, k, body, jnp.ones((k,), bool))
+    return top_boxes, jnp.where(keep, top_scores, 0.0)
+
+
+def decode_detections(loc, logits, anchors, num_classes: int,
+                      score_threshold: float = 0.05,
+                      iou_threshold: float = 0.45,
+                      max_detections: int = 100):
+    """[B, A, 4] loc + [B, A, C] logits -> per-image padded detections
+    (boxes [B, N, 4], scores [B, N], classes [B, N]) — the reference's
+    ``Postprocessor`` topN/NMS pipeline as one jitted program."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    boxes = decode_boxes(loc, jnp.asarray(anchors))  # [B, A, 4]
+
+    def per_image(bx, pr):
+        cls_boxes, cls_scores, cls_ids = [], [], []
+        for c in range(1, num_classes):  # 0 = background
+            s = jnp.where(pr[:, c] >= score_threshold, pr[:, c], 0.0)
+            nb, ns = _nms_mask(bx, s, iou_threshold, max_detections)
+            cls_boxes.append(nb)
+            cls_scores.append(ns)
+            cls_ids.append(jnp.full(ns.shape, c, jnp.int32))
+        all_boxes = jnp.concatenate(cls_boxes)
+        all_scores = jnp.concatenate(cls_scores)
+        all_ids = jnp.concatenate(cls_ids)
+        top_s, top_i = jax.lax.top_k(all_scores, max_detections)
+        return all_boxes[top_i], top_s, all_ids[top_i]
+
+    return jax.vmap(per_image)(boxes, probs)
+
+
+# ---------------------------------------------------------------------------
+# SSD graph (reference ssd/SSD.scala + SSDGraph.scala)
+# ---------------------------------------------------------------------------
+
+
+class _L2Normalize(Layer):
+    """Channel L2-norm with learned per-channel scale — the conv4_3
+    normalization (reference ``NormalizeScale`` in SSDGraph)."""
+
+    def __init__(self, scale_init: float = 20.0, name=None):
+        super().__init__(name)
+        self.scale_init = scale_init
+
+    def build(self, rng, input_shape):
+        return {"scale": jnp.full((input_shape[-1],), self.scale_init)}, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        norm = jnp.sqrt(jnp.sum(inputs * inputs, axis=-1, keepdims=True) + 1e-10)
+        return inputs / norm * params["scale"].astype(inputs.dtype), state
+
+
+# SSD300 config (reference SSD.scala:131-156): per-map (fsize, n_anchor)
+_SSD300 = dict(
+    fmap_sizes=[38, 19, 10, 5, 3, 1],
+    min_sizes=[30, 60, 111, 162, 213, 264],
+    max_sizes=[60, 111, 162, 213, 264, 315],
+    aspect_ratios=[[2], [2, 3], [2, 3], [2, 3], [2], [2]],
+)
+
+
+def _anchors_per_cell(ratios: Sequence[float], has_max: bool) -> int:
+    return 1 + (1 if has_max else 0) + 2 * len([r for r in ratios if r != 1.0])
+
+
+def _vgg_block(x, n, filters, name, pool=True, pool_stride=2):
+    for i in range(n):
+        x = Convolution2D(filters, 3, 3, border_mode="same",
+                          activation="relu", name=f"{name}_conv{i + 1}")(x)
+    if pool:
+        x = MaxPooling2D((2, 2), strides=(pool_stride, pool_stride),
+                         border_mode="same", name=f"{name}_pool")(x)
+    return x
+
+
+def ssd_vgg16(num_classes: int, resolution: int = 300) -> Tuple[Model, np.ndarray]:
+    """SSD300-VGG16: returns (model, anchors). Model outputs
+    [loc [B, A, 4], logits [B, A, C]] (reference ``SSD.vgg16`` +
+    ``SSDGraph``)."""
+    cfg = _SSD300
+    inp = Input((resolution, resolution, 3), name="image")
+    # VGG16 trunk
+    x = _vgg_block(inp, 2, 64, "block1")
+    x = _vgg_block(x, 2, 128, "block2")
+    x = _vgg_block(x, 3, 256, "block3")
+    x = _vgg_block(x, 3, 512, "block4", pool=False)
+    conv4_3 = x  # 38x38
+    x = MaxPooling2D((2, 2), border_mode="same", name="block4_pool")(x)
+    x = _vgg_block(x, 3, 512, "block5", pool=False)
+    x = MaxPooling2D((3, 3), strides=(1, 1), border_mode="same",
+                     name="block5_pool")(x)
+    # fc6/fc7 as atrous + 1x1 convs
+    from ...keras.layers import AtrousConvolution2D
+    x = AtrousConvolution2D(1024, 3, 3, atrous_rate=(6, 6), border_mode="same",
+                            activation="relu", name="fc6")(x)
+    fc7 = Convolution2D(1024, 1, 1, activation="relu", name="fc7")(x)  # 19x19
+
+    def extra(x, c1, c2, stride, pad, name):
+        x = Convolution2D(c1, 1, 1, activation="relu", name=f"{name}_1")(x)
+        if pad:
+            x = ZeroPadding2D((1, 1), name=f"{name}_pad")(x)
+            x = Convolution2D(c2, 3, 3, subsample=(stride, stride),
+                              activation="relu", name=f"{name}_2")(x)
+        else:
+            x = Convolution2D(c2, 3, 3, subsample=(stride, stride),
+                              activation="relu", border_mode="valid",
+                              name=f"{name}_2")(x)
+        return x
+
+    conv6_2 = extra(fc7, 256, 512, 2, True, "conv6")      # 10x10
+    conv7_2 = extra(conv6_2, 128, 256, 2, True, "conv7")  # 5x5
+    conv8_2 = extra(conv7_2, 128, 256, 1, False, "conv8")  # 3x3
+    conv9_2 = extra(conv8_2, 128, 256, 1, False, "conv9")  # 1x1
+
+    fmaps = [_L2Normalize(name="conv4_3_norm")(conv4_3), fc7, conv6_2,
+             conv7_2, conv8_2, conv9_2]
+    locs, confs = [], []
+    for i, (fmap, fsize, ratios, mx) in enumerate(zip(
+            fmaps, cfg["fmap_sizes"], cfg["aspect_ratios"], cfg["max_sizes"])):
+        k = _anchors_per_cell(ratios, mx is not None)
+        loc = Convolution2D(k * 4, 3, 3, border_mode="same",
+                            name=f"head{i}_loc")(fmap)
+        conf = Convolution2D(k * num_classes, 3, 3, border_mode="same",
+                             name=f"head{i}_conf")(fmap)
+        locs.append(Reshape((fsize * fsize * k, 4),
+                            name=f"head{i}_loc_flat")(loc))
+        confs.append(Reshape((fsize * fsize * k, num_classes),
+                             name=f"head{i}_conf_flat")(conf))
+    all_loc = merge(locs, mode="concat", concat_axis=1, name="loc_concat")
+    all_conf = merge(confs, mode="concat", concat_axis=1, name="conf_concat")
+    model = Model(inp, [all_loc, all_conf], name="ssd300_vgg16")
+    anchors = generate_anchors(cfg["fmap_sizes"], resolution,
+                               cfg["min_sizes"], cfg["max_sizes"],
+                               cfg["aspect_ratios"])
+    return model, anchors
+
+
+def ssd_mobilenet(num_classes: int, resolution: int = 300,
+                  alpha: float = 1.0) -> Tuple[Model, np.ndarray]:
+    """SSD300-MobileNet (reference mobilenet SSD variant): lighter trunk,
+    same head/anchor machinery."""
+    cfg = _SSD300
+    inp = Input((resolution, resolution, 3), name="image")
+
+    def c(f):
+        return max(8, int(f * alpha))
+
+    def dw(x, filters, stride, name):
+        cin = x.shape[-1]
+        x = Convolution2D(cin, 3, 3, subsample=(stride, stride),
+                          border_mode="same", bias=False, groups=cin,
+                          name=f"{name}_dw")(x)
+        x = BatchNormalization(name=f"{name}_dw_bn")(x)
+        x = Activation("relu", name=f"{name}_dw_act")(x)
+        x = Convolution2D(filters, 1, 1, bias=False, name=f"{name}_pw")(x)
+        x = BatchNormalization(name=f"{name}_pw_bn")(x)
+        return Activation("relu", name=f"{name}_pw_act")(x)
+
+    x = Convolution2D(c(32), 3, 3, subsample=(2, 2), border_mode="same",
+                      bias=False, name="stem")(inp)  # 150
+    x = BatchNormalization(name="stem_bn")(x)
+    x = Activation("relu", name="stem_act")(x)
+    x = dw(x, c(64), 1, "b1")
+    x = dw(x, c(128), 2, "b2")   # 75
+    x = dw(x, c(128), 1, "b3")
+    x = dw(x, c(256), 2, "b4")   # 38
+    x = dw(x, c(256), 1, "b5")
+    f38 = x
+    x = dw(x, c(512), 2, "b6")   # 19
+    for i in range(5):
+        x = dw(x, c(512), 1, f"b{7 + i}")
+    f19 = x
+    x = dw(x, c(1024), 2, "b12")  # 10
+    f10 = dw(x, c(1024), 1, "b13")
+    f5 = dw(f10, c(512), 2, "b14")
+    f3 = dw(f5, c(256), 2, "b15")
+    f1 = dw(f3, c(256), 3, "b16")
+
+    fmaps = [f38, f19, f10, f5, f3, f1]
+    locs, confs = [], []
+    for i, (fmap, fsize, ratios, mx) in enumerate(zip(
+            fmaps, cfg["fmap_sizes"], cfg["aspect_ratios"], cfg["max_sizes"])):
+        k = _anchors_per_cell(ratios, mx is not None)
+        loc = Convolution2D(k * 4, 3, 3, border_mode="same",
+                            name=f"head{i}_loc")(fmap)
+        conf = Convolution2D(k * num_classes, 3, 3, border_mode="same",
+                             name=f"head{i}_conf")(fmap)
+        locs.append(Reshape((fsize * fsize * k, 4),
+                            name=f"head{i}_loc_flat")(loc))
+        confs.append(Reshape((fsize * fsize * k, num_classes),
+                             name=f"head{i}_conf_flat")(conf))
+    all_loc = merge(locs, mode="concat", concat_axis=1, name="loc_concat")
+    all_conf = merge(confs, mode="concat", concat_axis=1, name="conf_concat")
+    model = Model(inp, [all_loc, all_conf], name="ssd300_mobilenet")
+    anchors = generate_anchors(cfg["fmap_sizes"], resolution,
+                               cfg["min_sizes"], cfg["max_sizes"],
+                               cfg["aspect_ratios"])
+    return model, anchors
+
+
+class SSD:
+    """SSD builder facade (reference ``SSD.apply``, ssd/SSD.scala:79)."""
+
+    BACKBONES = {"vgg16": ssd_vgg16, "mobilenet": ssd_mobilenet}
+
+    def __new__(cls, class_num: int, resolution: int = 300,
+                backbone: str = "vgg16"):
+        if backbone not in cls.BACKBONES:
+            raise ValueError(f"unknown backbone {backbone}; "
+                             f"have {sorted(cls.BACKBONES)}")
+        return cls.BACKBONES[backbone](class_num, resolution)
+
+
+# ---------------------------------------------------------------------------
+# ObjectDetector ZooModel (reference ObjectDetector.scala:37 + config)
+# ---------------------------------------------------------------------------
+
+
+@register_zoo_model
+class ObjectDetector(ZooModel):
+    """SSD detector with train/predict/postprocess wiring.
+
+    ``fit`` consumes (images, (loc_targets, cls_targets)) — use
+    :meth:`encode_batch` to build targets from raw boxes. ``detect`` returns
+    per-image (boxes, scores, classes) after NMS.
+    """
+
+    def __init__(self, class_num: int, backbone: str = "vgg16",
+                 resolution: int = 300, labels: Optional[List[str]] = None):
+        super().__init__()
+        self.class_num = class_num
+        self.backbone = backbone
+        self.resolution = resolution
+        self.labels = labels
+        self.anchors: Optional[np.ndarray] = None
+        self._decode_cache: Dict[Tuple, Any] = {}
+
+    def get_config(self) -> Dict[str, Any]:
+        return {"class_num": self.class_num, "backbone": self.backbone,
+                "resolution": self.resolution, "labels": self.labels}
+
+    def build_model(self) -> Model:
+        model, anchors = SSD(self.class_num, self.resolution, self.backbone)
+        self.anchors = anchors
+        return model
+
+    def default_compile(self):
+        self._ensure_built()
+        self.compile(optimizer="adam", loss=multibox_loss())
+
+    def encode_batch(self, gt_boxes: Sequence[np.ndarray],
+                     gt_labels: Sequence[np.ndarray],
+                     iou_threshold: float = 0.5
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """Per-image gt lists -> stacked (loc_targets, cls_targets)."""
+        self._ensure_built()
+        pairs = [encode_targets(np.asarray(b, np.float32),
+                                np.asarray(l), self.anchors, iou_threshold)
+                 for b, l in zip(gt_boxes, gt_labels)]
+        return (np.stack([p[0] for p in pairs]),
+                np.stack([p[1] for p in pairs]))
+
+    def detect(self, images: np.ndarray, batch_size: int = 16,
+               score_threshold: float = 0.05, iou_threshold: float = 0.45,
+               max_detections: int = 100):
+        """Forward + decode + NMS; returns (boxes, scores, classes) arrays
+        ([B, N, 4], [B, N], [B, N]; zero-score rows are padding)."""
+        self._ensure_built()
+        loc, logits = self.predict(images, batch_size=batch_size)
+        key = (score_threshold, iou_threshold, max_detections)
+        if key not in self._decode_cache:  # one jit cache entry per config
+            self._decode_cache[key] = jax.jit(
+                lambda l, g: decode_detections(
+                    l, g, self.anchors, self.class_num,
+                    score_threshold, iou_threshold, max_detections))
+        boxes, scores, classes = self._decode_cache[key](
+            jnp.asarray(loc), jnp.asarray(logits))
+        return np.asarray(boxes), np.asarray(scores), np.asarray(classes)
+
+    def predict_image_set(self, image_set, batch_size: int = 16, **kwargs):
+        """Detections over an ImageSet (reference
+        ``ImageModel.predictImageSet`` path)."""
+        from ...feature.image import ChannelNormalize, ImageSetToSample, Resize
+        chain = (Resize(self.resolution, self.resolution)
+                 >> ChannelNormalize([123.0, 117.0, 104.0], [1.0, 1.0, 1.0])
+                 >> ImageSetToSample())
+        fs = image_set.transform(chain).to_featureset(shuffle=False, shard=False)
+        return self.detect(np.asarray(fs.features), batch_size=batch_size,
+                           **kwargs)
+
+
+class Visualizer:
+    """Draw detections onto images (reference ``Visualizer.scala``) —
+    pure-numpy box painting, no cv2 dependency."""
+
+    def __init__(self, labels: Optional[List[str]] = None,
+                 score_threshold: float = 0.3, thickness: int = 2,
+                 color=(255, 0, 0)):
+        self.labels = labels
+        self.score_threshold = score_threshold
+        self.thickness = thickness
+        self.color = np.asarray(color, np.float32)
+
+    def draw(self, image: np.ndarray, boxes: np.ndarray, scores: np.ndarray,
+             classes: np.ndarray) -> np.ndarray:
+        img = np.array(image, np.float32, copy=True)
+        h, w = img.shape[:2]
+        t = self.thickness
+        for box, score in zip(boxes, scores):
+            if score < self.score_threshold:
+                continue
+            x0 = int(np.clip(box[0] * w, 0, w - 1))
+            y0 = int(np.clip(box[1] * h, 0, h - 1))
+            x1 = int(np.clip(box[2] * w, 0, w - 1))
+            y1 = int(np.clip(box[3] * h, 0, h - 1))
+            img[y0:y0 + t, x0:x1] = self.color
+            img[max(0, y1 - t):y1, x0:x1] = self.color
+            img[y0:y1, x0:x0 + t] = self.color
+            img[y0:y1, max(0, x1 - t):x1] = self.color
+        return img

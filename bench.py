@@ -1,8 +1,16 @@
 """Benchmark driver: prints ONE JSON line with the headline metric.
 
-Round-1 flagship: NCF (MovieLens-1M scale) training throughput in samples/sec
-on the available accelerator (BASELINE.json config #1). The reference
-publishes no absolute numbers (`published: {}`), so ``vs_baseline`` is null.
+Round-2 coverage of the north-star set (BASELINE.json):
+  1. ResNet-50 training images/sec (headline; config #2)
+  2. NCF samples/sec (config #1)
+  3. Wide&Deep samples/sec, sparse-embedding allreduce stress (config #3)
+  4. BERT-base fine-tune step, capture-style (config #4)
+
+Every workload reports MFU (achieved matmul FLOP/s divided by chip peak) from
+XLA's compiled cost analysis. The reference publishes no absolute numbers
+(`published: {}`), so ``vs_baseline`` is null.
+
+Usage: ``python bench.py [all|resnet50|ncf|widedeep|bert]`` (default all).
 """
 import json
 import sys
@@ -10,73 +18,248 @@ import time
 
 import numpy as np
 
+# bf16 peak matmul FLOP/s per chip by device kind (JAX's default matmul
+# precision on TPU uses bf16 multiplies, so this is the right denominator)
+_PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v6e": 918e12,
+}
 
-def bench_ncf(batch_size: int = 8192, steps: int = 50, warmup: int = 5):
+
+def _peak_flops():
     import jax
-    from analytics_zoo_tpu.common.context import init_tpu_context
-    from analytics_zoo_tpu.estimator import Estimator
-    from analytics_zoo_tpu.feature import FeatureSet
-    from analytics_zoo_tpu.keras import objectives, optimizers
-    from analytics_zoo_tpu.models import NeuralCF
+    kind = jax.devices()[0].device_kind
+    for key, peak in _PEAK_FLOPS.items():
+        if key.lower() in kind.lower():
+            return peak
+    return None
 
-    ctx = init_tpu_context()
-    ndev = ctx.num_devices
-    if batch_size % ndev:
-        batch_size = (batch_size // ndev) * ndev
 
-    # MovieLens-1M dimensions
-    users, items = 6040, 3706
-    n = batch_size * 8
-    rs = np.random.RandomState(0)
-    x = np.stack([rs.randint(1, users + 1, n),
-                  rs.randint(1, items + 1, n)], 1).astype(np.float32)
-    y = rs.randint(0, 2, n).astype(np.float32)
+class _BenchResult(dict):
+    pass
 
-    ncf = NeuralCF(users, items, 2, user_embed=64, item_embed=64,
-                   hidden_layers=[128, 64, 32], mf_embed=32)
-    model = ncf._ensure_built()
-    est = Estimator(model=model,
-                    loss_fn=objectives.get("sparse_categorical_crossentropy"),
-                    optimizer=optimizers.Adam(1e-3))
-    fs = FeatureSet.from_ndarrays(x, y)
 
-    it = fs.train_iterator(batch_size)
-    from analytics_zoo_tpu.feature import DeviceFeed
-    feed = DeviceFeed(it, est.mesh)
-    bx, by = next(feed)
+def _run_steps(est, bx, by, steps, warmup):
+    """Time `steps` train steps on a fixed device-resident batch (the input
+    pipeline is measured separately — this isolates device throughput);
+    returns (sec, flops_per_step). The step is compiled ONCE ahead of time
+    and the same executable both reports cost analysis and runs the loop."""
+    import jax
     est._ensure_initialized(bx)
     step_fn = est._build_train_step()
-
     rng = jax.random.PRNGKey(0)
     params, opt_state, mstate = est.params, est.opt_state, est.model_state
-    for i in range(warmup):
-        params, opt_state, mstate, loss = step_fn(params, opt_state, mstate,
-                                                  rng, bx, by)
+    compiled = step_fn.lower(params, opt_state, mstate, rng, bx, by).compile()
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops = float(ca["flops"]) if ca and "flops" in ca else None
+    except Exception:
+        flops = None
+    for _ in range(warmup):
+        params, opt_state, mstate, loss = compiled(params, opt_state, mstate,
+                                                   rng, bx, by)
     jax.block_until_ready(loss)
-
     start = time.perf_counter()
-    for i in range(steps):
-        bx, by = next(feed)
-        params, opt_state, mstate, loss = step_fn(params, opt_state, mstate,
-                                                  rng, bx, by)
+    for _ in range(steps):
+        params, opt_state, mstate, loss = compiled(params, opt_state, mstate,
+                                                   rng, bx, by)
     jax.block_until_ready(loss)
-    elapsed = time.perf_counter() - start
-    samples_per_sec = batch_size * steps / elapsed
-    return samples_per_sec, ctx
+    return time.perf_counter() - start, flops
+
+
+def _mfu(flops_per_step, steps, elapsed):
+    peak = _peak_flops()
+    if flops_per_step is None or peak is None:
+        return None
+    return round(flops_per_step * steps / elapsed / peak, 4)
+
+
+def bench_resnet50(batch_size: int = 256, steps: int = 20, warmup: int = 3):
+    """ResNet-50 dogs-vs-cats-shape training throughput (north-star #2)."""
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.estimator import Estimator
+    from analytics_zoo_tpu.keras import objectives, optimizers
+    from analytics_zoo_tpu.models.image.imageclassification import resnet
+    from analytics_zoo_tpu.parallel.mesh import shard_batch
+
+    ctx = init_tpu_context()
+    batch_size = max(ctx.num_devices, (batch_size // ctx.num_devices)
+                     * ctx.num_devices)
+    import jax.numpy as jnp
+    model = resnet(50, num_classes=2, input_shape=(224, 224, 3))
+    est = Estimator(model=model,
+                    loss_fn=objectives.get("sparse_categorical_crossentropy"),
+                    optimizer=optimizers.SGD(0.1, momentum=0.9),
+                    compute_dtype=jnp.bfloat16)
+    rs = np.random.RandomState(0)
+    x = rs.rand(batch_size, 224, 224, 3).astype(np.float32)
+    y = rs.randint(0, 2, batch_size).astype(np.float32)
+    bx, by = shard_batch(est.mesh, (x, y))
+    elapsed, flops = _run_steps(est, bx, by, steps, warmup)
+    return _BenchResult(
+        metric="resnet50_train_images_per_sec",
+        value=round(batch_size * steps / elapsed, 1),
+        unit="images/s",
+        mfu=_mfu(flops, steps, elapsed),
+        detail={"fixed_device_batch": True, "batch_size": batch_size, "image": "224x224x3",
+                "optimizer": "sgd+momentum",
+                "flops_per_step": flops})
+
+
+def bench_ncf(batch_size: int = 8192, steps: int = 50, warmup: int = 5):
+    """NCF MovieLens-1M training throughput (north-star #1)."""
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.estimator import Estimator
+    from analytics_zoo_tpu.keras import objectives, optimizers
+    from analytics_zoo_tpu.models import NeuralCF
+    from analytics_zoo_tpu.parallel.mesh import shard_batch
+
+    ctx = init_tpu_context()
+    if batch_size % ctx.num_devices:
+        batch_size = (batch_size // ctx.num_devices) * ctx.num_devices
+    users, items = 6040, 3706
+    rs = np.random.RandomState(0)
+    x = np.stack([rs.randint(1, users + 1, batch_size),
+                  rs.randint(1, items + 1, batch_size)], 1).astype(np.float32)
+    y = rs.randint(0, 2, batch_size).astype(np.float32)
+    ncf = NeuralCF(users, items, 2, user_embed=64, item_embed=64,
+                   hidden_layers=[128, 64, 32], mf_embed=32)
+    est = Estimator(model=ncf._ensure_built(),
+                    loss_fn=objectives.get("sparse_categorical_crossentropy"),
+                    optimizer=optimizers.Adam(1e-3))
+    bx, by = shard_batch(est.mesh, (x, y))
+    elapsed, flops = _run_steps(est, bx, by, steps, warmup)
+    return _BenchResult(
+        metric="ncf_train_samples_per_sec",
+        value=round(batch_size * steps / elapsed, 1),
+        unit="samples/s",
+        mfu=_mfu(flops, steps, elapsed),
+        detail={"fixed_device_batch": True, "model": "NeuralCF ml-1m (embed 64, mlp 128-64-32, mf 32)",
+                "batch_size": batch_size, "flops_per_step": flops})
+
+
+def bench_widedeep(batch_size: int = 8192, steps: int = 30, warmup: int = 5):
+    """Wide&Deep Census-shape training throughput (north-star #3): sparse
+    wide table via gather + scatter-add grads — the allreduce stress case."""
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.estimator import Estimator
+    from analytics_zoo_tpu.keras import objectives, optimizers
+    from analytics_zoo_tpu.models.recommendation.wide_and_deep import (
+        ColumnFeatureInfo, WideAndDeep)
+    from analytics_zoo_tpu.parallel.mesh import shard_batch
+
+    ctx = init_tpu_context()
+    if batch_size % ctx.num_devices:
+        batch_size = (batch_size // ctx.num_devices) * ctx.num_devices
+    # census-like columns + one large hashed cross (stress the wide table)
+    ci = ColumnFeatureInfo(
+        wide_base_cols=["edu", "occ"], wide_base_dims=[16, 1000],
+        wide_cross_cols=["edu_occ"], wide_cross_dims=[100000],
+        indicator_cols=["work", "marital"], indicator_dims=[9, 7],
+        embed_cols=["edu_e", "occ_e"], embed_in_dims=[16, 1000],
+        embed_out_dims=[8, 8],
+        continuous_cols=["age", "hours"])
+    wnd = WideAndDeep("wide_n_deep", 2, ci, hidden_layers=(40, 20, 10))
+    rs = np.random.RandomState(0)
+    offsets = np.cumsum([0] + ci.wide_dims)[:-1]
+    wide = np.stack([rs.randint(0, d, batch_size) + off
+                     for d, off in zip(ci.wide_dims, offsets)], 1)
+    ind = np.stack([rs.randint(0, d, batch_size)
+                    for d in ci.indicator_dims], 1)
+    emb = np.stack([rs.randint(0, d, batch_size)
+                    for d in ci.embed_in_dims], 1)
+    cont = rs.rand(batch_size, 2).astype(np.float32)
+    y = rs.randint(0, 2, batch_size).astype(np.float32)
+    est = Estimator(model=wnd._ensure_built(),
+                    loss_fn=objectives.get("sparse_categorical_crossentropy"),
+                    optimizer=optimizers.Adam(1e-3))
+    batch = shard_batch(est.mesh, ([wide.astype(np.int32),
+                                    ind.astype(np.int32),
+                                    emb.astype(np.int32), cont], y))
+    bx, by = batch
+    elapsed, flops = _run_steps(est, bx, by, steps, warmup)
+    return _BenchResult(
+        metric="widedeep_train_samples_per_sec",
+        value=round(batch_size * steps / elapsed, 1),
+        unit="samples/s",
+        mfu=_mfu(flops, steps, elapsed),
+        detail={"fixed_device_batch": True, "batch_size": batch_size, "wide_dim": sum(ci.wide_dims),
+                "flops_per_step": flops})
+
+
+def bench_bert(batch_size: int = 128, seq_len: int = 128, steps: int = 10,
+               warmup: int = 2):
+    """BERT-base fine-tune step via the capture-style task estimator
+    (north-star #4); exercises the attention stack on hardware."""
+    from analytics_zoo_tpu.capture.text import BERTClassifier, bert_input_pack
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.parallel.mesh import shard_batch
+
+    ctx = init_tpu_context()
+    batch_size = max(ctx.num_devices, (batch_size // ctx.num_devices)
+                     * ctx.num_devices)
+    import jax.numpy as jnp
+    clf = BERTClassifier(2, bert_config=dict(
+        vocab=30522, hidden_size=768, n_block=12, n_head=12,
+        max_position_len=512, intermediate_size=3072,
+        compute_dtype=jnp.bfloat16))
+    rs = np.random.RandomState(0)
+    tokens = rs.randint(1, 30000, (batch_size, seq_len))
+    x = bert_input_pack(tokens)
+    y = rs.randint(0, 2, batch_size).astype(np.float32)
+    est = clf.model.get_estimator()
+    bx, by = shard_batch(est.mesh, (x, y))
+    elapsed, flops = _run_steps(est, bx, by, steps, warmup)
+    return _BenchResult(
+        metric="bert_base_finetune_samples_per_sec",
+        value=round(batch_size * steps / elapsed, 1),
+        unit="samples/s",
+        mfu=_mfu(flops, steps, elapsed),
+        detail={"fixed_device_batch": True, "batch_size": batch_size, "seq_len": seq_len,
+                "model": "BERT-base (12L, 768h, 12 heads)",
+                "flops_per_step": flops})
+
+
+_WORKLOADS = {
+    "resnet50": bench_resnet50,
+    "ncf": bench_ncf,
+    "widedeep": bench_widedeep,
+    "bert": bench_bert,
+}
 
 
 def main():
-    sps, ctx = bench_ncf()
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    names = list(_WORKLOADS) if which == "all" else [which]
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    ctx = init_tpu_context()
+    results = {}
+    for name in names:
+        try:
+            results[name] = _WORKLOADS[name]()
+        except Exception as e:  # keep the headline line even if one fails
+            results[name] = _BenchResult(metric=f"{name}_failed", value=None,
+                                         unit="", mfu=None,
+                                         detail={"error": repr(e)})
+    head = results.get("resnet50") or next(iter(results.values()))
     print(json.dumps({
-        "metric": "ncf_train_samples_per_sec",
-        "value": round(sps, 1),
-        "unit": "samples/s",
+        "metric": head["metric"],
+        "value": head["value"],
+        "unit": head["unit"],
         "vs_baseline": None,
         "detail": {
-            "model": "NeuralCF ml-1m (embed 64, mlp 128-64-32, mf 32)",
-            "batch_size": 8192,
             "platform": ctx.platform,
             "num_devices": ctx.num_devices,
+            "mfu": head.get("mfu"),
+            "workloads": {n: {"metric": r["metric"], "value": r["value"],
+                              "unit": r["unit"], "mfu": r.get("mfu"),
+                              **r.get("detail", {})}
+                          for n, r in results.items()},
         },
     }))
 

@@ -1,0 +1,166 @@
+"""SSD object detection tests (reference test strategy: construct, fit a
+step, predict boxes, evaluate mAP on a toy set — SSDSpec.scala model)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models.image.objectdetection import (
+    ObjectDetector, SSD, decode_boxes, decode_detections, encode_targets,
+    generate_anchors, iou_matrix, multibox_loss, Visualizer)
+from analytics_zoo_tpu.models.image.evaluation import MeanAveragePrecision
+
+_SSD300_ARGS = dict(
+    fmap_sizes=[38, 19, 10, 5, 3, 1],
+    image_size=300,
+    min_sizes=[30, 60, 111, 162, 213, 264],
+    max_sizes=[60, 111, 162, 213, 264, 315],
+    aspect_ratios=[[2], [2, 3], [2, 3], [2, 3], [2], [2]],
+)
+
+
+class TestAnchors:
+    def test_ssd300_anchor_count(self):
+        a = generate_anchors(**_SSD300_ARGS)
+        assert a.shape == (8732, 4)  # the canonical SSD300 anchor count
+        assert np.all(a >= 0) and np.all(a <= 1)
+
+    def test_iou(self):
+        a = np.array([[0, 0, 1, 1]], np.float32)
+        b = np.array([[0, 0, 1, 1], [0.5, 0.5, 1.5, 1.5], [2, 2, 3, 3]],
+                     np.float32)
+        ious = iou_matrix(a, b)[0]
+        np.testing.assert_allclose(ious, [1.0, 0.25 / 1.75, 0.0], rtol=1e-5)
+
+    def test_encode_decode_roundtrip(self):
+        a = generate_anchors(**_SSD300_ARGS)
+        gt = np.array([[0.2, 0.3, 0.6, 0.8]], np.float32)
+        loc_t, cls_t = encode_targets(gt, np.array([5]), a)
+        pos = cls_t > 0
+        assert pos.sum() >= 1
+        decoded = np.asarray(decode_boxes(jnp.asarray(loc_t), jnp.asarray(a)))
+        np.testing.assert_allclose(decoded[pos], np.tile(gt, (pos.sum(), 1)),
+                                   atol=1e-5)
+
+    def test_empty_gt(self):
+        a = generate_anchors(**_SSD300_ARGS)
+        loc_t, cls_t = encode_targets(np.zeros((0, 4), np.float32),
+                                      np.zeros((0,)), a)
+        assert (cls_t == 0).all() and (loc_t == 0).all()
+
+
+class TestMultiBoxLoss:
+    def test_perfect_prediction_low_loss(self):
+        a = generate_anchors(**_SSD300_ARGS)
+        gt = np.array([[0.2, 0.3, 0.6, 0.8]], np.float32)
+        loc_t, cls_t = encode_targets(gt, np.array([1]), a)
+        loss_fn = multibox_loss()
+        A = a.shape[0]
+        y = (jnp.asarray(loc_t)[None], jnp.asarray(cls_t)[None])
+        # logits strongly favoring the target class
+        logits = jnp.full((1, A, 3), -10.0)
+        logits = logits.at[..., 0].set(10.0)
+        pos_idx = np.nonzero(cls_t > 0)[0]
+        logits = logits.at[0, pos_idx, 0].set(-10.0)
+        logits = logits.at[0, pos_idx, 1].set(10.0)
+        good = float(loss_fn(y, [jnp.asarray(loc_t)[None], logits]))
+        bad = float(loss_fn(y, [jnp.zeros((1, A, 4)),
+                                jnp.zeros((1, A, 3))]))
+        assert good < 0.01 < bad
+
+    def test_hard_negative_mining_ratio(self):
+        # with all-background targets there are no positives; loss is finite
+        loss_fn = multibox_loss()
+        y = (jnp.zeros((2, 100, 4)), jnp.zeros((2, 100), jnp.int32))
+        out = float(loss_fn(y, [jnp.zeros((2, 100, 4)),
+                                jnp.zeros((2, 100, 5))]))
+        assert np.isfinite(out)
+
+
+class TestNMS:
+    def test_decode_detections_suppresses_overlaps(self):
+        anchors = np.array([[0.3, 0.3, 0.2, 0.2],
+                            [0.31, 0.31, 0.2, 0.2],
+                            [0.7, 0.7, 0.2, 0.2]], np.float32)
+        loc = jnp.zeros((1, 3, 4))  # boxes == anchors
+        logits = jnp.asarray(
+            [[[0.0, 5.0], [0.0, 4.0], [0.0, 3.0]]])  # 2 classes (bg + 1)
+        boxes, scores, classes = decode_detections(
+            loc, logits, anchors, num_classes=2, score_threshold=0.1,
+            iou_threshold=0.5, max_detections=3)
+        kept = np.asarray(scores[0]) > 0
+        # anchors 0 and 1 overlap heavily: one suppressed; anchor 2 kept
+        assert kept.sum() == 2
+
+    def test_visualizer_draws(self):
+        img = np.zeros((50, 50, 3), np.float32)
+        out = Visualizer(score_threshold=0.1).draw(
+            img, np.array([[0.1, 0.1, 0.6, 0.6]]), np.array([0.9]),
+            np.array([1]))
+        assert out.sum() > 0 and img.sum() == 0  # drew, without mutating input
+
+
+class TestMeanAveragePrecision:
+    def test_perfect_detections(self):
+        m = MeanAveragePrecision(num_classes=3)
+        gt_b = np.array([[0.1, 0.1, 0.3, 0.3], [0.5, 0.5, 0.8, 0.8]])
+        gt_l = np.array([1, 2])
+        m.add(gt_b, np.array([0.9, 0.8]), gt_l, gt_b, gt_l)
+        res = m.compute()
+        assert res["mAP"] == pytest.approx(1.0)
+
+    def test_false_positive_halves_precision(self):
+        m = MeanAveragePrecision(num_classes=2)
+        gt_b = np.array([[0.1, 0.1, 0.3, 0.3]])
+        dets = np.array([[0.1, 0.1, 0.3, 0.3], [0.6, 0.6, 0.9, 0.9]])
+        m.add(dets, np.array([0.9, 0.8]), np.array([1, 1]), gt_b,
+              np.array([1]))
+        res = m.compute()
+        # 1 TP at rank 1 (p=1, r=1), FP after: AP stays 1.0 (recall saturated)
+        assert res["mAP"] == pytest.approx(1.0)
+        # reversed scores: FP first -> precision at recall 1 is 0.5
+        m2 = MeanAveragePrecision(num_classes=2)
+        m2.add(dets, np.array([0.5, 0.8]), np.array([1, 1]), gt_b,
+               np.array([1]))
+        assert m2.compute()["mAP"] == pytest.approx(0.5)
+
+    def test_voc2007_interpolation(self):
+        m = MeanAveragePrecision(num_classes=2, use_voc2007=True)
+        gt_b = np.array([[0.1, 0.1, 0.3, 0.3]])
+        m.add(gt_b, np.array([0.9]), np.array([1]), gt_b, np.array([1]))
+        assert m.compute()["mAP"] == pytest.approx(1.0)
+
+
+class TestSSDEndToEnd:
+    def test_ssd_mobilenet_fit_and_detect(self, ctx):
+        det = ObjectDetector(class_num=3, backbone="mobilenet", resolution=300)
+        det._ensure_built()
+        det.compile("adam", multibox_loss())
+        rs = np.random.RandomState(0)
+        n = 8
+        imgs = rs.rand(n, 300, 300, 3).astype(np.float32)
+        gt_boxes = [np.array([[0.2, 0.2, 0.6, 0.6]], np.float32)] * n
+        gt_labels = [np.array([1])] * n
+        loc_t, cls_t = det.encode_batch(gt_boxes, gt_labels)
+        assert loc_t.shape == (n, 8732, 4) and cls_t.shape == (n, 8732)
+        hist = det.fit(imgs, (loc_t, cls_t), batch_size=8, nb_epoch=1)
+        assert hist["iterations"] >= 1
+        boxes, scores, classes = det.detect(imgs[:8], batch_size=8,
+                                            max_detections=10)
+        assert boxes.shape == (8, 10, 4)
+        assert scores.shape == (8, 10)
+        # mAP machinery runs over the detections
+        m = MeanAveragePrecision(num_classes=3)
+        for i in range(4):
+            m.add(boxes[i], scores[i], classes[i], gt_boxes[i], gt_labels[i])
+        res = m.compute()
+        assert 0.0 <= res["mAP"] <= 1.0
+
+    def test_ssd_vgg16_builds(self, ctx):
+        model, anchors = SSD(21, 300, "vgg16")
+        assert anchors.shape == (8732, 4)
+        params, state = model.build(jax.random.PRNGKey(0))
+        x = jnp.zeros((1, 300, 300, 3))
+        (loc, conf), _ = model.call(params, state, x)
+        assert loc.shape == (1, 8732, 4)
+        assert conf.shape == (1, 8732, 21)
