@@ -111,18 +111,31 @@ class Estimator:
     # -- initialization -------------------------------------------------------
 
     def _ensure_initialized(self, sample_x) -> None:
-        if self.params is not None:
+        if self.params is not None and self.opt_state is not None:
             return
         from ..keras.engine import init_model
         self.root_rng, init_rng = jax.random.split(self.root_rng)
-        params, state = init_model(self.model, init_rng, sample_x)
-        sharding = param_sharding(self.mesh, params, self.param_rules)
-        self.params = jax.device_put(params, sharding)
-        self.model_state = jax.device_put(
-            state, param_sharding(self.mesh, state, self.param_rules))
-        self.opt_state = jax.device_put(
-            self.optimizer.init(self.params),
-            param_sharding(self.mesh, self.optimizer.init(params), None))
+        if self.params is None:
+            params, state = init_model(self.model, init_rng, sample_x)
+            sharding = param_sharding(self.mesh, params, self.param_rules)
+            self.params = jax.device_put(params, sharding)
+            if not self.model_state:
+                self.model_state = jax.device_put(
+                    state, param_sharding(self.mesh, state, self.param_rules))
+        elif not self.model_state:
+            # params were imported (set_params); build only fresh model state
+            # — under jit XLA dead-code-eliminates the (discarded) param init
+            state = jax.jit(
+                lambda r: init_model(self.model, r, sample_x)[1])(init_rng)
+            if jax.tree_util.tree_leaves(state):
+                self.model_state = jax.device_put(
+                    state, param_sharding(self.mesh, state, self.param_rules))
+            else:
+                self.model_state = {}
+        if self.opt_state is None:
+            opt = self.optimizer.init(self.params)
+            self.opt_state = jax.device_put(
+                opt, param_sharding(self.mesh, opt, None))
 
     def _clip_transform(self):
         if self._clip is None:
@@ -155,8 +168,16 @@ class Estimator:
         clip = self._clip_transform()
         cast = self._cast_inputs
 
+        # transfer learning: frozen layers get stop_gradient (XLA then
+        # dead-code-eliminates their backward pass) and zeroed updates (so
+        # weight-decay terms can't drift them either)
+        frozen = frozenset(getattr(model, "frozen_layers", ()) or ())
+
         def train_step(params, opt_state, model_state, rng, x, y):
             def compute_loss(p):
+                if frozen:
+                    p = {k: jax.lax.stop_gradient(v) if k in frozen else v
+                         for k, v in p.items()}
                 if direct is not None:
                     return direct(p, model_state, rng, x, y)
                 y_pred, new_state = model.call(p, model_state, cast(x),
@@ -171,6 +192,10 @@ class Estimator:
             if clip is not None:
                 grads, _ = clip.update(grads, clip.init(params), params)
             updates, opt_state = optimizer.update(grads, opt_state, params)
+            if frozen:
+                updates = {k: jax.tree_util.tree_map(jnp.zeros_like, u)
+                           if k in frozen else u
+                           for k, u in updates.items()}
             params = optax.apply_updates(params, updates)
             return params, opt_state, new_state, loss
 
@@ -230,7 +255,13 @@ class Estimator:
 
         sample = next(train_set.train_iterator(local_batch))
         self._ensure_initialized(sample[0])
-        if self._train_step is None:
+        # freeze()/unfreeze() may have changed since the step was compiled —
+        # the frozen set is baked into the jitted program, so compare rather
+        # than rely on the model holding a reference back to this estimator
+        frozen_now = frozenset(getattr(self.model, "frozen_layers", ()) or ())
+        if self._train_step is None or frozen_now != getattr(
+                self, "_frozen_at_build", frozenset()):
+            self._frozen_at_build = frozen_now
             self._train_step = self._build_train_step()
         if self._tb and self._train_writer is None:
             log_dir, app = self._tb
@@ -435,6 +466,11 @@ class Estimator:
     def set_params(self, params) -> None:
         sharding = param_sharding(self.mesh, params, self.param_rules)
         self.params = jax.device_put(params, sharding)
+
+    def set_model_state(self, state) -> None:
+        """Install non-trainable model state (e.g. imported BN statistics)."""
+        self.model_state = jax.device_put(
+            state, param_sharding(self.mesh, state, self.param_rules))
 
     def _snapshot_tree(self):
         return {

@@ -89,9 +89,23 @@ class Layer:
 def _scope_names(layers: Sequence["Layer"]) -> None:
     """Deterministically rename auto-named layers by position within a
     container, so two structurally identical models share parameter keys
-    (checkpoints stay loadable across model instances/processes)."""
+    (checkpoints stay loadable across model instances/processes). A layer is
+    renamed by the FIRST container that scopes it — shared layers (graph
+    surgery via ``new_graph``, one layer in two graphs) keep their name so an
+    existing params tree still matches."""
     counters: Dict[str, int] = defaultdict(int)
     seen = set()
+    kept: Dict[str, int] = {}
+    for l in layers:
+        if not l._auto_named and kept.setdefault(l.name, id(l)) != id(l):
+            # two DISTINCT layers carrying the same kept/explicit name would
+            # silently share one param-tree slot (build dedups by name) —
+            # e.g. layers scoped in two separate graphs then composed; fail
+            # loudly so the user renames one
+            raise ValueError(
+                f"duplicate layer name '{l.name}' from two different layers "
+                f"in one container; rename one (names key the param tree)")
+    taken = set(kept)
     for layer in layers:
         if id(layer) in seen:
             continue
@@ -99,7 +113,14 @@ def _scope_names(layers: Sequence["Layer"]) -> None:
         cls = type(layer).__name__.lower()
         counters[cls] += 1
         if layer._auto_named:
+            # skip names already held by kept/explicit layers in this
+            # container — a shared layer keeping its old name must not
+            # collide with a freshly scoped one (names are param-tree keys)
+            while f"{cls}_{counters[cls]}" in taken:
+                counters[cls] += 1
             layer.name = f"{cls}_{counters[cls]}"
+            layer._auto_named = False
+            taken.add(layer.name)
 
 
 class Node:
@@ -186,6 +207,84 @@ def Input(shape: Shape, name: Optional[str] = None) -> SymbolicTensor:
 class _TrainableMixin:
     """compile/fit/evaluate/predict surface shared by Sequential and Model
     (the reference ``KerasNet`` contract, Topology.scala:65-260)."""
+
+    # -- transfer learning (reference GraphNet/NetUtils.scala freeze API) -----
+
+    @property
+    def frozen_layers(self):
+        return getattr(self, "_frozen_layers", frozenset())
+
+    def _param_layer_names(self) -> List[str]:
+        """Names of layers that own parameters (top-level param-tree keys)."""
+        if getattr(self, "_param_names_cache", None) is None:
+            est = getattr(self, "_estimator", None)
+            if est is not None and est.params is not None:
+                self._param_names_cache = list(est.params)
+            else:
+                rng = jax.random.PRNGKey(0)
+                if self.built_shape is not None:
+                    # abstract build: names only, no parameter allocation
+                    out = jax.eval_shape(
+                        lambda r: self.build(r, self.built_shape), rng)
+                elif isinstance(self, Model):
+                    out = jax.eval_shape(lambda r: self.build(r), rng)
+                else:
+                    raise RuntimeError("model must be built before freeze()")
+                self._param_names_cache = list(out[0])
+        return self._param_names_cache
+
+    def _invalidate_steps(self):
+        est = getattr(self, "_estimator", None)
+        if est is not None:
+            est._train_step = None
+
+    def _all_layer_names(self) -> set:
+        """TOP-LEVEL layer names only: the param tree (and therefore the
+        freeze mask in the train step) is keyed by these. A nested layer's
+        name can never match a top-level key, so offering it for freeze()
+        would be the silent no-op this validation exists to prevent —
+        freeze the enclosing container instead."""
+        if isinstance(self, Model):
+            return {n.layer.name for n in self._nodes}
+        return {layer.name for layer in getattr(self, "layers", [])}
+
+    def freeze(self, names: Optional[Sequence[str]] = None) -> "Layer":
+        """Freeze the given layers (all param layers if ``names`` is None):
+        their params receive no gradient and no optimizer update. The train
+        step applies ``stop_gradient`` so XLA dead-code-eliminates the
+        frozen backward pass entirely (reference ``NetUtils.scala:79``)."""
+        if names is None:
+            names = self._param_layer_names()
+        elif isinstance(names, str):
+            names = [names]
+        known = self._all_layer_names()
+        try:
+            known |= set(self._param_layer_names())
+        except RuntimeError:
+            pass  # unbuilt Sequential: validate against layer names only
+        unknown = set(names) - known
+        if unknown:
+            # a typo here would silently leave a backbone trainable
+            raise ValueError(f"freeze: unknown layer name(s) {sorted(unknown)}; "
+                             f"known layers: {sorted(known)}")
+        self._frozen_layers = frozenset(self.frozen_layers | set(names))
+        self._invalidate_steps()
+        return self
+
+    def unfreeze(self, names: Optional[Sequence[str]] = None) -> "Layer":
+        """Unfreeze the given layers (all if None) (``NetUtils.scala:87``)."""
+        if names is None:
+            self._frozen_layers = frozenset()
+        else:
+            if isinstance(names, str):
+                names = [names]
+            self._frozen_layers = frozenset(self.frozen_layers - set(names))
+        self._invalidate_steps()
+        return self
+
+    def trainable_param_names(self) -> List[str]:
+        return [n for n in self._param_layer_names()
+                if n not in self.frozen_layers]
 
     def compile(self, optimizer, loss, metrics: Optional[List] = None):
         from . import objectives, optimizers as opt_mod
@@ -275,6 +374,7 @@ class Sequential(Layer, _TrainableMixin):
     def add(self, layer: Layer) -> "Sequential":
         self.layers.append(layer)
         _scope_names(self.layers)
+        self._param_names_cache = None  # freeze API must see the new layer
         return self
 
     def build(self, rng, input_shape):
@@ -395,6 +495,63 @@ class Model(Layer, _TrainableMixin):
     def compute_output_shape(self, input_shape):
         shapes = [o.shape for o in self.outputs]
         return shapes[0] if self._single_output else shapes
+
+    # -- graph surgery (reference GraphNet, NetUtils.scala:29) ----------------
+
+    def flattened_layers(self) -> List[Layer]:
+        """All layers in topological order (reference ``flattenedLayers``)."""
+        return [n.layer for n in self._nodes]
+
+    def _node_by_layer_name(self, name: str) -> Node:
+        for node in self._nodes:
+            if node.layer.name == name:
+                return node
+        raise KeyError(f"no layer named '{name}'; have "
+                       f"{[n.layer.name for n in self._nodes]}")
+
+    def new_graph(self, outputs: Union[str, Sequence[str]]) -> "Model":
+        """Truncate to a new Model whose outputs are the named layers'
+        outputs (reference ``newGraph``, NetUtils.scala:45). Layer names are
+        shared, so a params tree built for the original model works on the
+        truncated one (extra keys are simply unused)."""
+        names = [outputs] if isinstance(outputs, str) else list(outputs)
+        out_syms = []
+        for name in names:
+            node = self._node_by_layer_name(name)
+            shape = node.layer.compute_output_shape(
+                node.inputs[0].shape if len(node.inputs) == 1
+                else [s.shape for s in node.inputs])
+            if isinstance(shape, list):  # multi-output layer: take first
+                shape = shape[0]
+            out_syms.append(SymbolicTensor(tuple(shape), node, 0))
+        model = Model(self.inputs,
+                      out_syms if len(out_syms) > 1 else out_syms[0])
+        model._frozen_layers = frozenset(
+            self.frozen_layers & {n.layer.name for n in model._nodes})
+        return model
+
+    def freeze_up_to(self, names: Union[str, Sequence[str]]) -> "Model":
+        """Freeze every layer from the inputs up to and including the named
+        layers (reference ``freezeUpTo``, NetUtils.scala:95)."""
+        names = [names] if isinstance(names, str) else list(names)
+        seen: set = set()
+
+        def visit(node: Node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for sym in node.inputs:
+                if sym.node is not None:
+                    visit(sym.node)
+
+        frozen_names = []
+        for name in names:
+            visit(self._node_by_layer_name(name))
+        for node in self._nodes:
+            if id(node) in seen and not isinstance(node.layer, InputLayer):
+                frozen_names.append(node.layer.name)
+        param_names = set(self._param_layer_names())
+        return self.freeze([n for n in frozen_names if n in param_names])
 
 
 def init_model(model: Layer, rng: jax.Array, sample_input) -> Tuple[Any, Any]:

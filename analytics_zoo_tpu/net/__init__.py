@@ -1,0 +1,39 @@
+"""Transfer learning and model import (reference ``pipeline/api/net``).
+
+- :class:`Net` — static loaders: our own saved models, ONNX graphs,
+  torch weights (reference ``Net.scala:40`` load/loadBigDL/loadTF/
+  loadCaffe family, re-targeted at the formats that matter on TPU).
+- Graph surgery + freezing live on the engine ``Model`` itself
+  (``new_graph``/``freeze``/``freeze_up_to``/``unfreeze``), mirroring the
+  reference's GraphNet (``NetUtils.scala:29``).
+"""
+from .onnx_loader import OnnxLoaderError, load_onnx  # noqa: F401
+from .torch_import import load_torch, load_torch_state_dict  # noqa: F401
+
+
+class Net:
+    """Static import facade (reference ``Net.scala:40``)."""
+
+    @staticmethod
+    def load(path: str):
+        """Load a model saved with ``ZooModel.save_model`` or
+        ``model.save_model`` (our native checkpoint format)."""
+        import os
+        from ..models.common import ZooModel
+        if os.path.exists(os.path.join(path, "zoo_model.json")):
+            return ZooModel.load_model(path)
+        raise ValueError(
+            f"{path} is not a saved zoo model; for raw estimator "
+            f"checkpoints use Estimator.load_checkpoint")
+
+    @staticmethod
+    def load_onnx(path, dtype=None):
+        """ONNX file → ``(model, params, state)`` (reference
+        ``pyzoo/zoo/pipeline/api/onnx/onnx_loader.py:1``)."""
+        import numpy as np
+        return load_onnx(path, dtype=dtype or np.float32)
+
+    @staticmethod
+    def load_torch(model, module_or_path, strict: bool = True):
+        """Torch weights → ``(params, state)`` for a matching native model."""
+        return load_torch(model, module_or_path, strict=strict)

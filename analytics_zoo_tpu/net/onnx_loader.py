@@ -1,0 +1,543 @@
+"""ONNX graph → native Keras-engine ``Model`` (+ params/state pytrees).
+
+Plays the role of the reference's ONNX loader
+(``pyzoo/zoo/pipeline/api/onnx/onnx_loader.py:1`` +
+``onnx/ops_mapping.py``), but instead of building a BigDL graph it emits the
+functional JAX ``Model`` from :mod:`analytics_zoo_tpu.keras.engine` with a
+ready-made parameter tree, so an imported network drops straight into the
+Estimator/fine-tuning path.
+
+TPU-first layout policy: ONNX is NCHW; TPU convs want NHWC. Rather than
+wrapping every conv in transposes, the importer converts the *graph* once —
+4-D inputs become NHWC, conv kernels are permuted OIHW→HWIO, and a
+Flatten-then-Gemm boundary permutes the Gemm kernel rows so results match the
+original bit-for-bit (up to float assoc).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import onnx_wire as wire
+
+
+class _Value:
+    """A tensor flowing through the import: symbolic or constant."""
+
+    def __init__(self, sym=None, const: Optional[np.ndarray] = None,
+                 layout: Optional[str] = None,
+                 nhwc_shape: Optional[Tuple[int, int, int]] = None):
+        self.sym = sym              # engine SymbolicTensor (runtime tensor)
+        self.const = const          # numpy constant (initializer/Constant op)
+        self.layout = layout        # 'nhwc' = converted from NCHW 4-D
+        self.nhwc_shape = nhwc_shape  # (h, w, c) just before a flatten
+
+
+class OnnxLoaderError(ValueError):
+    pass
+
+
+def _auto(node: Dict[str, Any], prefix: str, idx: int) -> str:
+    name = node.get("name") or ""
+    if name:
+        # keep ONNX names but make them identifier-ish (param-tree keys)
+        return name.replace("/", "_").replace(":", "_").replace(".", "_")
+    return f"{prefix}_{idx}"
+
+
+def _pads_4(attrs) -> Tuple[int, int, int, int]:
+    pads = attrs.get("pads") or [0, 0, 0, 0]
+    if len(pads) == 2:  # 1-D op
+        return pads[0], 0, pads[1], 0
+    return tuple(pads)  # (h_begin, w_begin, h_end, w_end)
+
+
+class _GraphBuilder:
+    def __init__(self, graph: Dict[str, Any], dtype=np.float32):
+        self.graph = graph
+        self.dtype = dtype
+        self.values: Dict[str, _Value] = {}
+        self.params: Dict[str, Any] = {}
+        self.state: Dict[str, Any] = {}
+        self.inputs: List[Any] = []
+
+    # -- helpers -----------------------------------------------------------
+
+    def val(self, name: str) -> _Value:
+        if name not in self.values:
+            raise OnnxLoaderError(f"tensor '{name}' referenced before defined")
+        return self.values[name]
+
+    def const(self, name: str) -> np.ndarray:
+        v = self.val(name)
+        if v.const is None:
+            raise OnnxLoaderError(
+                f"tensor '{name}' must be a constant/initializer for this op")
+        return v.const
+
+    def sym(self, name: str):
+        v = self.val(name)
+        if v.sym is None:
+            raise OnnxLoaderError(f"tensor '{name}' is a constant where a "
+                                  f"runtime tensor was expected")
+        return v.sym
+
+    def set(self, name: str, value: _Value) -> None:
+        self.values[name] = value
+
+    def add_params(self, layer_name: str, p: Dict[str, Any],
+                   s: Optional[Dict[str, Any]] = None) -> None:
+        self.params[layer_name] = {k: np.asarray(v, dtype=self.dtype)
+                                   for k, v in p.items()}
+        if s:
+            self.state[layer_name] = {k: np.asarray(v, dtype=self.dtype)
+                                      for k, v in s.items()}
+
+    # -- graph walk --------------------------------------------------------
+
+    def build(self) -> Tuple[Any, Dict[str, Any], Dict[str, Any]]:
+        from ..keras.engine import Input, Model
+
+        for init in self.graph.get("initializer", []):
+            self.values[init["name"]] = _Value(const=wire.tensor_to_numpy(init))
+
+        input_syms = []
+        for vi in self.graph.get("input", []):
+            name = vi["name"]
+            if name in self.values:  # initializer doubling as graph input
+                continue
+            shape = wire.value_info_shape(vi)
+            if len(shape) == 4:
+                n, c, h, w = shape
+                sym = Input(shape=(h, w, c), name=f"input_{name}")
+                self.set(name, _Value(sym=sym, layout="nhwc"))
+            else:
+                sym = Input(shape=tuple(shape[1:]), name=f"input_{name}")
+                self.set(name, _Value(sym=sym))
+            input_syms.append(sym)
+        if not input_syms:
+            raise OnnxLoaderError("ONNX graph has no runtime inputs")
+
+        for i, node in enumerate(self.graph.get("node", [])):
+            op = node.get("op_type", "")
+            handler = getattr(self, f"op_{op.lower()}", None)
+            if handler is None:
+                raise OnnxLoaderError(
+                    f"unsupported ONNX op '{op}' (node {node.get('name') or i})")
+            handler(node, wire.attributes(node), _auto(node, op.lower(), i))
+
+        outs = []
+        for vi in self.graph.get("output", []):
+            outs.append(self.sym(vi["name"]))
+        model = Model(input_syms, outs if len(outs) > 1 else outs[0])
+        return model, self.params, self.state
+
+    # -- op handlers -------------------------------------------------------
+
+    def _set_out(self, node, sym, layout=None, nhwc_shape=None):
+        self.set(node["output"][0], _Value(sym=sym, layout=layout,
+                                           nhwc_shape=nhwc_shape))
+
+    def op_gemm(self, node, attrs, name):
+        from ..keras.layers import Dense
+        a = self.val(node["input"][0])
+        b = self.const(node["input"][1])
+        c = (self.const(node["input"][2])
+             if len(node["input"]) > 2 else None)
+        if attrs.get("transA"):
+            raise OnnxLoaderError("Gemm with transA on a runtime tensor")
+        kernel = b.T if attrs.get("transB") else b
+        alpha = attrs["alpha"] if attrs.get("alpha") is not None else 1.0
+        beta = attrs["beta"] if attrs.get("beta") is not None else 1.0
+        kernel = kernel * alpha
+        if a.nhwc_shape is not None:
+            # data was flattened from converted-NHWC; permute kernel rows from
+            # ONNX's (c,h,w) flat order to our (h,w,c) flat order
+            h, w, ch = a.nhwc_shape
+            perm = np.arange(ch * h * w).reshape(ch, h, w).transpose(1, 2, 0)
+            kernel = kernel[perm.reshape(-1), :]
+        layer = Dense(kernel.shape[1], bias=c is not None, name=name)
+        p = {"kernel": kernel}
+        if c is not None:
+            p["bias"] = np.reshape(c * beta, (-1,))
+        self.add_params(name, p)
+        self._set_out(node, layer(a.sym))
+
+    def op_matmul(self, node, attrs, name):
+        from ..keras.layers import Dense, Lambda, merge
+        a, b = self.val(node["input"][0]), self.val(node["input"][1])
+        if b.const is not None and b.const.ndim == 2:
+            layer = Dense(b.const.shape[1], bias=False, name=name)
+            kernel = b.const
+            if a.nhwc_shape is not None:
+                h, w, ch = a.nhwc_shape
+                perm = np.arange(ch * h * w).reshape(ch, h, w).transpose(1, 2, 0)
+                kernel = kernel[perm.reshape(-1), :]
+            self.add_params(name, {"kernel": kernel})
+            self._set_out(node, layer(a.sym))
+        elif a.sym is not None and b.sym is not None:
+            import jax.numpy as jnp
+            out = Lambda(lambda xs: jnp.matmul(xs[0], xs[1]), name=name)(
+                [a.sym, b.sym])
+            self._set_out(node, out)
+        else:
+            raise OnnxLoaderError("MatMul operand combination unsupported")
+
+    def _binary(self, node, name, mode, fn):
+        from ..keras.layers import Lambda, merge
+        a, b = self.val(node["input"][0]), self.val(node["input"][1])
+        if a.sym is not None and b.sym is not None:
+            if mode is not None:
+                self._set_out(node, merge([a.sym, b.sym], mode=mode, name=name),
+                              layout=a.layout, nhwc_shape=a.nhwc_shape)
+                return
+            out = Lambda(lambda xs: fn(xs[0], xs[1]), name=name)([a.sym, b.sym])
+            self._set_out(node, out, layout=a.layout, nhwc_shape=a.nhwc_shape)
+            return
+        # one side constant: captured as an XLA literal (non-trainable)
+        v, const = (a, b.const) if a.sym is not None else (b, a.const)
+        if v.layout == "nhwc" and const.ndim >= 3:
+            # move the channel axis of an NCHW-broadcast constant to the end
+            const = np.moveaxis(const, -3, -1)
+        cst = np.asarray(const, dtype=self.dtype)
+        if a.sym is not None:
+            out = Lambda(lambda x, c=cst: fn(x, c), name=name)(v.sym)
+        else:
+            out = Lambda(lambda x, c=cst: fn(c, x), name=name)(v.sym)
+        self._set_out(node, out, layout=v.layout, nhwc_shape=v.nhwc_shape)
+
+    def op_add(self, node, attrs, name):
+        self._binary(node, name, "sum", None)
+
+    def op_sum(self, node, attrs, name):
+        from ..keras.layers import merge
+        syms = [self.sym(i) for i in node["input"]]
+        v0 = self.val(node["input"][0])
+        self._set_out(node, merge(syms, mode="sum", name=name),
+                      layout=v0.layout, nhwc_shape=v0.nhwc_shape)
+
+    def op_sub(self, node, attrs, name):
+        self._binary(node, name, None, lambda x, y: x - y)
+
+    def op_mul(self, node, attrs, name):
+        self._binary(node, name, "mul", None)
+
+    def op_div(self, node, attrs, name):
+        self._binary(node, name, None, lambda x, y: x / y)
+
+    def op_pow(self, node, attrs, name):
+        self._binary(node, name, None, lambda x, y: x ** y)
+
+    def _activation(self, node, name, act):
+        from ..keras.layers import Activation
+        v = self.val(node["input"][0])
+        self._set_out(node, Activation(act, name=name)(v.sym),
+                      layout=v.layout, nhwc_shape=v.nhwc_shape)
+
+    def op_relu(self, node, attrs, name):
+        self._activation(node, name, "relu")
+
+    def op_sigmoid(self, node, attrs, name):
+        self._activation(node, name, "sigmoid")
+
+    def op_tanh(self, node, attrs, name):
+        self._activation(node, name, "tanh")
+
+    def op_softmax(self, node, attrs, name):
+        self._activation(node, name, "softmax")
+
+    def op_exp(self, node, attrs, name):
+        self._activation(node, name, "exp")
+
+    def op_identity(self, node, attrs, name):
+        self.set(node["output"][0], self.val(node["input"][0]))
+
+    def op_cast(self, node, attrs, name):
+        self.set(node["output"][0], self.val(node["input"][0]))
+
+    def op_dropout(self, node, attrs, name):
+        from ..keras.layers import Dropout
+        v = self.val(node["input"][0])
+        ratio = attrs.get("ratio")
+        if ratio is None and len(node["input"]) > 1 and node["input"][1]:
+            ratio = float(self.const(node["input"][1]))  # opset >= 12
+        if ratio is None:
+            ratio = 0.5
+        out = Dropout(float(ratio), name=name)(v.sym)
+        self.set(node["output"][0], _Value(sym=out, layout=v.layout,
+                                           nhwc_shape=v.nhwc_shape))
+
+    def op_leakyrelu(self, node, attrs, name):
+        from ..keras.layers import LeakyReLU
+        v = self.val(node["input"][0])
+        self._set_out(node, LeakyReLU(attrs["alpha"] if attrs.get("alpha") is not None else 0.01,
+                                  name=name)(v.sym),
+                      layout=v.layout, nhwc_shape=v.nhwc_shape)
+
+    def op_elu(self, node, attrs, name):
+        from ..keras.layers import ELU
+        v = self.val(node["input"][0])
+        self._set_out(node, ELU(attrs["alpha"] if attrs.get("alpha") is not None else 1.0,
+                                 name=name)(v.sym),
+                      layout=v.layout, nhwc_shape=v.nhwc_shape)
+
+    def op_clip(self, node, attrs, name):
+        from ..keras.layers import Lambda
+        import jax.numpy as jnp
+        lo = attrs.get("min")
+        hi = attrs.get("max")
+        if lo is None and len(node["input"]) > 1 and node["input"][1]:
+            lo = float(self.const(node["input"][1]))
+        if hi is None and len(node["input"]) > 2 and node["input"][2]:
+            hi = float(self.const(node["input"][2]))
+        v = self.val(node["input"][0])
+        out = Lambda(lambda x: jnp.clip(x, lo, hi), name=name)(v.sym)
+        self._set_out(node, out, layout=v.layout, nhwc_shape=v.nhwc_shape)
+
+    def op_constant(self, node, attrs, name):
+        t = attrs.get("value")
+        if t is None:
+            raise OnnxLoaderError("Constant node without a tensor value")
+        self.set(node["output"][0], _Value(const=np.asarray(t)))
+
+    def op_conv(self, node, attrs, name):
+        from ..keras.engine import SymbolicTensor
+        from ..keras.layers import Convolution2D, Lambda
+        v = self.val(node["input"][0])
+        w = self.const(node["input"][1])  # OIHW
+        b = self.const(node["input"][2]) if len(node["input"]) > 2 else None
+        if w.ndim != 4:
+            raise OnnxLoaderError("only 2-D Conv supported")
+        strides = tuple(attrs.get("strides") or (1, 1))
+        dil = tuple(attrs.get("dilations") or (1, 1))
+        groups = int(attrs.get("group") or 1)
+        h0, w0, h1, w1 = _pads_4(attrs)
+        sym = v.sym
+        if attrs.get("auto_pad") in ("SAME_UPPER", "SAME_LOWER"):
+            border = "same"
+        else:
+            border = "valid"
+            if any((h0, w0, h1, w1)):
+                import jax.numpy as jnp
+                sym = Lambda(lambda x: jnp.pad(
+                    x, ((0, 0), (h0, h1), (w0, w1), (0, 0))),
+                    name=f"{name}_pad")(sym)
+        layer = Convolution2D(w.shape[0], w.shape[2], w.shape[3],
+                              subsample=strides, border_mode=border,
+                              bias=b is not None, dilation=dil, groups=groups,
+                              name=name)
+        p = {"kernel": np.transpose(w, (2, 3, 1, 0))}  # OIHW → HWIO
+        if b is not None:
+            p["bias"] = b
+        self.add_params(name, p)
+        self._set_out(node, layer(sym), layout="nhwc")
+
+    def op_batchnormalization(self, node, attrs, name):
+        from ..keras.layers import BatchNormalization
+        v = self.val(node["input"][0])
+        scale = self.const(node["input"][1])
+        bias = self.const(node["input"][2])
+        mean = self.const(node["input"][3])
+        var = self.const(node["input"][4])
+        layer = BatchNormalization(
+            epsilon=attrs["epsilon"] if attrs.get("epsilon") is not None else 1e-5,
+            momentum=attrs["momentum"] if attrs.get("momentum") is not None
+            else 0.9, axis=-1, name=name)
+        self.add_params(name, {"gamma": scale, "beta": bias},
+                        {"moving_mean": mean, "moving_var": var})
+        self._set_out(node, layer(v.sym), layout=v.layout,
+                      nhwc_shape=v.nhwc_shape)
+
+    def _pool(self, node, attrs, name, cls):
+        from ..keras.layers import Lambda
+        v = self.val(node["input"][0])
+        ks = tuple(attrs.get("kernel_shape") or (2, 2))
+        strides = tuple(attrs.get("strides") or ks)
+        h0, w0, h1, w1 = _pads_4(attrs)
+        sym = v.sym
+        border = "valid"
+        if attrs.get("auto_pad") in ("SAME_UPPER", "SAME_LOWER"):
+            border = "same"
+        elif any((h0, w0, h1, w1)):
+            import jax.numpy as jnp
+            fill = -np.inf if cls.__name__.startswith("Max") else 0.0
+            sym = Lambda(lambda x: jnp.pad(
+                x, ((0, 0), (h0, h1), (w0, w1), (0, 0)),
+                constant_values=fill), name=f"{name}_pad")(sym)
+        layer = cls(pool_size=ks, strides=strides, border_mode=border,
+                    name=name)
+        self._set_out(node, layer(sym), layout="nhwc")
+
+    def op_maxpool(self, node, attrs, name):
+        from ..keras.layers import MaxPooling2D
+        self._pool(node, attrs, name, MaxPooling2D)
+
+    def op_averagepool(self, node, attrs, name):
+        from ..keras.layers import AveragePooling2D, Lambda
+        h0, w0, h1, w1 = _pads_4(attrs)
+        include_pad = bool(attrs.get("count_include_pad", 0))
+        if any((h0, w0, h1, w1)) and not include_pad:
+            # ONNX default excludes padding from the divisor: divide the
+            # zero-padded window sum by a same-padded ones-mask window sum
+            import jax.numpy as jnp
+            from jax import lax
+            v = self.val(node["input"][0])
+            ks = tuple(attrs.get("kernel_shape") or (2, 2))
+            strides = tuple(attrs.get("strides") or ks)
+
+            def avg_excl_pad(x):
+                xp = jnp.pad(x, ((0, 0), (h0, h1), (w0, w1), (0, 0)))
+                mask = jnp.pad(jnp.ones_like(x), ((0, 0), (h0, h1),
+                                                  (w0, w1), (0, 0)))
+                dims, strd = (1, ks[0], ks[1], 1), (1,) + strides + (1,)
+                s = lax.reduce_window(xp, 0.0, lax.add, dims, strd, "VALID")
+                n = lax.reduce_window(mask, 0.0, lax.add, dims, strd, "VALID")
+                return s / n
+
+            self._set_out(node, Lambda(avg_excl_pad, name=name)(v.sym),
+                          layout="nhwc")
+            return
+        self._pool(node, attrs, name, AveragePooling2D)
+
+    def op_globalaveragepool(self, node, attrs, name):
+        from ..keras.layers import GlobalAveragePooling2D
+        v = self.val(node["input"][0])
+        # ONNX keeps (N,C,1,1); downstream Flatten/Reshape collapses it — our
+        # layer goes straight to (N,C), so mark the output already-flat
+        self._set_out(node, GlobalAveragePooling2D(name=name)(v.sym))
+
+    def op_flatten(self, node, attrs, name):
+        from ..keras.layers import Flatten
+        v = self.val(node["input"][0])
+        if v.sym.shape is not None and len(v.sym.shape) == 2:
+            self.set(node["output"][0], v)  # already flat (e.g. after GAP)
+            return
+        nhwc = None
+        if v.layout == "nhwc" and len(v.sym.shape) == 4:
+            _, h, w, c = v.sym.shape
+            nhwc = (h, w, c)
+        self._set_out(node, Flatten(name=name)(v.sym), nhwc_shape=nhwc)
+
+    def op_reshape(self, node, attrs, name):
+        from ..keras.layers import Flatten, Reshape
+        v = self.val(node["input"][0])
+        target = attrs.get("shape")
+        if target is None and len(node["input"]) > 1:
+            target = [int(x) for x in self.const(node["input"][1]).reshape(-1)]
+        if target is None:
+            raise OnnxLoaderError("Reshape without target shape")
+        tail = list(target[1:])
+        if tail == [-1] or (len(tail) == 1 and v.sym.shape is not None):
+            if len(v.sym.shape) == 2:
+                self.set(node["output"][0], v)
+                return
+            nhwc = None
+            if v.layout == "nhwc" and len(v.sym.shape) == 4:
+                _, h, w, c = v.sym.shape
+                nhwc = (h, w, c)
+            self._set_out(node, Flatten(name=name)(v.sym), nhwc_shape=nhwc)
+            return
+        if v.layout == "nhwc":
+            raise OnnxLoaderError(
+                "general Reshape on an NCHW-converted tensor is ambiguous; "
+                "only flatten-style reshapes are supported after convs")
+        self._set_out(node, Reshape(tail, name=name)(v.sym))
+
+    def op_concat(self, node, attrs, name):
+        from ..keras.layers import merge
+        vals = [self.val(i) for i in node["input"]]
+        axis = int(attrs.get("axis") or 0)
+        if vals[0].layout == "nhwc" and axis == 1:
+            axis = 3  # channel concat in the converted layout
+        self._set_out(node, merge([v.sym for v in vals], mode="concat",
+                                  concat_axis=axis, name=name),
+                      layout=vals[0].layout)
+
+    def op_transpose(self, node, attrs, name):
+        from ..keras.layers import Permute
+        v = self.val(node["input"][0])
+        if v.layout == "nhwc":
+            raise OnnxLoaderError("Transpose after conv conversion unsupported")
+        perm = attrs.get("perm")
+        if perm is None or perm[0] != 0:
+            raise OnnxLoaderError("Transpose must keep the batch axis first")
+        self._set_out(node, Permute([int(p) for p in perm[1:]], name=name)(v.sym))
+
+    def op_unsqueeze(self, node, attrs, name):
+        from ..keras.layers import ExpandDim
+        v = self.val(node["input"][0])
+        axes = attrs.get("axes")
+        if axes is None and len(node["input"]) > 1:
+            axes = [int(x) for x in self.const(node["input"][1]).reshape(-1)]
+        if v.const is not None:
+            self.set(node["output"][0],
+                     _Value(const=np.expand_dims(v.const, tuple(axes))))
+            return
+        sym = v.sym
+        for ax in sorted(axes):
+            sym = ExpandDim(ax, name=f"{name}_{ax}")(sym)
+        self._set_out(node, sym)
+
+    def op_squeeze(self, node, attrs, name):
+        from ..keras.layers import Squeeze
+        v = self.val(node["input"][0])
+        axes = attrs.get("axes")
+        if axes is None and len(node["input"]) > 1:
+            axes = [int(x) for x in self.const(node["input"][1]).reshape(-1)]
+        if v.const is not None:
+            self.set(node["output"][0],
+                     _Value(const=np.squeeze(v.const, tuple(axes))))
+            return
+        sym = v.sym
+        for ax in sorted(axes, reverse=True):
+            sym = Squeeze(ax, name=f"{name}_{ax}")(sym)
+        self._set_out(node, sym)
+
+    def op_reducemean(self, node, attrs, name):
+        from ..keras.layers import Lambda
+        import jax.numpy as jnp
+        v = self.val(node["input"][0])
+        axes = tuple(attrs.get("axes") or ())
+        keep = bool(attrs.get("keepdims", 1))
+        if v.layout == "nhwc" and axes:
+            # graph was converted NCHW→NHWC: remap axis 1(C)→3, 2(H)→1, 3(W)→2
+            axes = tuple({1: 3, 2: 1, 3: 2}.get(a, a) for a in axes)
+        out = Lambda(lambda x: jnp.mean(x, axis=axes or None, keepdims=keep),
+                     name=name)(v.sym)
+        # keepdims on a converted tensor stays NHWC; a full spatial reduce
+        # without keepdims yields (N, C) — already flat, no layout to track
+        layout = v.layout if (keep and v.layout == "nhwc") else None
+        self._set_out(node, out, layout=layout)
+
+    def op_gather(self, node, attrs, name):
+        from ..keras.layers import Embedding
+        v = self.val(node["input"][0])
+        idx = self.val(node["input"][1])
+        if v.const is not None and idx.sym is not None and v.const.ndim == 2 \
+                and int(attrs.get("axis") or 0) == 0:
+            # embedding lookup: table is the constant, indices are runtime
+            layer = Embedding(v.const.shape[0], v.const.shape[1], name=name)
+            self.add_params(name, {"table": v.const})
+            self._set_out(node, layer(idx.sym))
+            return
+        raise OnnxLoaderError("Gather supported only as embedding lookup")
+
+
+def load_onnx(path_or_bytes, dtype=np.float32):
+    """Import an ONNX model.
+
+    Returns ``(model, params, state)`` where ``model`` is an engine ``Model``
+    and ``params``/``state`` are ready for ``Estimator.set_params`` /
+    ``model.call``.
+    """
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        data = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as f:
+            data = f.read()
+    proto = wire.load_model(data)
+    graph = proto.get("graph")
+    if not graph:
+        raise OnnxLoaderError("no graph in ONNX model (corrupt file?)")
+    return _GraphBuilder(graph, dtype=dtype).build()

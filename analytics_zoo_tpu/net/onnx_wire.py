@@ -1,0 +1,289 @@
+"""Minimal protobuf wire-format decoder for ONNX ModelProto.
+
+The reference imports ONNX graphs through the ``onnx`` python package
+(``pyzoo/zoo/pipeline/api/onnx/onnx_loader.py:1``); that package is not a
+dependency here, and the wire format is simple enough that a schema-driven
+decoder for the handful of ONNX messages we need (ModelProto, GraphProto,
+NodeProto, TensorProto, AttributeProto, ValueInfoProto) is ~200 lines and
+imports nothing but numpy. Field numbers follow the public ``onnx.proto3``
+schema.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# wire types
+_VARINT, _I64, _LEN, _I32 = 0, 1, 2, 5
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long (corrupt protobuf)")
+
+
+def _skip(buf: bytes, pos: int, wire_type: int) -> int:
+    if wire_type == _VARINT:
+        _, pos = _read_varint(buf, pos)
+        return pos
+    if wire_type == _I64:
+        return pos + 8
+    if wire_type == _LEN:
+        n, pos = _read_varint(buf, pos)
+        return pos + n
+    if wire_type == _I32:
+        return pos + 4
+    raise ValueError(f"unsupported wire type {wire_type}")
+
+
+def _zigzag(v: int) -> int:
+    # onnx uses plain int64 (not sint64); negative ints arrive as 2^64-|v|
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+class Field:
+    """One schema entry: how to decode a field number."""
+
+    def __init__(self, name: str, kind: str, repeated: bool = False,
+                 schema: Optional[Dict[int, "Field"]] = None):
+        self.name = name
+        self.kind = kind  # int | float32 | string | bytes | message | packed_int | packed_float
+        self.repeated = repeated
+        self.schema = schema
+
+
+def parse(buf: bytes, schema: Dict[int, Field]) -> Dict[str, Any]:
+    """Decode one message with the given schema; unknown fields are skipped."""
+    out: Dict[str, Any] = {}
+    for fno, f in schema.items():
+        if f.repeated:
+            out[f.name] = []
+    pos, end = 0, len(buf)
+    while pos < end:
+        key, pos = _read_varint(buf, pos)
+        fno, wt = key >> 3, key & 7
+        f = schema.get(fno)
+        if f is None:
+            pos = _skip(buf, pos, wt)
+            continue
+        val: Any
+        if f.kind == "int":
+            if wt == _VARINT:
+                v, pos = _read_varint(buf, pos)
+                val = _zigzag(v)
+            elif wt == _LEN:  # packed repeated ints
+                n, pos = _read_varint(buf, pos)
+                sub_end = pos + n
+                vals = []
+                while pos < sub_end:
+                    v, pos = _read_varint(buf, pos)
+                    vals.append(_zigzag(v))
+                out[f.name].extend(vals)
+                continue
+            else:
+                pos = _skip(buf, pos, wt)
+                continue
+        elif f.kind == "float32":
+            if wt == _I32:
+                val = struct.unpack_from("<f", buf, pos)[0]
+                pos += 4
+            elif wt == _LEN:  # packed floats
+                n, pos = _read_varint(buf, pos)
+                out[f.name].extend(
+                    np.frombuffer(buf, dtype="<f4", count=n // 4, offset=pos))
+                pos += n
+                continue
+            else:
+                pos = _skip(buf, pos, wt)
+                continue
+        elif f.kind == "float64":
+            if wt == _I64:
+                val = struct.unpack_from("<d", buf, pos)[0]
+                pos += 8
+            elif wt == _LEN:
+                n, pos = _read_varint(buf, pos)
+                out[f.name].extend(
+                    np.frombuffer(buf, dtype="<f8", count=n // 8, offset=pos))
+                pos += n
+                continue
+            else:
+                pos = _skip(buf, pos, wt)
+                continue
+        elif f.kind in ("string", "bytes", "message"):
+            if wt != _LEN:
+                pos = _skip(buf, pos, wt)
+                continue
+            n, pos = _read_varint(buf, pos)
+            raw = buf[pos:pos + n]
+            pos += n
+            if f.kind == "string":
+                val = raw.decode("utf-8", errors="replace")
+            elif f.kind == "bytes":
+                val = raw
+            else:
+                val = parse(raw, f.schema)
+        else:
+            raise ValueError(f"unknown schema kind {f.kind}")
+        if f.repeated:
+            out[f.name].append(val)
+        else:
+            out[f.name] = val
+    return out
+
+
+# --------------------------------------------------------------------------
+# ONNX message schemas (field numbers from onnx/onnx.proto3)
+# --------------------------------------------------------------------------
+
+TENSOR_SCHEMA: Dict[int, Field] = {
+    1: Field("dims", "int", repeated=True),
+    2: Field("data_type", "int"),
+    4: Field("float_data", "float32", repeated=True),
+    5: Field("int32_data", "int", repeated=True),
+    6: Field("string_data", "bytes", repeated=True),
+    7: Field("int64_data", "int", repeated=True),
+    8: Field("name", "string"),
+    9: Field("raw_data", "bytes"),
+    10: Field("double_data", "float64", repeated=True),
+    11: Field("uint64_data", "int", repeated=True),
+}
+
+_DIM_SCHEMA = {
+    1: Field("dim_value", "int"),
+    2: Field("dim_param", "string"),
+}
+_SHAPE_SCHEMA = {1: Field("dim", "message", repeated=True, schema=_DIM_SCHEMA)}
+_TENSOR_TYPE_SCHEMA = {
+    1: Field("elem_type", "int"),
+    2: Field("shape", "message", schema=_SHAPE_SCHEMA),
+}
+_TYPE_SCHEMA = {1: Field("tensor_type", "message", schema=_TENSOR_TYPE_SCHEMA)}
+VALUE_INFO_SCHEMA = {
+    1: Field("name", "string"),
+    2: Field("type", "message", schema=_TYPE_SCHEMA),
+}
+
+ATTRIBUTE_SCHEMA: Dict[int, Field] = {
+    1: Field("name", "string"),
+    2: Field("f", "float32"),
+    3: Field("i", "int"),
+    4: Field("s", "bytes"),
+    5: Field("t", "message", schema=TENSOR_SCHEMA),
+    7: Field("floats", "float32", repeated=True),
+    8: Field("ints", "int", repeated=True),
+    9: Field("strings", "bytes", repeated=True),
+    10: Field("tensors", "message", repeated=True, schema=TENSOR_SCHEMA),
+    20: Field("type", "int"),
+}
+
+NODE_SCHEMA: Dict[int, Field] = {
+    1: Field("input", "string", repeated=True),
+    2: Field("output", "string", repeated=True),
+    3: Field("name", "string"),
+    4: Field("op_type", "string"),
+    5: Field("attribute", "message", repeated=True, schema=ATTRIBUTE_SCHEMA),
+    7: Field("domain", "string"),
+}
+
+GRAPH_SCHEMA: Dict[int, Field] = {
+    1: Field("node", "message", repeated=True, schema=NODE_SCHEMA),
+    2: Field("name", "string"),
+    5: Field("initializer", "message", repeated=True, schema=TENSOR_SCHEMA),
+    11: Field("input", "message", repeated=True, schema=VALUE_INFO_SCHEMA),
+    12: Field("output", "message", repeated=True, schema=VALUE_INFO_SCHEMA),
+    13: Field("value_info", "message", repeated=True, schema=VALUE_INFO_SCHEMA),
+}
+
+_OPSET_SCHEMA = {1: Field("domain", "string"), 2: Field("version", "int")}
+MODEL_SCHEMA: Dict[int, Field] = {
+    1: Field("ir_version", "int"),
+    2: Field("producer_name", "string"),
+    7: Field("graph", "message", schema=GRAPH_SCHEMA),
+    8: Field("opset_import", "message", repeated=True, schema=_OPSET_SCHEMA),
+}
+
+# TensorProto.DataType → numpy
+_DTYPES = {
+    1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16, 5: np.int16,
+    6: np.int32, 7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64,
+    12: np.uint32, 13: np.uint64,
+}
+
+
+def tensor_to_numpy(t: Dict[str, Any]) -> np.ndarray:
+    """TensorProto dict → ndarray (raw_data or the typed repeated fields)."""
+    dims = tuple(t.get("dims", []))
+    dt = _DTYPES.get(t.get("data_type", 1))
+    if dt is None:
+        raise ValueError(f"unsupported ONNX tensor dtype {t.get('data_type')}")
+    raw = t.get("raw_data")
+    if raw:
+        arr = np.frombuffer(raw, dtype=np.dtype(dt).newbyteorder("<"))
+    elif t.get("float_data"):
+        arr = np.asarray(t["float_data"], dtype=np.float32)
+    elif t.get("int64_data"):
+        arr = np.asarray(t["int64_data"], dtype=np.int64)
+    elif t.get("int32_data"):
+        arr = np.asarray(t["int32_data"], dtype=np.int32)
+    elif t.get("double_data"):
+        arr = np.asarray(t["double_data"], dtype=np.float64)
+    else:
+        arr = np.zeros(int(np.prod(dims)) if dims else 0, dtype=dt)
+    return arr.astype(dt, copy=False).reshape(dims)
+
+
+def attributes(node: Dict[str, Any]) -> Dict[str, Any]:
+    """NodeProto attribute list → {name: python value}."""
+    out: Dict[str, Any] = {}
+    for a in node.get("attribute", []):
+        name = a.get("name", "")
+        # AttributeProto.type: 1=FLOAT 2=INT 3=STRING 4=TENSOR 6=FLOATS 7=INTS 8=STRINGS
+        # proto3 omits default-valued scalars from the wire, so a typed FLOAT/
+        # INT attribute with no payload means 0.0/0 — not "absent"
+        atype = a.get("type")
+        if atype == 1 or (atype is None and "f" in a):
+            out[name] = a.get("f", 0.0)
+        elif atype == 2 or (atype is None and "i" in a):
+            out[name] = a.get("i", 0)
+        elif atype == 3 or (atype is None and "s" in a):
+            s = a.get("s", b"")
+            out[name] = s.decode("utf-8", errors="replace")
+        elif atype == 4 or (atype is None and "t" in a):
+            out[name] = tensor_to_numpy(a["t"])
+        elif atype == 6 or a.get("floats"):
+            out[name] = [float(v) for v in a.get("floats", [])]
+        elif atype == 7 or a.get("ints"):
+            out[name] = [int(v) for v in a.get("ints", [])]
+        elif atype == 8 or a.get("strings"):
+            out[name] = [s.decode("utf-8", errors="replace")
+                         for s in a.get("strings", [])]
+        else:
+            out[name] = None
+    return out
+
+
+def load_model(data: bytes) -> Dict[str, Any]:
+    """Decode serialized ModelProto bytes → nested dict."""
+    return parse(data, MODEL_SCHEMA)
+
+
+def value_info_shape(vi: Dict[str, Any]) -> List[Optional[int]]:
+    """ValueInfoProto → [dim or None, ...] (None = symbolic/batch dim)."""
+    tt = (vi.get("type") or {}).get("tensor_type") or {}
+    dims = (tt.get("shape") or {}).get("dim", [])
+    shape: List[Optional[int]] = []
+    for d in dims:
+        v = d.get("dim_value")
+        shape.append(int(v) if v else None)
+    return shape

@@ -1,0 +1,176 @@
+"""Import torch module weights into native engine models.
+
+The reference's ``TorchNet`` ships a TorchScript blob to JVM executors
+(``zoo/src/main/scala/com/intel/analytics/zoo/pipeline/api/net/TorchNet.scala:1``);
+here the useful capability is *weight transfer*: take a trained
+``torch.nn.Module`` (or saved ``state_dict``) and produce the parameter
+pytree for a structurally matching native model, so fine-tuning continues on
+TPU. (Inference on an opaque TorchScript module is served separately by
+``inference.InferenceModel.load_torch`` on host CPU.)
+
+Matching is *by order and kind*: parameter-bearing torch submodules
+(Linear/Conv2d/BatchNorm2d/Embedding/...) are aligned with the native
+model's parameter-bearing layers in topological order — the same contract
+torchvision-style sequential definitions satisfy naturally.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _group_state_dict(state_dict) -> List[Tuple[str, Dict[str, np.ndarray]]]:
+    """Group flat ``a.b.weight``-style keys by owning module prefix,
+    preserving insertion order (torch state_dicts are ordered)."""
+    groups: Dict[str, Dict[str, np.ndarray]] = {}
+    for key, tensor in state_dict.items():
+        prefix, _, leaf = key.rpartition(".")
+        arr = np.asarray(tensor.detach().cpu().numpy()
+                         if hasattr(tensor, "detach") else tensor)
+        groups.setdefault(prefix, {})[leaf] = arr
+    return list(groups.items())
+
+
+def _kind_of_group(leaves: Dict[str, np.ndarray]) -> Optional[str]:
+    if "running_mean" in leaves:
+        return "batchnorm"
+    w = leaves.get("weight")
+    if w is None:
+        return None
+    if w.ndim == 4:
+        return "conv2d"
+    if w.ndim == 2:
+        return "linear"  # 2-D: Linear (or Embedding — resolved at match time)
+    if w.ndim == 1:
+        return "norm1d"  # LayerNorm / affine-only
+    return None
+
+
+def _native_kind(layer) -> Optional[str]:
+    name = type(layer).__name__
+    if name in ("Dense",):
+        return "linear"
+    if name in ("Convolution2D", "Conv2D", "SeparableConvolution2D",
+                "Deconvolution2D", "AtrousConvolution2D", "ShareConvolution2D"):
+        return "conv2d"
+    if name == "BatchNormalization":
+        return "batchnorm"
+    if name == "LayerNormalization":
+        return "norm1d"
+    if name in ("Embedding", "WordEmbedding", "SparseEmbedding"):
+        return "embedding"
+    return None
+
+
+def _param_layers(model) -> List[Tuple[Tuple[str, ...], Any]]:
+    """Parameter-bearing layers in build order, with their param-tree paths.
+
+    The native param tree nests by container (``Sequential.build`` stores a
+    sub-dict per child container), so each leaf layer is addressed by the
+    chain of container-level keys down to it.
+    """
+    from ..keras.engine import Model, Sequential
+    out: List[Tuple[Tuple[str, ...], Any]] = []
+
+    def walk(m, path):
+        if isinstance(m, Sequential):
+            for l in m.layers:
+                walk(l, path + (l.name,))
+        elif isinstance(m, Model):
+            seen = set()
+            for node in m._nodes:
+                if id(node.layer) not in seen:
+                    seen.add(id(node.layer))
+                    walk(node.layer, path + (node.layer.name,))
+        else:
+            if _native_kind(m) is not None:
+                out.append((path, m))
+    walk(model, ())
+    return out
+
+
+def _set_path(tree: Dict[str, Any], path: Tuple[str, ...], value) -> None:
+    for key in path[:-1]:
+        tree = tree.setdefault(key, {})
+    tree[path[-1]] = value
+
+
+def convert_group(kind: str, leaves: Dict[str, np.ndarray]
+                  ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+    """One torch module's tensors → native (params, state) for its layer."""
+    if kind == "linear":
+        p = {"kernel": leaves["weight"].T}
+        if "bias" in leaves:
+            p["bias"] = leaves["bias"]
+        return p, {}
+    if kind == "conv2d":
+        # torch OIHW → native HWIO
+        p = {"kernel": np.transpose(leaves["weight"], (2, 3, 1, 0))}
+        if "bias" in leaves:
+            p["bias"] = leaves["bias"]
+        return p, {}
+    if kind == "batchnorm":
+        return ({"gamma": leaves["weight"], "beta": leaves["bias"]},
+                {"moving_mean": leaves["running_mean"],
+                 "moving_var": leaves["running_var"]})
+    if kind == "norm1d":
+        return {"gamma": leaves["weight"], "beta": leaves.get(
+            "bias", np.zeros_like(leaves["weight"]))}, {}
+    if kind == "embedding":
+        return {"table": leaves["weight"]}, {}
+    raise ValueError(f"unhandled torch module kind {kind}")
+
+
+def load_torch_state_dict(model, state_dict, strict: bool = True
+                          ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Map a torch ``state_dict`` onto ``model``'s layers by order + kind.
+
+    Returns ``(params, state)`` pytrees keyed by native layer names. With
+    ``strict`` every torch parameter group must be consumed and every native
+    param layer filled.
+    """
+    groups = [(prefix, leaves, _kind_of_group(leaves))
+              for prefix, leaves in _group_state_dict(state_dict)]
+    groups = [(p, l, k) for p, l, k in groups if k is not None]
+    layers = _param_layers(model)
+    params: Dict[str, Any] = {}
+    state: Dict[str, Any] = {}
+    gi = 0
+    for path, layer in layers:
+        want = _native_kind(layer)
+        # embeddings and linears share torch kind 'linear' when 2-D; accept
+        matches = {want, "linear" if want == "embedding" else want}
+        while gi < len(groups) and groups[gi][2] not in matches:
+            if strict:
+                raise ValueError(
+                    f"torch group '{groups[gi][0]}' ({groups[gi][2]}) does "
+                    f"not match native layer '{layer.name}' ({want})")
+            gi += 1
+        if gi >= len(groups):
+            raise ValueError(
+                f"ran out of torch parameter groups at native layer "
+                f"'{layer.name}' ({want}); {len(layers)} layers vs "
+                f"{len(groups)} groups")
+        prefix, leaves, kind = groups[gi]
+        gi += 1
+        p, s = convert_group(want if want == "embedding" else kind, leaves)
+        _set_path(params, path, p)
+        if s:
+            _set_path(state, path, s)
+    if strict and gi != len(groups):
+        leftover = [g[0] for g in groups[gi:]]
+        raise ValueError(f"unconsumed torch parameter groups: {leftover}")
+    return params, state
+
+
+def load_torch(model, module_or_path, strict: bool = True):
+    """Accept an ``nn.Module``, a ``state_dict``, or a ``.pt`` path."""
+    sd = module_or_path
+    if isinstance(module_or_path, str):
+        import torch
+        sd = torch.load(module_or_path, map_location="cpu",
+                        weights_only=True)
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    return load_torch_state_dict(model, sd, strict=strict)

@@ -42,6 +42,12 @@ class _BenchResult(dict):
     pass
 
 
+def _transient(e: Exception) -> bool:
+    msg = repr(e)
+    return any(s in msg for s in ("remote_compile", "response body closed",
+                                  "DEADLINE_EXCEEDED", "UNAVAILABLE"))
+
+
 def _run_steps(est, bx, by, steps, warmup):
     """Time `steps` train steps on a fixed device-resident batch (the input
     pipeline is measured separately — this isolates device throughput);
@@ -240,12 +246,19 @@ def main():
     ctx = init_tpu_context()
     results = {}
     for name in names:
-        try:
-            results[name] = _WORKLOADS[name]()
-        except Exception as e:  # keep the headline line even if one fails
-            results[name] = _BenchResult(metric=f"{name}_failed", value=None,
-                                         unit="", mfu=None,
-                                         detail={"error": repr(e)})
+        # the tunnel to the remote compile service occasionally drops the
+        # response mid-body on big HLO programs; retry before giving up
+        for attempt in range(3):
+            try:
+                results[name] = _WORKLOADS[name]()
+                break
+            except Exception as e:  # keep the headline line even if one fails
+                results[name] = _BenchResult(metric=f"{name}_failed", value=None,
+                                             unit="", mfu=None,
+                                             detail={"error": repr(e)})
+                if not _transient(e) or attempt == 2:
+                    break
+                time.sleep(5 * (attempt + 1))
     head = results.get("resnet50") or next(iter(results.values()))
     print(json.dumps({
         "metric": head["metric"],
