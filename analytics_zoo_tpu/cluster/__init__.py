@@ -1,0 +1,5 @@
+"""Pod orchestration: multi-process launch, coordination, failure detection
+(the reference's RayOnSpark layer, ``pyzoo/zoo/ray/raycontext.py:190``,
+re-designed for TPU pods on ``jax.distributed``)."""
+from .launcher import (  # noqa: F401
+    PodLauncher, PodLaunchError, WorkerResult, run_pod)

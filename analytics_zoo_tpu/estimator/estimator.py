@@ -287,9 +287,31 @@ class Estimator:
                      or getattr(validation_trigger, "requires_loss", True)
                      or getattr(checkpoint_trigger, "requires_loss", True))
 
+        # the data pipeline is part of the checkpoint: expose enough state for
+        # _snapshot_tree to record "which permutation, how far in"
+        self._active_train_set = train_set
+        self._batches_per_epoch = batches_per_epoch
+        self._local_batch = local_batch
+
         while not end_trigger(state):
-            feed = DeviceFeed(train_set.train_iterator(local_batch), self.mesh)
-            epoch_iter = 0
+            skip = 0
+            if getattr(self, "_restore_data", None) is not None:
+                rng_json, skip, saved_batch = self._restore_data
+                self._restore_data = None
+                train_set.set_data_state(rng_json)
+                if skip and saved_batch and saved_batch != local_batch:
+                    raise ValueError(
+                        f"resuming a mid-epoch snapshot taken with per-host "
+                        f"batch {saved_batch} using batch {local_batch} would "
+                        f"replay the wrong records; resume with the original "
+                        f"batch size (or from an epoch-boundary snapshot)")
+                skip = min(skip, batches_per_epoch)
+            self._epoch_data_state = train_set.data_state()
+            feed = DeviceFeed(
+                train_set.train_iterator(local_batch, skip_batches=skip),
+                self.mesh)
+            epoch_iter = skip
+            self._epoch_offset = epoch_iter
             try:
                 for x, y in feed:
                     step_rng = jax.random.fold_in(self.root_rng, self.global_step)
@@ -300,6 +322,7 @@ class Estimator:
                             step_rng, x, y)
                     self.global_step += 1
                     epoch_iter += 1
+                    self._epoch_offset = epoch_iter
                     state.iteration = self.global_step
                     pending.append(loss)
 
@@ -473,12 +496,28 @@ class Estimator:
             state, param_sharding(self.mesh, state, self.param_rules))
 
     def _snapshot_tree(self):
-        return {
+        tree = {
             "params": jax.tree_util.tree_map(np.asarray, self.params),
             "opt_state": jax.tree_util.tree_map(np.asarray, self.opt_state),
             "model_state": jax.tree_util.tree_map(np.asarray, self.model_state),
             "meta": {"global_step": self.global_step, "epoch": self.epoch},
         }
+        ts = getattr(self, "_active_train_set", None)
+        if ts is not None:
+            # data-pipeline state: an epoch-end snapshot records the post-epoch
+            # RNG (next epoch starts fresh); a mid-epoch one records the
+            # epoch-START rng + batches consumed so resume replays the same
+            # permutation from the same position. JSON→uint8 so orbax treats
+            # it as a plain array leaf.
+            if self._epoch_offset >= self._batches_per_epoch:
+                rng_json, offset = ts.data_state(), 0
+            else:
+                rng_json, offset = self._epoch_data_state, self._epoch_offset
+            tree["meta"]["data_rng"] = np.frombuffer(
+                rng_json.encode(), dtype=np.uint8).copy()
+            tree["meta"]["data_offset"] = offset
+            tree["meta"]["data_batch"] = self._local_batch
+        return tree
 
     def _save_snapshot(self) -> None:
         path = os.path.join(self._ckpt_dir, f"snapshot-{self.global_step}")
@@ -494,16 +533,38 @@ class Estimator:
         return os.path.join(self._ckpt_dir, newest)
 
     def save_checkpoint(self, path: str) -> None:
+        """Write a snapshot. EVERY process must call this: orbax's save is a
+        collective (it barriers across ``jax.process_count()`` processes and
+        elects process 0 as the writer) — gating it to rank 0 deadlocks the
+        pod at the barrier."""
         import orbax.checkpoint as ocp
-        if self.ctx.process_index == 0:
-            ckptr = ocp.PyTreeCheckpointer()
-            ckptr.save(os.path.abspath(path), self._snapshot_tree(), force=True)
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.abspath(path), self._snapshot_tree(), force=True)
 
     def load_checkpoint(self, path: str) -> None:
+        """Restore a snapshot. Restores are data-only (orbax reads arrays,
+        never pickled code — the CheckedObjectInputStream concern from the
+        reference, ``common/CheckedObjectInputStream.scala:1``, is designed
+        away), but the STRUCTURE is still validated before any state is
+        touched so a truncated/foreign checkpoint can't half-install."""
         import orbax.checkpoint as ocp
         ckptr = ocp.PyTreeCheckpointer()
         path = os.path.abspath(path)
         tree = ckptr.restore(path)
+        missing = {"params", "opt_state", "model_state", "meta"} - set(tree)
+        if missing:
+            raise ValueError(
+                f"checkpoint at {path} is not an estimator snapshot "
+                f"(missing {sorted(missing)})")
+        if self.params is not None:
+            live = jax.tree_util.tree_structure(
+                jax.tree_util.tree_map(lambda x: 0, self.params))
+            loaded = jax.tree_util.tree_structure(
+                jax.tree_util.tree_map(lambda x: 0, tree["params"]))
+            if live != loaded:
+                raise ValueError(
+                    f"checkpoint param structure does not match the live "
+                    f"model: {loaded} vs {live}")
         # orbax returns optax NamedTuple states as plain containers; re-restore
         # with a live template so the optimizer state keeps its structure.
         live_opt = (self.opt_state if self.opt_state is not None
@@ -523,3 +584,8 @@ class Estimator:
             tree["opt_state"], param_sharding(self.mesh, tree["opt_state"], None))
         self.global_step = int(tree["meta"]["global_step"])
         self.epoch = int(tree["meta"]["epoch"])
+        if "data_rng" in tree["meta"]:
+            rng_json = bytes(np.asarray(tree["meta"]["data_rng"])).decode()
+            self._restore_data = (rng_json,
+                                  int(tree["meta"]["data_offset"]),
+                                  int(tree["meta"].get("data_batch", 0)))

@@ -193,14 +193,36 @@ class FeatureSet:
              if self.labels is not None else None)
         return x, y
 
-    def train_iterator(self, batch_size: int) -> Iterator[Tuple[ArrayTree, Optional[ArrayTree]]]:
+    def train_iterator(self, batch_size: int, skip_batches: int = 0
+                       ) -> Iterator[Tuple[ArrayTree, Optional[ArrayTree]]]:
         """Endless iterator; reshuffles every epoch; drops the remainder so
-        every step sees a full, static-shaped batch (XLA-friendly)."""
+        every step sees a full, static-shaped batch (XLA-friendly).
+
+        ``skip_batches`` fast-forwards within the FIRST epoch only — the
+        checkpoint-resume path replays the restored epoch's permutation and
+        skips the batches already trained on."""
         while True:
             order = (self._rng.permutation(self.size) if self.shuffle
                      else np.arange(self.size))
-            for start in range(0, self.size - batch_size + 1, batch_size):
+            first = skip_batches * batch_size
+            skip_batches = 0
+            for start in range(first, self.size - batch_size + 1, batch_size):
                 yield self._gather(order[start:start + batch_size])
+
+    # -- checkpointable iteration state (SURVEY §7 step 3: resume must replay
+    # -- the SAME data order an uninterrupted run would have seen) ------------
+
+    def data_state(self) -> str:
+        """Serialized shuffle-RNG state; JSON (PCG64 state holds 128-bit
+        ints, which JSON carries exactly and numpy cannot)."""
+        import json
+        return json.dumps(self._rng.bit_generator.state)
+
+    def set_data_state(self, state_json: str) -> None:
+        import json
+        rng = np.random.default_rng()
+        rng.bit_generator.state = json.loads(state_json)
+        self._rng = rng
 
     def eval_iterator(self, batch_size: int, pad_remainder: bool = False
                       ) -> Iterator[Tuple[ArrayTree, Optional[ArrayTree], int]]:

@@ -53,6 +53,28 @@ def _pads_4(attrs) -> Tuple[int, int, int, int]:
     return tuple(pads)  # (h_begin, w_begin, h_end, w_end)
 
 
+def _same_lower_pads(in_hw, kernel, strides, dilations=(1, 1)
+                     ) -> Tuple[int, int, int, int]:
+    """Explicit pads for ONNX auto_pad=SAME_LOWER (extra pad at the BEGIN
+    side; XLA's "SAME" is SAME_UPPER, so this must be materialized)."""
+    out = []
+    for size, k, s, d in zip(in_hw, kernel, strides, dilations):
+        eff_k = (k - 1) * d + 1
+        total = max((-(-size // s) - 1) * s + eff_k - size, 0)
+        out.append((total - total // 2, total // 2))  # (begin>=end)
+    (h0, h1), (w0, w1) = out
+    return h0, w0, h1, w1
+
+
+def _permute_flat_kernel(kernel: np.ndarray,
+                         nhwc_shape: Tuple[int, int, int]) -> np.ndarray:
+    """Reorder a Gemm/MatMul kernel's input rows from ONNX's (c,h,w) flat
+    order to the converted graph's (h,w,c) flat order."""
+    h, w, ch = nhwc_shape
+    perm = np.arange(ch * h * w).reshape(ch, h, w).transpose(1, 2, 0)
+    return kernel[perm.reshape(-1), :]
+
+
 class _GraphBuilder:
     def __init__(self, graph: Dict[str, Any], dtype=np.float32):
         self.graph = graph
@@ -152,11 +174,8 @@ class _GraphBuilder:
         beta = attrs["beta"] if attrs.get("beta") is not None else 1.0
         kernel = kernel * alpha
         if a.nhwc_shape is not None:
-            # data was flattened from converted-NHWC; permute kernel rows from
-            # ONNX's (c,h,w) flat order to our (h,w,c) flat order
-            h, w, ch = a.nhwc_shape
-            perm = np.arange(ch * h * w).reshape(ch, h, w).transpose(1, 2, 0)
-            kernel = kernel[perm.reshape(-1), :]
+            # data was flattened from converted-NHWC; reorder kernel rows
+            kernel = _permute_flat_kernel(kernel, a.nhwc_shape)
         layer = Dense(kernel.shape[1], bias=c is not None, name=name)
         p = {"kernel": kernel}
         if c is not None:
@@ -171,9 +190,7 @@ class _GraphBuilder:
             layer = Dense(b.const.shape[1], bias=False, name=name)
             kernel = b.const
             if a.nhwc_shape is not None:
-                h, w, ch = a.nhwc_shape
-                perm = np.arange(ch * h * w).reshape(ch, h, w).transpose(1, 2, 0)
-                kernel = kernel[perm.reshape(-1), :]
+                kernel = _permute_flat_kernel(kernel, a.nhwc_shape)
             self.add_params(name, {"kernel": kernel})
             self._set_out(node, layer(a.sym))
         elif a.sym is not None and b.sym is not None:
@@ -187,6 +204,10 @@ class _GraphBuilder:
     def _binary(self, node, name, mode, fn):
         from ..keras.layers import Lambda, merge
         a, b = self.val(node["input"][0]), self.val(node["input"][1])
+        if a.const is not None and b.const is not None:
+            # exporter left an un-folded constant expression: fold it here
+            self.set(node["output"][0], _Value(const=fn(a.const, b.const)))
+            return
         if a.sym is not None and b.sym is not None:
             if mode is not None:
                 self._set_out(node, merge([a.sym, b.sym], mode=mode, name=name),
@@ -208,7 +229,7 @@ class _GraphBuilder:
         self._set_out(node, out, layout=v.layout, nhwc_shape=v.nhwc_shape)
 
     def op_add(self, node, attrs, name):
-        self._binary(node, name, "sum", None)
+        self._binary(node, name, "sum", lambda x, y: x + y)
 
     def op_sum(self, node, attrs, name):
         from ..keras.layers import merge
@@ -221,7 +242,7 @@ class _GraphBuilder:
         self._binary(node, name, None, lambda x, y: x - y)
 
     def op_mul(self, node, attrs, name):
-        self._binary(node, name, "mul", None)
+        self._binary(node, name, "mul", lambda x, y: x * y)
 
     def op_div(self, node, attrs, name):
         self._binary(node, name, None, lambda x, y: x / y)
@@ -314,9 +335,13 @@ class _GraphBuilder:
         groups = int(attrs.get("group") or 1)
         h0, w0, h1, w1 = _pads_4(attrs)
         sym = v.sym
-        if attrs.get("auto_pad") in ("SAME_UPPER", "SAME_LOWER"):
-            border = "same"
+        auto_pad = attrs.get("auto_pad")
+        if auto_pad == "SAME_UPPER":
+            border = "same"  # XLA SAME == ONNX SAME_UPPER
         else:
+            if auto_pad == "SAME_LOWER":
+                h0, w0, h1, w1 = _same_lower_pads(
+                    v.sym.shape[1:3], (w.shape[2], w.shape[3]), strides, dil)
             border = "valid"
             if any((h0, w0, h1, w1)):
                 import jax.numpy as jnp
@@ -357,14 +382,19 @@ class _GraphBuilder:
         h0, w0, h1, w1 = _pads_4(attrs)
         sym = v.sym
         border = "valid"
-        if attrs.get("auto_pad") in ("SAME_UPPER", "SAME_LOWER"):
-            border = "same"
-        elif any((h0, w0, h1, w1)):
-            import jax.numpy as jnp
-            fill = -np.inf if cls.__name__.startswith("Max") else 0.0
-            sym = Lambda(lambda x: jnp.pad(
-                x, ((0, 0), (h0, h1), (w0, w1), (0, 0)),
-                constant_values=fill), name=f"{name}_pad")(sym)
+        auto_pad = attrs.get("auto_pad")
+        if auto_pad == "SAME_UPPER":
+            border = "same"  # XLA SAME == ONNX SAME_UPPER
+        else:
+            if auto_pad == "SAME_LOWER":
+                h0, w0, h1, w1 = _same_lower_pads(v.sym.shape[1:3], ks,
+                                                  strides)
+            if any((h0, w0, h1, w1)):
+                import jax.numpy as jnp
+                fill = -np.inf if cls.__name__.startswith("Max") else 0.0
+                sym = Lambda(lambda x: jnp.pad(
+                    x, ((0, 0), (h0, h1), (w0, w1), (0, 0)),
+                    constant_values=fill), name=f"{name}_pad")(sym)
         layer = cls(pool_size=ks, strides=strides, border_mode=border,
                     name=name)
         self._set_out(node, layer(sym), layout="nhwc")
@@ -428,7 +458,18 @@ class _GraphBuilder:
         if target is None:
             raise OnnxLoaderError("Reshape without target shape")
         tail = list(target[1:])
-        if tail == [-1] or (len(tail) == 1 and v.sym.shape is not None):
+        if len(tail) == 1:
+            # flatten-style reshape; reject widths that would mix rows across
+            # the batch axis (silently passing those through is worse than
+            # failing the import)
+            in_tail = v.sym.shape[1:]
+            flat = (int(np.prod(in_tail))
+                    if all(d is not None for d in in_tail) else None)
+            if tail[0] != -1 and flat is not None and tail[0] != flat:
+                raise OnnxLoaderError(
+                    f"Reshape {v.sym.shape} -> (N, {tail[0]}) changes the "
+                    f"per-record element count ({flat}); batch-mixing "
+                    f"reshapes are unsupported")
             if len(v.sym.shape) == 2:
                 self.set(node["output"][0], v)
                 return

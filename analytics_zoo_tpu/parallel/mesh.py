@@ -34,12 +34,23 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def shard_batch(mesh: Mesh, batch: Any) -> Any:
     """Device-put a host batch pytree with the batch axis sharded over
     ``data``. This is the host→device edge of the input pipeline (the
-    reference's FeatureSet-iterator → model-replica feed)."""
+    reference's FeatureSet-iterator → model-replica feed).
+
+    Single-process: plain sharded ``device_put``. Multi-process (pod): each
+    process holds only ITS rows (FeatureSet already per-host shards), so the
+    local batch is assembled into the global array via
+    ``make_array_from_process_local_data`` — the jit'd step then sees one
+    logical global batch, XLA handles cross-host collectives."""
+    multiprocess = jax.process_count() > 1
+
     def put(x):
         if x is None:  # unlabeled datasets yield (x, None)
             return None
         arr = np.asarray(x)
-        return jax.device_put(arr, data_sharding(mesh, arr.ndim))
+        sharding = data_sharding(mesh, arr.ndim)
+        if multiprocess:
+            return jax.make_array_from_process_local_data(sharding, arr)
+        return jax.device_put(arr, sharding)
     return jax.tree_util.tree_map(put, batch, is_leaf=lambda x: x is None)
 
 

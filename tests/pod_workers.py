@@ -1,0 +1,70 @@
+"""Worker targets for PodLauncher tests — run inside spawned processes
+(imported by ``analytics_zoo_tpu.cluster.bootstrap`` AFTER
+``jax.distributed.initialize``)."""
+import json
+import os
+
+import numpy as np
+
+
+def train_worker(workdir: str) -> int:
+    """Drive the full multi-process path: context discovery, per-host
+    FeatureSet sharding, global-batch division, a real fit, rank-0
+    checkpointing."""
+    import jax
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.estimator import Estimator
+    from analytics_zoo_tpu.feature import FeatureSet
+    from analytics_zoo_tpu.keras import Sequential, objectives, optimizers
+    from analytics_zoo_tpu.keras.layers import Activation, Dense
+
+    ctx = init_tpu_context()
+    assert ctx.process_count == 2, ctx.process_count
+    assert jax.device_count() == 4, jax.device_count()
+    assert jax.local_device_count() == 2
+
+    n = 32
+    # deterministic dataset, identical on every process; FeatureSet takes
+    # this process's interleaved rows
+    feats = np.arange(n, dtype=np.float32).reshape(n, 1).repeat(4, axis=1)
+    labels = (np.arange(n) % 2).astype(np.float32)
+    fs = FeatureSet.from_ndarrays(feats, labels, shuffle=False)
+    assert fs.size == n // 2, fs.size  # per-host shard
+
+    model = Sequential([Dense(8, name="d1"), Activation("relu"),
+                        Dense(2, name="d2")])
+    est = Estimator(model=model,
+                    loss_fn=objectives.get("sparse_categorical_crossentropy"),
+                    optimizer=optimizers.SGD(0.05))
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    est.set_checkpoint(ckpt_dir)
+    result = est.train(fs, batch_size=8, epochs=2)
+    assert result["iterations"] == 8, result["iterations"]  # 4/epoch x 2
+
+    # every process must see the SAME loss (one logical global batch)
+    from jax.experimental import multihost_utils
+    losses = multihost_utils.process_allgather(
+        np.float32(result["loss_history"][-1]))
+    assert np.allclose(losses, losses[0]), losses
+
+    with open(os.path.join(workdir, f"done_{ctx.process_index}.json"), "w") as f:
+        json.dump({
+            "process_index": ctx.process_index,
+            "shard_rows": [float(v) for v in np.asarray(fs.features)[:, 0]],
+            "final_loss": float(result["loss_history"][-1]),
+            "iterations": result["iterations"],
+        }, f)
+    return 0
+
+
+def failing_worker(_workdir: str) -> int:
+    """Rank 1 dies before the collective; rank 0 would hang in it forever —
+    the launcher's failure detection must kill the pod."""
+    import jax
+    if jax.process_index() == 1:
+        raise RuntimeError("injected worker failure")
+    import time
+    from jax.experimental import multihost_utils
+    multihost_utils.process_allgather(np.float32(1.0))  # blocks forever
+    time.sleep(600)
+    return 0
