@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -102,11 +103,12 @@ class PodLauncher:
                 env["ZOO_TPU_PROC_ID"] = str(pid)
                 log_path = os.path.join(log_dir, f"worker_{pid}.log")
                 logs.append(log_path)
-                logf = open(log_path, "w")
-                procs.append(subprocess.Popen(
-                    [sys.executable, "-m", "analytics_zoo_tpu.cluster.bootstrap"],
-                    env=env, stdout=logf, stderr=subprocess.STDOUT,
-                    cwd=os.getcwd()))
+                with open(log_path, "w") as logf:  # child keeps its dup'd fd
+                    procs.append(subprocess.Popen(
+                        [sys.executable, "-m",
+                         "analytics_zoo_tpu.cluster.bootstrap"],
+                        env=env, stdout=logf, stderr=subprocess.STDOUT,
+                        cwd=os.getcwd()))
             return self._wait(procs, logs, timeout)
         finally:
             for p in procs:
@@ -132,7 +134,14 @@ class PodLauncher:
                 for p in procs:
                     if p.poll() is None:
                         p.terminate()
-                time.sleep(0.5)
+                deadline = time.time() + 5  # reap so returncodes are real
+                for p in procs:
+                    if p.poll() is None:
+                        try:
+                            p.wait(timeout=max(0.1, deadline - time.time()))
+                        except subprocess.TimeoutExpired:
+                            p.kill()
+                            p.wait()
                 break
             if deadline and time.time() > deadline:
                 for p in procs:
@@ -143,14 +152,21 @@ class PodLauncher:
                     f"pod timed out after {timeout}s", results)
             time.sleep(0.2)
         results = self._results(procs, logs)
-        failed = [r for r in results if r.returncode != 0]
-        if failed:
+        # -SIGTERM exits are workers WE killed in fail-fast — report them as
+        # terminated, not as the failure's cause
+        failed = [r for r in results
+                  if r.returncode not in (0, -signal.SIGTERM, -signal.SIGKILL)]
+        killed = [r for r in results
+                  if r.returncode in (-signal.SIGTERM, -signal.SIGKILL)]
+        if failed or killed:
             tails = "\n".join(
                 f"--- worker {r.process_id} (rc={r.returncode}) ---\n"
                 f"{r.log_tail()}" for r in failed)
+            note = (f" ({len(killed)} healthy workers terminated by "
+                    f"fail-fast)" if killed else "")
             raise PodLaunchError(
-                f"{len(failed)}/{self.num_processes} workers failed\n{tails}",
-                results)
+                f"{len(failed)}/{self.num_processes} workers failed{note}\n"
+                f"{tails}", results)
         return results
 
     def _results(self, procs, logs) -> List[WorkerResult]:

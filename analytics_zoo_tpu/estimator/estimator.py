@@ -295,7 +295,8 @@ class Estimator:
 
         while not end_trigger(state):
             skip = 0
-            if getattr(self, "_restore_data", None) is not None:
+            resumable = hasattr(train_set, "data_state")
+            if getattr(self, "_restore_data", None) is not None and resumable:
                 rng_json, skip, saved_batch = self._restore_data
                 self._restore_data = None
                 train_set.set_data_state(rng_json)
@@ -306,7 +307,8 @@ class Estimator:
                         f"replay the wrong records; resume with the original "
                         f"batch size (or from an epoch-boundary snapshot)")
                 skip = min(skip, batches_per_epoch)
-            self._epoch_data_state = train_set.data_state()
+            self._epoch_data_state = (train_set.data_state() if resumable
+                                      else None)
             feed = DeviceFeed(
                 train_set.train_iterator(local_batch, skip_batches=skip),
                 self.mesh)
@@ -503,7 +505,7 @@ class Estimator:
             "meta": {"global_step": self.global_step, "epoch": self.epoch},
         }
         ts = getattr(self, "_active_train_set", None)
-        if ts is not None:
+        if ts is not None and hasattr(ts, "data_state"):
             # data-pipeline state: an epoch-end snapshot records the post-epoch
             # RNG (next epoch starts fresh); a mid-epoch one records the
             # epoch-START rng + batches consumed so resume replays the same

@@ -27,6 +27,40 @@ class Preprocessing:
         return (self.apply(r) for r in records)
 
 
+class BatchPreprocessing(Preprocessing):
+    """A transform that operates on the WHOLE stacked array tree at once
+    (``batched=True``): ``FeatureSet.transform`` calls ``apply_batch`` in a
+    single vectorized numpy call instead of a per-record Python loop. The
+    per-record ``apply`` still works (records get a temporary batch axis),
+    so batched and record transforms chain freely."""
+
+    batched = True
+
+    def apply_batch(self, batch: Any) -> Any:
+        raise NotImplementedError
+
+    def apply(self, record: Any) -> Any:
+        add = lambda a: np.asarray(a)[None]
+        drop = lambda a: np.asarray(a)[0]
+        batched = (tuple(add(r) for r in record) if isinstance(record, tuple)
+                   else {k: add(v) for k, v in record.items()}
+                   if isinstance(record, dict) else add(record))
+        out = self.apply_batch(batched)
+        return (tuple(drop(o) for o in out) if isinstance(out, tuple)
+                else {k: drop(v) for k, v in out.items()}
+                if isinstance(out, dict) else drop(out))
+
+
+class BatchLambda(BatchPreprocessing):
+    """Vectorized transform from a plain function over the stacked tree."""
+
+    def __init__(self, fn: Callable[[Any], Any]):
+        self.fn = fn
+
+    def apply_batch(self, batch: Any) -> Any:
+        return self.fn(batch)
+
+
 class ChainedPreprocessing(Preprocessing):
     def __init__(self, *stages: Preprocessing):
         flat = []
@@ -36,11 +70,18 @@ class ChainedPreprocessing(Preprocessing):
             else:
                 flat.append(s)
         self.stages = tuple(flat)
+        # a chain of all-batched stages is itself batched (stays vectorized)
+        self.batched = all(getattr(s, "batched", False) for s in flat)
 
     def apply(self, record: Any) -> Any:
         for s in self.stages:
             record = s.apply(record)
         return record
+
+    def apply_batch(self, batch: Any) -> Any:
+        for s in self.stages:
+            batch = s.apply_batch(batch)
+        return batch
 
 
 class Lambda(Preprocessing):

@@ -1,0 +1,280 @@
+"""TFRecord ingest (reference ``TFDataset.from_tfrecord_file``,
+``pyzoo/zoo/tfpark/tf_dataset.py:458`` + the JVM TFRecord input formats).
+
+Reading is two-tier:
+- a native C++ indexer (``native/tfrecord_reader.cpp``) mmaps the file,
+  CRC32C-validates framing, and serves zero-copy batched reads over ctypes;
+- a pure-Python fallback (shares the masked-CRC implementation with the
+  TensorBoard writer) when no compiler is available.
+
+``tf.train.Example`` decoding uses the shared schema-driven protobuf wire
+decoder — no tensorflow dependency anywhere.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..utils.protowire import Field, parse
+from ..utils.tensorboard import frame_record, masked_crc32c
+
+# -- tf.train.Example schema (tensorflow/core/example/{example,feature}.proto)
+
+_BYTES_LIST = {1: Field("value", "bytes", repeated=True)}
+_FLOAT_LIST = {1: Field("value", "float32", repeated=True)}
+_INT64_LIST = {1: Field("value", "int", repeated=True)}
+_FEATURE = {
+    1: Field("bytes_list", "message", schema=_BYTES_LIST),
+    2: Field("float_list", "message", schema=_FLOAT_LIST),
+    3: Field("int64_list", "message", schema=_INT64_LIST),
+}
+_FEATURE_ENTRY = {  # map<string, Feature> entry
+    1: Field("key", "string"),
+    2: Field("value", "message", schema=_FEATURE),
+}
+_FEATURES = {1: Field("feature", "message", repeated=True,
+                      schema=_FEATURE_ENTRY)}
+_EXAMPLE = {1: Field("features", "message", schema=_FEATURES)}
+
+
+def parse_example(raw: bytes) -> Dict[str, Any]:
+    """Serialized ``tf.train.Example`` → ``{name: ndarray | [bytes]}``."""
+    ex = parse(raw, _EXAMPLE)
+    out: Dict[str, Any] = {}
+    for entry in (ex.get("features") or {}).get("feature", []):
+        key = entry.get("key", "")
+        feat = entry.get("value") or {}
+        if feat.get("bytes_list") is not None:
+            out[key] = list(feat["bytes_list"].get("value", []))
+        elif feat.get("float_list") is not None:
+            out[key] = np.asarray(feat["float_list"].get("value", []),
+                                  dtype=np.float32)
+        elif feat.get("int64_list") is not None:
+            out[key] = np.asarray(feat["int64_list"].get("value", []),
+                                  dtype=np.int64)
+        else:
+            out[key] = None
+    return out
+
+
+# -- Example encoding (for writers/tests; protobuf wire encode is tiny) -----
+
+
+from ..utils.protowire import (  # noqa: E402
+    encode_len_field as _len_field, encode_varint as _varint)
+
+
+def encode_example(features: Dict[str, Any]) -> bytes:
+    """``{name: bytes|[bytes]|float array|int array}`` → serialized Example."""
+    entries = b""
+    for key, value in features.items():
+        if isinstance(value, (bytes, bytearray)):
+            value = [bytes(value)]
+        if isinstance(value, (list, tuple)) and value \
+                and isinstance(value[0], (bytes, bytearray)):
+            payload = b"".join(_len_field(1, bytes(v)) for v in value)
+            feat = _len_field(1, payload)
+        else:
+            arr = np.asarray(value)
+            if np.issubdtype(arr.dtype, np.floating):
+                payload = _len_field(1, arr.astype("<f4").tobytes())
+                feat = _len_field(2, payload)
+            elif np.issubdtype(arr.dtype, np.integer):
+                body = b"".join(_varint(int(v)) for v in arr.reshape(-1))
+                payload = _len_field(1, body)
+                feat = _len_field(3, payload)
+            else:
+                raise TypeError(f"unsupported feature dtype for '{key}': "
+                                f"{arr.dtype}")
+        entries += _len_field(1, _len_field(1, key.encode()) + _len_field(2, feat))
+    return _len_field(1, entries)
+
+
+class TFRecordWriter:
+    """Write framed records (CRC32C), same framing as the event writer."""
+
+    def __init__(self, path: str):
+        self._f = open(path, "wb")
+
+    def write(self, record: bytes) -> None:
+        self._f.write(frame_record(record))
+
+    def write_example(self, features: Dict[str, Any]) -> None:
+        self.write(encode_example(features))
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# -- readers ----------------------------------------------------------------
+
+
+class _NativeReader:
+    """ctypes wrapper over native/tfrecord_reader.cpp."""
+
+    _lib = None
+    _lib_tried = False
+
+    @classmethod
+    def lib(cls):
+        if not cls._lib_tried:
+            cls._lib_tried = True
+            from ..native import load_library
+            lib = load_library("tfrecord_reader")
+            if lib is not None:
+                lib.ztr_open.restype = ctypes.c_void_p
+                lib.ztr_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+                lib.ztr_count.restype = ctypes.c_long
+                lib.ztr_count.argtypes = [ctypes.c_void_p]
+                lib.ztr_error.restype = ctypes.c_int
+                lib.ztr_error.argtypes = [ctypes.c_void_p]
+                lib.ztr_record_len.restype = ctypes.c_long
+                lib.ztr_record_len.argtypes = [ctypes.c_void_p, ctypes.c_long]
+                lib.ztr_read.restype = ctypes.c_int
+                lib.ztr_read.argtypes = [ctypes.c_void_p, ctypes.c_long,
+                                         ctypes.c_char_p]
+                lib.ztr_read_batch.restype = ctypes.c_int
+                lib.ztr_read_batch.argtypes = [
+                    ctypes.c_void_p, ctypes.c_long, ctypes.c_long,
+                    ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
+                lib.ztr_total_bytes.restype = ctypes.c_int64
+                lib.ztr_total_bytes.argtypes = [ctypes.c_void_p, ctypes.c_long,
+                                                ctypes.c_long]
+                lib.ztr_close.argtypes = [ctypes.c_void_p]
+                cls._lib = lib
+        return cls._lib
+
+    def __init__(self, path: str, verify_crc: bool = True):
+        lib = self.lib()
+        assert lib is not None
+        self._handle = lib.ztr_open(path.encode(), 2 if verify_crc else 1)
+        if not self._handle:
+            raise OSError(f"cannot open TFRecord file {path}")
+        err = lib.ztr_error(self._handle)
+        if err:
+            n = lib.ztr_count(self._handle)
+            kind = "truncated" if err == 1 else "CRC mismatch"
+            lib.ztr_close(self._handle)
+            self._handle = None
+            raise IOError(f"corrupt TFRecord file {path}: {kind} after "
+                          f"{n} records")
+
+    def __len__(self):
+        return self.lib().ztr_count(self._handle)
+
+    def read(self, i: int) -> bytes:
+        lib = self.lib()
+        n = lib.ztr_record_len(self._handle, i)
+        if n < 0:
+            raise IndexError(i)
+        buf = ctypes.create_string_buffer(n)
+        lib.ztr_read(self._handle, i, buf)
+        return buf.raw[:n]
+
+    def read_batch(self, start: int, n: int) -> List[bytes]:
+        lib = self.lib()
+        total = lib.ztr_total_bytes(self._handle, start, n)
+        if total < 0:
+            raise IndexError((start, n))
+        buf = ctypes.create_string_buffer(int(total))
+        lens = (ctypes.c_int64 * n)()
+        lib.ztr_read_batch(self._handle, start, n, buf, lens)
+        out, pos = [], 0
+        raw = buf.raw
+        for i in range(n):
+            out.append(raw[pos:pos + lens[i]])
+            pos += lens[i]
+        return out
+
+    def close(self):
+        if self._handle:
+            self.lib().ztr_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class _PythonReader:
+    """Pure-Python fallback: reads the whole framing eagerly."""
+
+    def __init__(self, path: str, verify_crc: bool = True):
+        self._records: List[bytes] = []
+        with open(path, "rb") as f:
+            data = f.read()
+        pos, size = 0, len(data)
+        while pos + 12 <= size:
+            (length,) = struct.unpack_from("<Q", data, pos)
+            (hcrc,) = struct.unpack_from("<I", data, pos + 8)
+            if verify_crc and hcrc != masked_crc32c(data[pos:pos + 8]):
+                raise IOError(f"corrupt TFRecord file {path}: header CRC "
+                              f"mismatch after {len(self._records)} records")
+            if pos + 12 + length + 4 > size:
+                raise IOError(f"corrupt TFRecord file {path}: truncated "
+                              f"after {len(self._records)} records")
+            payload = data[pos + 12:pos + 12 + length]
+            (dcrc,) = struct.unpack_from("<I", data, pos + 12 + length)
+            if verify_crc and dcrc != masked_crc32c(payload):
+                raise IOError(f"corrupt TFRecord file {path}: payload CRC "
+                              f"mismatch after {len(self._records)} records")
+            self._records.append(payload)
+            pos += 12 + length + 4
+        if pos != size:
+            raise IOError(f"corrupt TFRecord file {path}: trailing garbage")
+
+    def __len__(self):
+        return len(self._records)
+
+    def read(self, i: int) -> bytes:
+        return self._records[i]
+
+    def read_batch(self, start: int, n: int) -> List[bytes]:
+        return self._records[start:start + n]
+
+    def close(self):
+        self._records = []
+
+
+def open_tfrecord(path: str, verify_crc: bool = True):
+    """Open a TFRecord file with the native reader, falling back to Python."""
+    if _NativeReader.lib() is not None:
+        return _NativeReader(path, verify_crc)
+    return _PythonReader(path, verify_crc)
+
+
+def iter_tfrecords(paths: Union[str, Sequence[str]],
+                   verify_crc: bool = True) -> Iterator[bytes]:
+    """Iterate raw records across one or more files."""
+    if isinstance(paths, str):
+        paths = [paths]
+    for path in paths:
+        reader = open_tfrecord(path, verify_crc)
+        try:
+            n = len(reader)
+            start = 0
+            while start < n:
+                cnt = min(1024, n - start)
+                for rec in reader.read_batch(start, cnt):
+                    yield rec
+                start += cnt
+        finally:
+            reader.close()
+
+
+def read_examples(paths: Union[str, Sequence[str]],
+                  verify_crc: bool = True) -> Iterator[Dict[str, Any]]:
+    for raw in iter_tfrecords(paths, verify_crc):
+        yield parse_example(raw)

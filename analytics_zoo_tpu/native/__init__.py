@@ -1,0 +1,4 @@
+"""Native (C++) runtime components, built on demand with the system
+toolchain and loaded over ctypes. Python fallbacks exist for every native
+path — the framework works without a compiler, just slower."""
+from .build import load_library  # noqa: F401

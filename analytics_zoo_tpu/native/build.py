@@ -1,0 +1,65 @@
+"""On-demand compilation of the native components.
+
+No build step at install time: the first import compiles the .so next to the
+source with the system ``g++`` (cached by mtime), the way JAX itself JITs its
+kernels. Failure to build is non-fatal — callers fall back to pure Python.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_lock = threading.Lock()
+_cache = {}
+
+
+def _build(src: str, out: str) -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", out, src]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.warning("native build unavailable (%s); using Python fallback", e)
+        return False
+    if proc.returncode != 0:
+        logger.warning("native build failed; using Python fallback:\n%s",
+                       proc.stderr[-2000:])
+        return False
+    return True
+
+
+def load_library(name: str) -> Optional[ctypes.CDLL]:
+    """Load (building if stale/missing) ``native/<name>.cpp`` as a CDLL.
+    Returns None when no compiler is available — callers must fall back."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        src = os.path.join(_DIR, f"{name}.cpp")
+        out = os.path.join(_DIR, f"lib{name}.so")
+        if not os.path.exists(src):
+            raise FileNotFoundError(src)
+        ok = True
+        if (not os.path.exists(out)
+                or os.path.getmtime(out) < os.path.getmtime(src)):
+            # build into the package dir when writable, else a temp dir
+            target = out
+            if not os.access(_DIR, os.W_OK):
+                target = os.path.join(tempfile.gettempdir(),
+                                      f"zoo_native_lib{name}.so")
+            ok = _build(src, target)
+            out = target
+        lib = None
+        if ok:
+            try:
+                lib = ctypes.CDLL(out)
+            except OSError as e:
+                logger.warning("could not load %s (%s); Python fallback", out, e)
+        _cache[name] = lib
+        return lib

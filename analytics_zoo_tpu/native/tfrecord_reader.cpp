@@ -1,0 +1,171 @@
+// Native TFRecord reader: mmap + CRC32C + record index.
+//
+// The reference's data plane leans on JVM-native readers (Hadoop input
+// formats / TFRecordInputFormat) so the hot ingest path never touches
+// per-record interpreted code; this plays the same role for the TPU host
+// pipeline. Python asks for an index once (offsets/lengths validated by
+// CRC32C), then slices records straight out of the mapped file with zero
+// copies in the common case.
+//
+// Format (tensorflow/core/lib/io/record_writer.h):
+//   uint64 length | uint32 masked_crc32c(length) | data | uint32 masked_crc32c(data)
+//
+// Build: g++ -O3 -shared -fPIC -o libzoo_tfrecord.so tfrecord_reader.cpp
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+// ---- CRC32C (Castagnoli), slice-by-8 table driven ----
+uint32_t kTable[8][256];
+bool kTableInit = false;
+
+void init_table() {
+  if (kTableInit) return;
+  const uint32_t poly = 0x82F63B78u;
+  for (uint32_t n = 0; n < 256; n++) {
+    uint32_t crc = n;
+    for (int k = 0; k < 8; k++) crc = (crc & 1) ? (crc >> 1) ^ poly : crc >> 1;
+    kTable[0][n] = crc;
+  }
+  for (uint32_t n = 0; n < 256; n++) {
+    uint32_t crc = kTable[0][n];
+    for (int s = 1; s < 8; s++) {
+      crc = kTable[0][crc & 0xFF] ^ (crc >> 8);
+      kTable[s][n] = crc;
+    }
+  }
+  kTableInit = true;
+}
+
+uint32_t crc32c(const uint8_t* data, size_t n, uint32_t crc = 0) {
+  crc ^= 0xFFFFFFFFu;
+  // 8 bytes at a time through the sliced tables
+  while (n >= 8) {
+    uint64_t word;
+    std::memcpy(&word, data, 8);
+    word ^= crc;
+    crc = kTable[7][word & 0xFF] ^ kTable[6][(word >> 8) & 0xFF] ^
+          kTable[5][(word >> 16) & 0xFF] ^ kTable[4][(word >> 24) & 0xFF] ^
+          kTable[3][(word >> 32) & 0xFF] ^ kTable[2][(word >> 40) & 0xFF] ^
+          kTable[1][(word >> 48) & 0xFF] ^ kTable[0][(word >> 56) & 0xFF];
+    data += 8;
+    n -= 8;
+  }
+  while (n--) crc = kTable[0][(crc ^ *data++) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+uint32_t masked_crc(const uint8_t* data, size_t n) {
+  uint32_t crc = crc32c(data, n);
+  return ((crc >> 15) | (crc << 17)) + 0xA282EAD8u;
+}
+
+struct Reader {
+  uint8_t* base = nullptr;
+  size_t size = 0;
+  std::vector<uint64_t> offsets;  // of record payload
+  std::vector<uint64_t> lengths;
+  int error = 0;  // 0 ok, 1 truncated, 2 crc mismatch
+};
+
+}  // namespace
+
+extern "C" {
+
+// Open + index a TFRecord file. verify: 0 none, 1 header crc, 2 +payload crc.
+void* ztr_open(const char* path, int verify) {
+  init_table();
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { ::close(fd); return nullptr; }
+  auto* r = new Reader();
+  r->size = static_cast<size_t>(st.st_size);
+  if (r->size > 0) {
+    r->base = static_cast<uint8_t*>(
+        mmap(nullptr, r->size, PROT_READ, MAP_PRIVATE, fd, 0));
+    if (r->base == MAP_FAILED) { ::close(fd); delete r; return nullptr; }
+    madvise(r->base, r->size, MADV_SEQUENTIAL);
+  }
+  ::close(fd);
+
+  size_t pos = 0;
+  while (pos + 12 <= r->size) {
+    uint64_t len;
+    std::memcpy(&len, r->base + pos, 8);
+    if (verify >= 1) {
+      uint32_t hcrc;
+      std::memcpy(&hcrc, r->base + pos + 8, 4);
+      if (hcrc != masked_crc(r->base + pos, 8)) { r->error = 2; break; }
+    }
+    // overflow-safe bounds check: a crafted length near 2^64 must not wrap
+    // `pos + 12 + len + 4` past the mmap (CRC32C is not a MAC)
+    uint64_t avail = r->size - pos - 12;
+    if (len > avail || avail - len < 4) { r->error = 1; break; }
+    if (verify >= 2) {
+      uint32_t dcrc;
+      std::memcpy(&dcrc, r->base + pos + 12 + len, 4);
+      if (dcrc != masked_crc(r->base + pos + 12, len)) { r->error = 2; break; }
+    }
+    r->offsets.push_back(pos + 12);
+    r->lengths.push_back(len);
+    pos += 12 + len + 4;
+  }
+  return r;
+}
+
+long ztr_count(void* h) { return static_cast<Reader*>(h)->offsets.size(); }
+int ztr_error(void* h) { return static_cast<Reader*>(h)->error; }
+
+long ztr_record_len(void* h, long i) {
+  auto* r = static_cast<Reader*>(h);
+  if (i < 0 || static_cast<size_t>(i) >= r->lengths.size()) return -1;
+  return static_cast<long>(r->lengths[i]);
+}
+
+// Copy record i into buf (caller sized it via ztr_record_len).
+int ztr_read(void* h, long i, uint8_t* buf) {
+  auto* r = static_cast<Reader*>(h);
+  if (i < 0 || static_cast<size_t>(i) >= r->offsets.size()) return -1;
+  std::memcpy(buf, r->base + r->offsets[i], r->lengths[i]);
+  return 0;
+}
+
+// Bulk: copy records [start, start+n) back-to-back into buf and write each
+// length into lens. Python then splits with numpy — one ctypes call per batch.
+int ztr_read_batch(void* h, long start, long n, uint8_t* buf, int64_t* lens) {
+  auto* r = static_cast<Reader*>(h);
+  if (start < 0 || start + n > static_cast<long>(r->offsets.size())) return -1;
+  uint8_t* out = buf;
+  for (long i = 0; i < n; i++) {
+    uint64_t len = r->lengths[start + i];
+    std::memcpy(out, r->base + r->offsets[start + i], len);
+    lens[i] = static_cast<int64_t>(len);
+    out += len;
+  }
+  return 0;
+}
+
+int64_t ztr_total_bytes(void* h, long start, long n) {
+  auto* r = static_cast<Reader*>(h);
+  if (start < 0 || start + n > static_cast<long>(r->offsets.size())) return -1;
+  int64_t total = 0;
+  for (long i = 0; i < n; i++) total += r->lengths[start + i];
+  return total;
+}
+
+void ztr_close(void* h) {
+  auto* r = static_cast<Reader*>(h);
+  if (r->base && r->size) munmap(r->base, r->size);
+  delete r;
+}
+
+}  // extern "C"

@@ -489,8 +489,9 @@ class _GraphBuilder:
         from ..keras.layers import merge
         vals = [self.val(i) for i in node["input"]]
         axis = int(attrs.get("axis") or 0)
-        if vals[0].layout == "nhwc" and axis == 1:
-            axis = 3  # channel concat in the converted layout
+        if vals[0].layout == "nhwc":
+            # NCHW axes → NHWC: C(1)→3, H(2)→1, W(3)→2
+            axis = {1: 3, 2: 1, 3: 2}.get(axis, axis)
         self._set_out(node, merge([v.sym for v in vals], mode="concat",
                                   concat_axis=axis, name=name),
                       layout=vals[0].layout)

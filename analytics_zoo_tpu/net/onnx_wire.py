@@ -1,145 +1,14 @@
-"""Minimal protobuf wire-format decoder for ONNX ModelProto.
-
-The reference imports ONNX graphs through the ``onnx`` python package
-(``pyzoo/zoo/pipeline/api/onnx/onnx_loader.py:1``); that package is not a
-dependency here, and the wire format is simple enough that a schema-driven
-decoder for the handful of ONNX messages we need (ModelProto, GraphProto,
-NodeProto, TensorProto, AttributeProto, ValueInfoProto) is ~200 lines and
-imports nothing but numpy. Field numbers follow the public ``onnx.proto3``
-schema.
+"""ONNX ModelProto schemas over the shared wire decoder
+(:mod:`analytics_zoo_tpu.utils.protowire`). Field numbers follow the
+public ``onnx.proto3`` schema.
 """
 from __future__ import annotations
 
-import struct
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-# wire types
-_VARINT, _I64, _LEN, _I32 = 0, 1, 2, 5
-
-
-def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
-    result = 0
-    shift = 0
-    while True:
-        b = buf[pos]
-        pos += 1
-        result |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return result, pos
-        shift += 7
-        if shift > 70:
-            raise ValueError("varint too long (corrupt protobuf)")
-
-
-def _skip(buf: bytes, pos: int, wire_type: int) -> int:
-    if wire_type == _VARINT:
-        _, pos = _read_varint(buf, pos)
-        return pos
-    if wire_type == _I64:
-        return pos + 8
-    if wire_type == _LEN:
-        n, pos = _read_varint(buf, pos)
-        return pos + n
-    if wire_type == _I32:
-        return pos + 4
-    raise ValueError(f"unsupported wire type {wire_type}")
-
-
-def _zigzag(v: int) -> int:
-    # onnx uses plain int64 (not sint64); negative ints arrive as 2^64-|v|
-    return v - (1 << 64) if v >= (1 << 63) else v
-
-
-class Field:
-    """One schema entry: how to decode a field number."""
-
-    def __init__(self, name: str, kind: str, repeated: bool = False,
-                 schema: Optional[Dict[int, "Field"]] = None):
-        self.name = name
-        self.kind = kind  # int | float32 | string | bytes | message | packed_int | packed_float
-        self.repeated = repeated
-        self.schema = schema
-
-
-def parse(buf: bytes, schema: Dict[int, Field]) -> Dict[str, Any]:
-    """Decode one message with the given schema; unknown fields are skipped."""
-    out: Dict[str, Any] = {}
-    for fno, f in schema.items():
-        if f.repeated:
-            out[f.name] = []
-    pos, end = 0, len(buf)
-    while pos < end:
-        key, pos = _read_varint(buf, pos)
-        fno, wt = key >> 3, key & 7
-        f = schema.get(fno)
-        if f is None:
-            pos = _skip(buf, pos, wt)
-            continue
-        val: Any
-        if f.kind == "int":
-            if wt == _VARINT:
-                v, pos = _read_varint(buf, pos)
-                val = _zigzag(v)
-            elif wt == _LEN:  # packed repeated ints
-                n, pos = _read_varint(buf, pos)
-                sub_end = pos + n
-                vals = []
-                while pos < sub_end:
-                    v, pos = _read_varint(buf, pos)
-                    vals.append(_zigzag(v))
-                out[f.name].extend(vals)
-                continue
-            else:
-                pos = _skip(buf, pos, wt)
-                continue
-        elif f.kind == "float32":
-            if wt == _I32:
-                val = struct.unpack_from("<f", buf, pos)[0]
-                pos += 4
-            elif wt == _LEN:  # packed floats
-                n, pos = _read_varint(buf, pos)
-                out[f.name].extend(
-                    np.frombuffer(buf, dtype="<f4", count=n // 4, offset=pos))
-                pos += n
-                continue
-            else:
-                pos = _skip(buf, pos, wt)
-                continue
-        elif f.kind == "float64":
-            if wt == _I64:
-                val = struct.unpack_from("<d", buf, pos)[0]
-                pos += 8
-            elif wt == _LEN:
-                n, pos = _read_varint(buf, pos)
-                out[f.name].extend(
-                    np.frombuffer(buf, dtype="<f8", count=n // 8, offset=pos))
-                pos += n
-                continue
-            else:
-                pos = _skip(buf, pos, wt)
-                continue
-        elif f.kind in ("string", "bytes", "message"):
-            if wt != _LEN:
-                pos = _skip(buf, pos, wt)
-                continue
-            n, pos = _read_varint(buf, pos)
-            raw = buf[pos:pos + n]
-            pos += n
-            if f.kind == "string":
-                val = raw.decode("utf-8", errors="replace")
-            elif f.kind == "bytes":
-                val = raw
-            else:
-                val = parse(raw, f.schema)
-        else:
-            raise ValueError(f"unknown schema kind {f.kind}")
-        if f.repeated:
-            out[f.name].append(val)
-        else:
-            out[f.name] = val
-    return out
+from ..utils.protowire import Field, parse  # noqa: F401 (re-export)
 
 
 # --------------------------------------------------------------------------

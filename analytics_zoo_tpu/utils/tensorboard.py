@@ -62,21 +62,7 @@ def masked_crc32c(data: bytes) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _varint(n: int) -> bytes:
-    out = bytearray()
-    n &= 0xFFFFFFFFFFFFFFFF
-    while True:
-        b = n & 0x7F
-        n >>= 7
-        if n:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return bytes(out)
-
-
-def _tag(field: int, wire_type: int) -> bytes:
-    return _varint((field << 3) | wire_type)
+from .protowire import encode_tag as _tag, encode_varint as _varint  # noqa: E402
 
 
 def _f64(field: int, value: float) -> bytes:

@@ -1,0 +1,197 @@
+"""Input-pipeline additions: TFRecord ingest (native C++ reader + Python
+fallback), tf.train.Example codec, streaming generator datasets, vectorized
+and thread-pooled transforms, string/bytes ingest."""
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature import FeatureSet
+from analytics_zoo_tpu.feature.preprocessing import (
+    BatchLambda, Lambda, stack_records)
+from analytics_zoo_tpu.feature.tfrecord import (
+    TFRecordWriter, _NativeReader, _PythonReader, encode_example,
+    iter_tfrecords, open_tfrecord, parse_example, read_examples)
+
+
+def _write_examples(path, n=10):
+    with TFRecordWriter(path) as w:
+        for i in range(n):
+            w.write_example({
+                "x": np.arange(4, dtype=np.float32) + i,
+                "y": np.asarray([i % 3], dtype=np.int64),
+                "name": f"rec{i}".encode(),
+            })
+
+
+class TestExampleCodec:
+    def test_roundtrip(self):
+        raw = encode_example({
+            "f": np.asarray([1.5, -2.0], dtype=np.float32),
+            "i": np.asarray([7, -9, 0], dtype=np.int64),
+            "b": [b"ab", b"cde"],
+        })
+        ex = parse_example(raw)
+        np.testing.assert_array_equal(ex["f"], [1.5, -2.0])
+        np.testing.assert_array_equal(ex["i"], [7, -9, 0])
+        assert ex["b"] == [b"ab", b"cde"]
+
+
+class TestTFRecordReaders:
+    def test_native_and_python_agree(self, tmp_path):
+        path = str(tmp_path / "data.tfrecord")
+        _write_examples(path, 12)
+        py = _PythonReader(path)
+        assert len(py) == 12
+        if _NativeReader.lib() is not None:
+            nat = _NativeReader(path)
+            assert len(nat) == 12
+            for i in range(12):
+                assert nat.read(i) == py.read(i)
+            assert nat.read_batch(3, 5) == [py.read(i) for i in range(3, 8)]
+            nat.close()
+        else:
+            pytest.skip("native reader unavailable (no compiler)")
+
+    def test_native_reader_builds(self):
+        # the native component is part of the framework contract on this
+        # image (g++ is baked in) — fail loudly if the build breaks
+        assert _NativeReader.lib() is not None
+
+    def test_corrupt_payload_detected(self, tmp_path):
+        path = str(tmp_path / "bad.tfrecord")
+        _write_examples(path, 5)
+        data = bytearray(open(path, "rb").read())
+        data[len(data) // 2] ^= 0xFF  # flip a payload byte
+        open(path, "wb").write(bytes(data))
+        with pytest.raises(IOError):
+            reader = open_tfrecord(path, verify_crc=True)
+            # native reader reports at open; python raises during scan
+            reader.close()
+
+    def test_iter_multiple_files(self, tmp_path):
+        p1, p2 = str(tmp_path / "a.tfrecord"), str(tmp_path / "b.tfrecord")
+        _write_examples(p1, 3)
+        _write_examples(p2, 4)
+        assert len(list(iter_tfrecords([p1, p2]))) == 7
+
+    def test_read_examples(self, tmp_path):
+        path = str(tmp_path / "ex.tfrecord")
+        _write_examples(path, 6)
+        exs = list(read_examples(path))
+        assert len(exs) == 6
+        np.testing.assert_array_equal(exs[2]["x"], [2, 3, 4, 5])
+        assert exs[2]["name"] == [b"rec2"]
+
+
+class TestFromTFRecord:
+    def test_featureset_from_tfrecord(self, tmp_path):
+        path = str(tmp_path / "t.tfrecord")
+        _write_examples(path, 16)
+        fs = FeatureSet.from_tfrecord(
+            path, parser=lambda ex: (ex["x"], ex["y"][0].astype(np.float32)),
+            shuffle=False)
+        assert fs.size == 16
+        x, y = next(fs.train_iterator(8))
+        assert x.shape == (8, 4) and y.shape == (8,)
+        np.testing.assert_array_equal(x[3], [3, 4, 5, 6])
+
+    def test_streaming_from_tfrecord(self, tmp_path):
+        path = str(tmp_path / "s.tfrecord")
+        _write_examples(path, 16)
+        fs = FeatureSet.from_tfrecord(
+            path, parser=lambda ex: (ex["x"], ex["y"][0].astype(np.float32)),
+            streaming=True)
+        assert fs.size == 16
+        it = fs.train_iterator(4)
+        seen = [next(it) for _ in range(8)]  # two epochs worth
+        assert all(x.shape == (4, 4) for x, _ in seen)
+        # epoch 2 replays the same (unshuffled) stream
+        np.testing.assert_array_equal(seen[0][0], seen[4][0])
+
+
+class TestStreaming:
+    def _gen(self):
+        for i in range(20):
+            yield (np.full(3, i, dtype=np.float32),
+                   np.float32(i % 2))
+
+    def test_streaming_train(self):
+        fs = FeatureSet.from_generator(self._gen, 20, streaming=True)
+        from analytics_zoo_tpu.estimator import Estimator
+        from analytics_zoo_tpu.keras import Sequential, objectives, optimizers
+        from analytics_zoo_tpu.keras.layers import Dense
+        est = Estimator(
+            model=Sequential([Dense(4, name="a"), Dense(2, name="b")]),
+            loss_fn=objectives.get("sparse_categorical_crossentropy"),
+            optimizer=optimizers.SGD(0.01))
+        out = est.train(fs, batch_size=8, epochs=2)
+        assert out["iterations"] == 4  # 2 full batches x 2 epochs
+
+    def test_streaming_eval_iterator_tail(self):
+        fs = FeatureSet.from_generator(self._gen, 20, streaming=True)
+        batches = list(fs.eval_iterator(8))
+        assert [b[2] for b in batches] == [8, 8, 4]
+
+    def test_generator_error_surfaces(self):
+        def bad():
+            yield (np.zeros(3, np.float32), np.float32(0))
+            raise RuntimeError("loader exploded")
+
+        fs = FeatureSet.from_generator(bad, 10, streaming=True)
+        it = fs.train_iterator(1)
+        next(it)
+        with pytest.raises(RuntimeError, match="loader exploded"):
+            for _ in range(5):
+                next(it)
+
+
+class TestTransformTiers:
+    def test_batch_transform_vectorized(self):
+        x = np.arange(12, dtype=np.float32).reshape(6, 2)
+        fs = FeatureSet.from_ndarrays(x, np.zeros(6, np.float32),
+                                      shuffle=False)
+        out = fs.transform(BatchLambda(lambda b: b * 2 + 1))
+        np.testing.assert_array_equal(np.asarray(out.features), x * 2 + 1)
+
+    def test_batch_chain_stays_batched(self):
+        chain = BatchLambda(lambda b: b * 2) >> BatchLambda(lambda b: b + 1)
+        assert chain.batched
+        x = np.ones((4, 3), np.float32)
+        fs = FeatureSet.from_ndarrays(x, shuffle=False)
+        out = fs.transform(chain)
+        np.testing.assert_array_equal(np.asarray(out.features),
+                                      np.full((4, 3), 3.0))
+
+    def test_mixed_chain_falls_back_per_record(self):
+        chain = BatchLambda(lambda b: b * 2) >> Lambda(lambda r: r + 1)
+        assert not chain.batched
+        x = np.ones((4, 3), np.float32)
+        out = FeatureSet.from_ndarrays(x, shuffle=False).transform(chain)
+        np.testing.assert_array_equal(np.asarray(out.features),
+                                      np.full((4, 3), 3.0))
+
+    def test_threaded_transform_matches_serial(self):
+        x = np.arange(40, dtype=np.float32).reshape(20, 2)
+        fs = FeatureSet.from_ndarrays(x, shuffle=False)
+        serial = fs.transform(Lambda(lambda r: r ** 2))
+        threaded = fs.transform(Lambda(lambda r: r ** 2), num_workers=4)
+        np.testing.assert_array_equal(np.asarray(serial.features),
+                                      np.asarray(threaded.features))
+
+
+class TestStrings:
+    def test_from_strings_with_tokenizer(self):
+        texts = ["a b", "b c d", "a"]
+        vocab = {"a": 1, "b": 2, "c": 3, "d": 4}
+
+        def tok(s):
+            ids = [vocab[w] for w in s.split()][:3]
+            return np.pad(np.asarray(ids, np.int32), (0, 3 - len(ids)))
+
+        fs = FeatureSet.from_strings(
+            texts, np.zeros(3, np.float32), transform=Lambda(tok),
+            shuffle=False)
+        np.testing.assert_array_equal(
+            np.asarray(fs.features),
+            [[1, 2, 0], [2, 3, 4], [1, 0, 0]])
