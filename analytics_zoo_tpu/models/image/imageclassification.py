@@ -22,6 +22,26 @@ from ...keras.layers import (
 _RESNET_BLOCKS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3),
                   101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
 
+# canonical ImageNet statistics in pixel units — the ONE definition used by
+# on-device preprocess, the host ChannelNormalize chain, and bench.py
+IMAGENET_MEAN = np.asarray([0.485, 0.456, 0.406], np.float32) * 255.0
+IMAGENET_STD = np.asarray([0.229, 0.224, 0.225], np.float32) * 255.0
+_IMAGENET_MEAN, _IMAGENET_STD = IMAGENET_MEAN, IMAGENET_STD
+
+
+def _input_preprocess(x, mode: Optional[str]):
+    """Optional on-device input normalization. ``"imagenet_uint8"`` lets the
+    host pipeline ship raw uint8 (4x less host→HBM traffic — see bench.py
+    input_pipeline) and XLA fuses the normalize into the first conv."""
+    if mode is None:
+        return x
+    if mode == "imagenet_uint8":
+        import jax.numpy as jnp
+        return Lambda(
+            lambda t: (t.astype(jnp.float32) - _IMAGENET_MEAN) / _IMAGENET_STD,
+            name="preprocess")(x)
+    raise ValueError(f"unknown preprocess mode {mode!r}")
+
 
 def _conv_bn(x, filters, k, stride=1, activation="relu", name=""):
     x = Convolution2D(filters, k, k, subsample=(stride, stride),
@@ -55,7 +75,8 @@ def _bottleneck_block(x, filters, stride, name):
 
 def resnet(depth: int = 50, num_classes: int = 1000,
            input_shape: Tuple[int, int, int] = (224, 224, 3),
-           include_top: bool = True) -> Model:
+           include_top: bool = True,
+           preprocess: Optional[str] = None) -> Model:
     """ResNet-v1 (18/34/50/101/152)."""
     if depth not in _RESNET_BLOCKS:
         raise ValueError(f"unsupported depth {depth}; have "
@@ -63,7 +84,8 @@ def resnet(depth: int = 50, num_classes: int = 1000,
     blocks = _RESNET_BLOCKS[depth]
     block_fn = _basic_block if depth < 50 else _bottleneck_block
     inp = Input(input_shape, name="image")
-    x = _conv_bn(inp, 64, 7, 2, "relu", "stem")
+    x = _input_preprocess(inp, preprocess)
+    x = _conv_bn(x, 64, 7, 2, "relu", "stem")
     x = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same",
                      name="stem_pool")(x)
     filters = 64
@@ -158,7 +180,8 @@ class ImageClassifier(ZooModel):
             ChannelNormalize, ImageSetToSample, Resize)
         h, w, _ = self.input_shape
         return (Resize(h, w)
-                >> ChannelNormalize([123.68, 116.78, 103.94], [58.4, 57.1, 57.4])
+                >> ChannelNormalize(IMAGENET_MEAN.tolist(),
+                                    IMAGENET_STD.tolist())
                 >> ImageSetToSample())
 
     def predict_image_set(self, image_set, top_k: int = 5,

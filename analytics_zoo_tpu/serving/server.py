@@ -147,3 +147,31 @@ class ClusterServing:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+
+
+def main() -> None:
+    """CLI entry (the ``cluster-serving-start`` role, packaged as
+    ``zoo-serving``): read a YAML config, write a pidfile, serve."""
+    import signal
+    import sys
+
+    cfg_path = sys.argv[1] if len(sys.argv) > 1 else "config.yaml"
+    cfg = ServingConfig.from_yaml(cfg_path)
+    # construct (model load, queue init) BEFORE writing the pidfile so a
+    # startup failure can't leave a stale pidfile for a supervisor to kill
+    # an unrelated reused pid with
+    serving = ClusterServing(cfg)
+    signal.signal(signal.SIGTERM, lambda *_: serving.stop())
+    signal.signal(signal.SIGINT, lambda *_: serving.stop())
+    pidfile = os.environ.get("ZOO_SERVING_PIDFILE", "/tmp/zoo_serving.pid")
+    try:
+        with open(pidfile, "w") as f:
+            f.write(str(os.getpid()))
+        serving.run()
+    finally:
+        try:
+            with open(pidfile) as f:
+                if f.read().strip() == str(os.getpid()):
+                    os.remove(pidfile)
+        except OSError:
+            pass

@@ -253,8 +253,8 @@ def bench_input_pipeline(batch_size: int = 256, steps: int = 30):
     rs = np.random.RandomState(0)
     raw = rs.randint(0, 255, (n, 224, 224, 3), dtype=np.uint8)
     labels = rs.randint(0, 2, n).astype(np.float32)
-    mean = np.asarray([0.485, 0.456, 0.406], np.float32) * 255
-    std = np.asarray([0.229, 0.224, 0.225], np.float32) * 255
+    from analytics_zoo_tpu.models.image.imageclassification import (
+        IMAGENET_MEAN as mean, IMAGENET_STD as std)
 
     def run(fs, device_fn=None):
         feed = DeviceFeed(fs.train_iterator(batch_size), ctx.mesh)

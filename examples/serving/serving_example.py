@@ -1,0 +1,54 @@
+"""Cluster serving end-to-end (reference
+``pyzoo/zoo/examples/serving``): save a zoo model, start the serving engine
+on a file-backed queue, push tensors with the client SDK, read predictions.
+"""
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+from analytics_zoo_tpu.models import NeuralCF
+from analytics_zoo_tpu.serving import ClusterServing, ServingConfig
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--requests", type=int, default=8)
+    args = ap.parse_args()
+
+    workdir = tempfile.mkdtemp(prefix="zoo_serving_example_")
+    model_path = os.path.join(workdir, "model")
+    queue_src = f"dir://{workdir}/queue"
+
+    # 1. train briefly and save the model the server will load
+    ncf = NeuralCF(50, 40, 2, user_embed=8, item_embed=8,
+                   hidden_layers=[16, 8], mf_embed=4)
+    ncf.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    rs = np.random.RandomState(0)
+    x = np.stack([rs.randint(1, 50, 512), rs.randint(1, 40, 512)], 1) \
+        .astype(np.float32)
+    ncf.fit(x, (rs.rand(512) > 0.5).astype(np.float32), batch_size=128,
+            nb_epoch=1)
+    ncf.save_model(model_path)
+
+    # 2. serving engine on a background thread (same engine `zoo-serving`
+    # runs as a daemon from config.yaml)
+    cfg = ServingConfig(model_path=model_path, model_type="zoo",
+                        data_src=queue_src, batch_size=4, filter_top_n=2)
+    serving = ClusterServing(cfg).start()
+
+    # 3. client: enqueue tensors, await results
+    inq, outq = InputQueue(queue_src), OutputQueue(queue_src)
+    for i in range(args.requests):
+        inq.enqueue_tensor(f"req-{i}", x[i])
+    for i in range(args.requests):
+        result = outq.query(f"req-{i}", timeout_s=30)
+        print(f"req-{i}: {result}")
+    serving.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""BERT sequence classification via the capture-style task estimator
+(north-star #4; reference ``pyzoo/zoo/examples/tfpark/estimator`` BERT
+classifier flow).
+
+``--smoke`` uses a 2-layer toy BERT; the default is BERT-base shapes, which
+the attention stack runs through the pallas flash kernel on TPU.
+"""
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu.capture.text import BERTClassifier, bert_input_pack
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--seq-len", type=int, default=64)
+    args = ap.parse_args()
+
+    if args.smoke:
+        config = dict(vocab=1000, hidden_size=32, n_block=2, n_head=2,
+                      max_position_len=64, intermediate_size=64)
+        n, seq = 64, 16
+    else:
+        config = dict(vocab=30522, hidden_size=768, n_block=12, n_head=12,
+                      max_position_len=512, intermediate_size=3072)
+        n, seq = 2048, args.seq_len
+
+    clf = BERTClassifier(num_classes=2, bert_config=config, optimizer="adam")
+    rs = np.random.RandomState(0)
+    tokens = rs.randint(1, config["vocab"], (n, seq))
+    # planted signal: label = whether token 7 appears in the sequence
+    labels = (tokens == 7).any(axis=1).astype(np.float32)
+
+    result = clf.fit(tokens, labels, batch_size=args.batch_size,
+                     epochs=args.epochs)
+    print(f"fine-tune loss: {result['loss_history'][-1]:.4f}")
+
+    probs = clf.predict(tokens[:8])
+    print("predictions:", np.argmax(probs, axis=-1).tolist())
+
+
+if __name__ == "__main__":
+    main()
