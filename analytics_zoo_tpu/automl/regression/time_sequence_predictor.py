@@ -14,6 +14,7 @@ from ..feature.time_sequence import TimeSequenceFeatureTransformer
 from ..model import MODEL_REGISTRY
 from ..pipeline.time_sequence import TimeSequencePipeline
 from ..search.local_search import LocalSearchEngine
+from ..search.parallel_search import ParallelSearchEngine
 
 
 class TimeSequencePredictor:
@@ -59,12 +60,19 @@ class TimeSequencePredictor:
 
     def fit(self, input_df, validation_df=None,
             recipe: Optional[Recipe] = None, metric: str = "mse",
+            search_engine: str = "local", num_workers: Optional[int] = None,
             ) -> TimeSequencePipeline:
+        """``search_engine="parallel"`` runs trials in spawned worker
+        processes (the RayTune role); the winning config is then re-fit
+        in-process to build the returned pipeline."""
         recipe = recipe or SmokeRecipe()
         self._best = None
         self._best_score = None
         self._mode = Evaluator.get_metric_mode(metric)
-        engine = LocalSearchEngine()
+        if search_engine == "parallel":
+            engine = ParallelSearchEngine(num_workers=num_workers)
+        else:
+            engine = LocalSearchEngine()
         ft_probe = TimeSequenceFeatureTransformer(
             self.future_seq_len, self.dt_col, self.target_col,
             self.extra_features_col)
@@ -74,7 +82,13 @@ class TimeSequencePredictor:
                        fit_fn=self._trial)
         engine.run()
         if self._best is None:
-            raise RuntimeError("no successful trials")
+            # parallel engines ran trials in worker processes, so the
+            # in-process best tracker never fired: re-fit the winning config
+            best_trials = engine.get_best_trials(1)
+            if not best_trials:
+                raise RuntimeError("no successful trials")
+            self._trial(best_trials[0].config,
+                        (input_df, validation_df, metric))
         ft, model, config = self._best
         self.pipeline = TimeSequencePipeline(ft, model, config,
                                              name=self.name)

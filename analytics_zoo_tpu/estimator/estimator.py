@@ -111,7 +111,14 @@ class Estimator:
     # -- initialization -------------------------------------------------------
 
     def _ensure_initialized(self, sample_x) -> None:
-        if self.params is not None and self.opt_state is not None:
+        # "state resolved" distinguishes a genuinely-stateless model (state
+        # legitimately {}) from state that simply hasn't been built yet — an
+        # empty dict alone can't express that, and skipping the build for a
+        # BatchNorm model means KeyError at call time
+        state_resolved = (getattr(self, "_state_resolved", False)
+                          or bool(self.model_state))
+        if self.params is not None and state_resolved and (
+                self.opt_state is not None or self.optimizer is None):
             return
         from ..keras.engine import init_model
         self.root_rng, init_rng = jax.random.split(self.root_rng)
@@ -122,7 +129,8 @@ class Estimator:
             if not self.model_state:
                 self.model_state = jax.device_put(
                     state, param_sharding(self.mesh, state, self.param_rules))
-        elif not self.model_state:
+            self._state_resolved = True
+        elif not state_resolved:
             # params were imported (set_params); build only fresh model state
             # — under jit XLA dead-code-eliminates the (discarded) param init
             state = jax.jit(
@@ -132,7 +140,8 @@ class Estimator:
                     state, param_sharding(self.mesh, state, self.param_rules))
             else:
                 self.model_state = {}
-        if self.opt_state is None:
+            self._state_resolved = True
+        if self.opt_state is None and self.optimizer is not None:
             opt = self.optimizer.init(self.params)
             self.opt_state = jax.device_put(
                 opt, param_sharding(self.mesh, opt, None))
@@ -433,9 +442,12 @@ class Estimator:
 
     def _evaluate_direct(self, val_set: FeatureSet, batch_size: int
                          ) -> Dict[str, float]:
-        """Average captured loss over full batches (direct-loss capture mode:
-        the loss fn sees the raw batch, so padding can't be masked — the tail
-        remainder is dropped)."""
+        """Record-weighted average of the captured loss (direct-loss capture
+        mode: the loss fn sees the raw batch, so padding cannot be masked).
+        Full batches run sharded; the tail batch runs UNPADDED through the
+        same jitted step — its batch axis is simply replicated over the mesh
+        (one extra compile at the tail shape) — so every record counts and a
+        validation set smaller than one batch still evaluates."""
         local_batch = min(self.ctx.local_batch(batch_size), val_set.size)
         ndev = self.mesh.devices.size
         local_batch = max(ndev, (local_batch // ndev) * ndev)
@@ -446,19 +458,27 @@ class Estimator:
             self._direct_eval_step = jax.jit(
                 lambda p, s, rng, x, y: direct(p, s, rng, x, y)[0])
         eval_rng = jax.random.PRNGKey(0)
-        losses = []  # partial tail batches are dropped (loss can't mask pad)
+        # multi-process: each host's shard has its OWN tail, so running it
+        # unsharded would diverge the SPMD programs across hosts — drop tails
+        # there (full batches only, as before); single-process evaluates the
+        # tail exactly via a replicated-batch compile
+        multiproc = self.ctx.process_count > 1
+        total, weight = 0.0, 0
         for x, y, valid in val_set.eval_iterator(local_batch,
-                                                 pad_remainder=True):
-            if valid < local_batch:
+                                                 pad_remainder=False):
+            if valid == local_batch:
+                x, y = shard_batch(self.mesh, (x, y))
+            elif multiproc:
                 continue
-            bx, by = shard_batch(self.mesh, (x, y))
-            losses.append(float(self._direct_eval_step(
-                self.params, self.model_state, eval_rng, bx, by)))
-        if not losses:
+            loss = float(self._direct_eval_step(
+                self.params, self.model_state, eval_rng, x, y))
+            total += loss * valid
+            weight += valid
+        if weight == 0:
             raise ValueError(
                 f"validation set smaller than one batch ({val_set.size} < "
-                f"{local_batch}); reduce batch_size")
-        return {"loss": float(np.mean(losses))}
+                f"{local_batch}) on a multi-host run; reduce batch_size")
+        return {"loss": total / weight}
 
     # -- predict (TFNet/Predictable equivalent) -------------------------------
 
@@ -493,9 +513,11 @@ class Estimator:
         self.params = jax.device_put(params, sharding)
 
     def set_model_state(self, state) -> None:
-        """Install non-trainable model state (e.g. imported BN statistics)."""
+        """Install non-trainable model state (e.g. imported BN statistics).
+        An explicit empty tree marks the model as deliberately stateless."""
         self.model_state = jax.device_put(
             state, param_sharding(self.mesh, state, self.param_rules))
+        self._state_resolved = True
 
     def _snapshot_tree(self):
         tree = {

@@ -1,0 +1,9 @@
+"""Keras-2-style API (reference ``zoo/.../pipeline/api/keras2/`` +
+``pyzoo/zoo/pipeline/api/keras2/``): the SAME engine and layers as
+:mod:`analytics_zoo_tpu.keras`, exposed under Keras-2 argument names
+(``units``/``filters``/``kernel_size``/``strides``/``padding``/
+``use_bias``/``rate``...). Models built from either namespace mix freely —
+these classes subclass the keras-1 layers, so params/checkpoints/graphs are
+identical."""
+from ..keras.engine import Input, Model, Sequential  # noqa: F401
+from . import layers  # noqa: F401

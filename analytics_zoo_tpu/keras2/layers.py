@@ -1,0 +1,204 @@
+"""Keras-2 argument-name adapters over the native keras-1 layer classes
+(reference keras2 layers: ``keras2/layers/Dense.scala:30``,
+``pyzoo/zoo/pipeline/api/keras2/layers/core.py:55`` etc.).
+
+Each class subclasses its keras-1 twin and only translates constructor
+vocabulary (units→output_dim, strides→subsample, padding→border_mode...),
+so graphs, params and checkpoints are interchangeable between the two APIs.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+from ..keras import layers as k1
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+def _reject_unknown(cls_name: str, kwargs) -> None:
+    """Unsupported Keras-2 arguments fail loudly — silently dropping e.g.
+    ``dilation_rate`` or ``kernel_regularizer`` would build a DIFFERENT
+    model than the user asked for."""
+    if kwargs:
+        raise TypeError(f"{cls_name}: unsupported keras2 argument(s) "
+                        f"{sorted(kwargs)}")
+
+
+class Dense(k1.Dense):
+    def __init__(self, units: int, activation=None,
+                 kernel_initializer="glorot_uniform", use_bias: bool = True,
+                 name: Optional[str] = None, **kwargs):
+        _reject_unknown("Dense", kwargs)
+        super().__init__(units, activation=activation,
+                         init=kernel_initializer, bias=use_bias, name=name)
+
+
+class Dropout(k1.Dropout):
+    def __init__(self, rate: float, name: Optional[str] = None):
+        super().__init__(rate, name=name)
+
+
+class Activation(k1.Activation):
+    pass
+
+
+class Flatten(k1.Flatten):
+    pass
+
+
+class Softmax(k1.Softmax):
+    pass
+
+
+class Conv1D(k1.Convolution1D):
+    def __init__(self, filters: int, kernel_size: int, strides: int = 1,
+                 padding: str = "valid", activation=None,
+                 kernel_initializer="glorot_uniform", use_bias: bool = True,
+                 name: Optional[str] = None, **kwargs):
+        _reject_unknown("Conv1D", kwargs)
+        super().__init__(filters, kernel_size, activation=activation,
+                         subsample_length=strides, border_mode=padding,
+                         init=kernel_initializer, bias=use_bias, name=name)
+
+
+class Conv2D(k1.Convolution2D):
+    def __init__(self, filters: int, kernel_size, strides=(1, 1),
+                 padding: str = "valid", activation=None,
+                 dilation_rate=(1, 1), groups: int = 1,
+                 kernel_initializer="glorot_uniform", use_bias: bool = True,
+                 name: Optional[str] = None, **kwargs):
+        _reject_unknown("Conv2D", kwargs)
+        kh, kw = _pair(kernel_size)
+        super().__init__(filters, kh, kw, activation=activation,
+                         subsample=_pair(strides), border_mode=padding,
+                         init=kernel_initializer, bias=use_bias,
+                         dilation=_pair(dilation_rate), groups=groups,
+                         name=name)
+
+
+class Conv3D(k1.Convolution3D):
+    def __init__(self, filters: int, kernel_size, strides=(1, 1, 1),
+                 padding: str = "valid", activation=None,
+                 kernel_initializer="glorot_uniform", use_bias: bool = True,
+                 name: Optional[str] = None, **kwargs):
+        _reject_unknown("Conv3D", kwargs)
+        kd, kh, kw = (kernel_size if isinstance(kernel_size, (tuple, list))
+                      else (kernel_size,) * 3)
+        sd, sh, sw = (strides if isinstance(strides, (tuple, list))
+                      else (strides,) * 3)
+        super().__init__(filters, kd, kh, kw, activation=activation,
+                         subsample=(sd, sh, sw), border_mode=padding,
+                         init=kernel_initializer, bias=use_bias, name=name)
+
+
+class MaxPooling1D(k1.MaxPooling1D):
+    def __init__(self, pool_size: int = 2, strides: Optional[int] = None,
+                 padding: str = "valid", name: Optional[str] = None):
+        super().__init__(pool_length=pool_size, stride=strides,
+                         border_mode=padding, name=name)
+
+
+class MaxPooling2D(k1.MaxPooling2D):
+    def __init__(self, pool_size=(2, 2), strides=None, padding: str = "valid",
+                 name: Optional[str] = None):
+        super().__init__(pool_size=_pair(pool_size), strides=strides,
+                         border_mode=padding, name=name)
+
+
+class AveragePooling1D(k1.AveragePooling1D):
+    def __init__(self, pool_size: int = 2, strides: Optional[int] = None,
+                 padding: str = "valid", name: Optional[str] = None):
+        super().__init__(pool_length=pool_size, stride=strides,
+                         border_mode=padding, name=name)
+
+
+class AveragePooling2D(k1.AveragePooling2D):
+    def __init__(self, pool_size=(2, 2), strides=None, padding: str = "valid",
+                 name: Optional[str] = None):
+        super().__init__(pool_size=_pair(pool_size), strides=strides,
+                         border_mode=padding, name=name)
+
+
+class GlobalAveragePooling1D(k1.GlobalAveragePooling1D):
+    pass
+
+
+class GlobalAveragePooling2D(k1.GlobalAveragePooling2D):
+    pass
+
+
+class GlobalAveragePooling3D(k1.GlobalAveragePooling3D):
+    pass
+
+
+class GlobalMaxPooling1D(k1.GlobalMaxPooling1D):
+    pass
+
+
+class GlobalMaxPooling2D(k1.GlobalMaxPooling2D):
+    pass
+
+
+class GlobalMaxPooling3D(k1.GlobalMaxPooling3D):
+    pass
+
+
+class Cropping1D(k1.Cropping1D):
+    def __init__(self, cropping=(1, 1), name: Optional[str] = None):
+        super().__init__(cropping=cropping, name=name)
+
+
+class LocallyConnected1D(k1.LocallyConnected1D):
+    def __init__(self, filters: int, kernel_size: int, strides: int = 1,
+                 activation=None, use_bias: bool = True,
+                 name: Optional[str] = None, **kwargs):
+        _reject_unknown("LocallyConnected1D", kwargs)
+        super().__init__(filters, kernel_size, activation=activation,
+                         subsample_length=strides, bias=use_bias, name=name)
+
+
+class Embedding(k1.Embedding):
+    def __init__(self, input_dim: int, output_dim: int,
+                 embeddings_initializer="uniform",
+                 name: Optional[str] = None, **kwargs):
+        _reject_unknown("Embedding", kwargs)
+        super().__init__(input_dim, output_dim,
+                         init=embeddings_initializer, name=name)
+
+
+class BatchNormalization(k1.BatchNormalization):
+    def __init__(self, axis: int = -1, momentum: float = 0.99,
+                 epsilon: float = 1e-3, name: Optional[str] = None,
+                 **kwargs):
+        _reject_unknown("BatchNormalization", kwargs)
+        super().__init__(epsilon=epsilon, momentum=momentum, axis=axis,
+                         name=name)
+
+
+# -- merge layers (reference keras2 Maximum/Minimum/Average) ----------------
+
+
+def maximum(inputs, name: Optional[str] = None):
+    return k1.merge(inputs, mode="max", name=name)
+
+
+def minimum(inputs, name: Optional[str] = None):
+    return k1.merge(inputs, mode="min", name=name)
+
+
+def average(inputs, name: Optional[str] = None):
+    return k1.merge(inputs, mode="ave", name=name)
+
+
+def add(inputs, name: Optional[str] = None):
+    return k1.merge(inputs, mode="sum", name=name)
+
+
+def multiply(inputs, name: Optional[str] = None):
+    return k1.merge(inputs, mode="mul", name=name)
+
+
+def concatenate(inputs, axis: int = -1, name: Optional[str] = None):
+    return k1.merge(inputs, mode="concat", concat_axis=axis, name=name)

@@ -1,0 +1,133 @@
+"""Image3D volume transforms + the Keras-2 naming API."""
+import math
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature.image3d import (
+    AffineTransform3D, CenterCrop3D, Crop3D, RandomCrop3D, Rotate3D)
+
+
+class TestCrop3D:
+    def test_fixed_crop(self):
+        vol = np.arange(4 * 5 * 6, dtype=np.float32).reshape(4, 5, 6)
+        out = Crop3D([1, 2, 3], [2, 2, 2]).apply(vol)
+        np.testing.assert_array_equal(out, vol[1:3, 2:4, 3:5])
+
+    def test_channel_axis_preserved(self):
+        vol = np.zeros((4, 5, 6, 1), np.float32)
+        assert Crop3D([0, 0, 0], [2, 2, 2]).apply(vol).shape == (2, 2, 2, 1)
+
+    def test_out_of_bounds_rejected(self):
+        with pytest.raises(ValueError):
+            Crop3D([3, 0, 0], [2, 2, 2]).apply(np.zeros((4, 4, 4)))
+
+    def test_center_crop(self):
+        vol = np.arange(6 ** 3, dtype=np.float32).reshape(6, 6, 6)
+        out = CenterCrop3D(2, 2, 2).apply(vol)
+        np.testing.assert_array_equal(out, vol[2:4, 2:4, 2:4])
+
+    def test_random_crop_shape(self):
+        out = RandomCrop3D(3, 4, 5).apply(np.zeros((8, 8, 8)))
+        assert out.shape == (3, 4, 5)
+
+
+class TestAffine3D:
+    def test_identity(self):
+        vol = np.random.RandomState(0).rand(5, 6, 7).astype(np.float32)
+        out = AffineTransform3D(np.eye(3)).apply(vol)
+        np.testing.assert_allclose(out, vol, atol=1e-5)
+
+    def test_translation_shifts(self):
+        vol = np.zeros((5, 5, 5), np.float32)
+        vol[2, 2, 2] = 1.0
+        # translation moves the sampled source coordinate by -t, i.e. the
+        # CONTENT moves by +t along each axis
+        out = AffineTransform3D(np.eye(3), translation=(1, 0, 0),
+                                clamp_mode="padding").apply(vol)
+        assert out[3, 2, 2] == pytest.approx(1.0)
+        assert out[2, 2, 2] == pytest.approx(0.0)
+
+    def test_padding_mode_fills(self):
+        vol = np.ones((4, 4, 4), np.float32)
+        out = AffineTransform3D(np.eye(3), translation=(2, 0, 0),
+                                clamp_mode="padding", pad_val=-7).apply(vol)
+        assert out[0, 0, 0] == pytest.approx(-7)
+
+    def test_clamp_rejects_pad_val(self):
+        with pytest.raises(ValueError):
+            AffineTransform3D(np.eye(3), clamp_mode="clamp", pad_val=1.0)
+
+    def test_rotate_90_yaw(self):
+        """Reference convention (Rotation.scala:47-48): the yaw matrix acts
+        on (z, y, x) coordinate vectors mixing the first two components, so
+        a 90-degree yaw rotates the z-y plane and leaves x invariant. A unit
+        mass at offset (0, -1, 0) from center moves to offset (-1, 0, 0)."""
+        vol = np.zeros((3, 5, 5), np.float32)
+        vol[1, 1, 2] = 1.0  # center (1,2,2) + offset (0,-1,0)
+        out = Rotate3D([math.pi / 2, 0, 0]).apply(vol)
+        assert out.sum() == pytest.approx(1.0, abs=1e-5)
+        assert out[1, 1, 2] == pytest.approx(0.0, abs=1e-5)
+        assert out[0, 2, 2] == pytest.approx(1.0, abs=1e-5)
+
+    def test_rotate_roundtrip(self):
+        # smooth volume: double trilinear interpolation stays accurate
+        g = np.linspace(-1, 1, 12)
+        zz, yy, xx = np.meshgrid(g, g, g, indexing="ij")
+        vol = np.exp(-(zz ** 2 + yy ** 2 + xx ** 2) * 2).astype(np.float32)
+        ang = [0.3, -0.2, 0.5]
+        once = Rotate3D(ang).apply(vol)
+        out = AffineTransform3D(np.linalg.inv(Rotate3D(ang).mat)).apply(once)
+        # interpolation loses a little at the borders; interior must agree
+        # two trilinear passes over a curved field cost a few percent
+        np.testing.assert_allclose(out[3:9, 3:9, 3:9], vol[3:9, 3:9, 3:9],
+                                   atol=0.1)
+
+
+class TestKeras2:
+    def test_dense_conv_names(self):
+        import jax
+        from analytics_zoo_tpu.keras2 import Input, Model
+        from analytics_zoo_tpu.keras2.layers import (
+            Conv2D, Dense, Dropout, Flatten, MaxPooling2D)
+        x = Input(shape=(8, 8, 3))
+        h = Conv2D(4, kernel_size=3, strides=1, padding="same",
+                   activation="relu", name="c1")(x)
+        h = MaxPooling2D(pool_size=2)(h)
+        h = Flatten()(h)
+        h = Dropout(rate=0.5)(h)
+        y = Dense(units=2, use_bias=True, name="head")(h)
+        model = Model(x, y)
+        params, state = model.build(jax.random.PRNGKey(0))
+        out, _ = model.call(params, state,
+                            np.zeros((2, 8, 8, 3), np.float32))
+        assert np.asarray(out).shape == (2, 2)
+        # identical param-tree contract as keras-1
+        assert params["c1"]["kernel"].shape == (3, 3, 3, 4)
+        assert params["head"]["kernel"].shape == (4 * 4 * 4, 2)
+
+    def test_keras1_keras2_interchangeable(self):
+        """Same weights, same answers across the two namespaces."""
+        import jax
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras.layers import Dense as Dense1
+        from analytics_zoo_tpu.keras2.layers import Dense as Dense2
+        m1 = Sequential([Dense1(5, name="d")])
+        m2 = Sequential([Dense2(units=5, name="d")])
+        p, s = m1.build(jax.random.PRNGKey(0), (None, 3))
+        x = np.random.RandomState(2).randn(4, 3).astype(np.float32)
+        y1, _ = m1.call(p, s, x)
+        y2, _ = m2.call(p, s, x)
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+
+    def test_merge_functions(self):
+        import jax
+        from analytics_zoo_tpu.keras2 import Input, Model
+        from analytics_zoo_tpu.keras2.layers import average, maximum
+        a, b = Input(shape=(4,)), Input(shape=(4,))
+        model = Model([a, b], maximum([a, b]))
+        p, s = model.build(jax.random.PRNGKey(0))
+        xa = np.asarray([[1, 5, 2, 0]], np.float32)
+        xb = np.asarray([[3, 1, 2, 4]], np.float32)
+        out, _ = model.call(p, s, [xa, xb])
+        np.testing.assert_array_equal(np.asarray(out), [[3, 5, 2, 4]])
