@@ -24,7 +24,12 @@ from .abstract import TrialOutput
 from .local_search import LocalSearchEngine, _expand_grid, _materialize
 
 
-def _worker_init():
+# per-worker trainable context, installed once by the pool initializer so
+# the (potentially large) dataset is pickled once per WORKER, not per trial
+_worker_ctx: Dict[str, Any] = {}
+
+
+def _worker_init(fit_fn, model_create_fn, data, metric):
     # the worker interpreter may have pre-imported jax (sitecustomize) with
     # the hardware platform pinned; re-assert CPU before any backend starts
     try:
@@ -32,15 +37,18 @@ def _worker_init():
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
+    _worker_ctx.update(fit_fn=fit_fn, model_create_fn=model_create_fn,
+                       data=data, metric=metric)
 
 
-def _run_one(payload) -> Dict[str, Any]:
-    fit_fn, model_create_fn, config, data, metric = payload
+def _run_one(config) -> Dict[str, Any]:
+    fit_fn = _worker_ctx["fit_fn"]
     if fit_fn is not None:
-        score = fit_fn(config, data)
+        score = fit_fn(config, _worker_ctx["data"])
     else:
-        model = model_create_fn()
-        score = model.fit_eval(data, metric=metric, **config)
+        model = _worker_ctx["model_create_fn"]()
+        score = model.fit_eval(_worker_ctx["data"],
+                               metric=_worker_ctx["metric"], **config)
     return {"config": config, "metric": float(score)}
 
 
@@ -71,23 +79,22 @@ class ParallelSearchEngine(LocalSearchEngine):
         n_samples = max(1, self.recipe.runtime_params()["num_samples"])
         configs = [_materialize(point, self.rng)
                    for point in points for _ in range(n_samples)]
-        payloads = [(self.fit_fn, self.model_create_fn, c, self.data,
-                     self.metric) for c in configs]
+        ctx_args = (self.fit_fn, self.model_create_fn, self.data, self.metric)
         # validate picklability UP FRONT, so a genuine trial exception later
         # propagates as itself instead of being misdiagnosed
         import pickle
         try:
-            pickle.dumps(payloads[0])
+            pickle.dumps(ctx_args)
         except Exception as e:
             raise ValueError(
                 "ParallelSearchEngine needs a picklable trainable "
                 "(module-level fit_fn / model_create_fn); use "
                 f"LocalSearchEngine for closures. Underlying error: {e!r}")
         with ProcessPoolExecutor(
-                max_workers=min(self.num_workers, len(payloads)),
+                max_workers=min(self.num_workers, len(configs)),
                 mp_context=get_context("spawn"),
-                initializer=_worker_init) as pool:
-            results = list(pool.map(_run_one, payloads))
+                initializer=_worker_init, initargs=ctx_args) as pool:
+            results = list(pool.map(_run_one, configs))
         self.trials = [TrialOutput(config=r["config"], metric=r["metric"])
                        for r in results]
         return self.trials

@@ -608,6 +608,10 @@ class Estimator:
             tree["opt_state"], param_sharding(self.mesh, tree["opt_state"], None))
         self.global_step = int(tree["meta"]["global_step"])
         self.epoch = int(tree["meta"]["epoch"])
+        # a restored model_state (even a legitimately empty one) is final —
+        # without this a stateless model burns an rng split rebuilding it,
+        # diverging the resumed dropout stream from an uninterrupted run
+        self._state_resolved = True
         if "data_rng" in tree["meta"]:
             rng_json = bytes(np.asarray(tree["meta"]["data_rng"])).decode()
             self._restore_data = (rng_json,

@@ -17,6 +17,12 @@ def _pair(v):
     return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
 
 
+def _check_padding(cls_name: str, padding: str) -> None:
+    if padding not in ("valid", "same"):
+        raise ValueError(f"{cls_name}: unsupported padding {padding!r} "
+                         f"(only 'valid'/'same'; 'causal' is not available)")
+
+
 def _reject_unknown(cls_name: str, kwargs) -> None:
     """Unsupported Keras-2 arguments fail loudly — silently dropping e.g.
     ``dilation_rate`` or ``kernel_regularizer`` would build a DIFFERENT
@@ -58,6 +64,7 @@ class Conv1D(k1.Convolution1D):
                  kernel_initializer="glorot_uniform", use_bias: bool = True,
                  name: Optional[str] = None, **kwargs):
         _reject_unknown("Conv1D", kwargs)
+        _check_padding("Conv1D", padding)
         super().__init__(filters, kernel_size, activation=activation,
                          subsample_length=strides, border_mode=padding,
                          init=kernel_initializer, bias=use_bias, name=name)
@@ -70,6 +77,7 @@ class Conv2D(k1.Convolution2D):
                  kernel_initializer="glorot_uniform", use_bias: bool = True,
                  name: Optional[str] = None, **kwargs):
         _reject_unknown("Conv2D", kwargs)
+        _check_padding("Conv2D", padding)
         kh, kw = _pair(kernel_size)
         super().__init__(filters, kh, kw, activation=activation,
                          subsample=_pair(strides), border_mode=padding,
@@ -84,6 +92,7 @@ class Conv3D(k1.Convolution3D):
                  kernel_initializer="glorot_uniform", use_bias: bool = True,
                  name: Optional[str] = None, **kwargs):
         _reject_unknown("Conv3D", kwargs)
+        _check_padding("Conv3D", padding)
         kd, kh, kw = (kernel_size if isinstance(kernel_size, (tuple, list))
                       else (kernel_size,) * 3)
         sd, sh, sw = (strides if isinstance(strides, (tuple, list))
@@ -96,6 +105,7 @@ class Conv3D(k1.Convolution3D):
 class MaxPooling1D(k1.MaxPooling1D):
     def __init__(self, pool_size: int = 2, strides: Optional[int] = None,
                  padding: str = "valid", name: Optional[str] = None):
+        _check_padding("MaxPooling1D", padding)
         super().__init__(pool_length=pool_size, stride=strides,
                          border_mode=padding, name=name)
 
@@ -103,6 +113,7 @@ class MaxPooling1D(k1.MaxPooling1D):
 class MaxPooling2D(k1.MaxPooling2D):
     def __init__(self, pool_size=(2, 2), strides=None, padding: str = "valid",
                  name: Optional[str] = None):
+        _check_padding("MaxPooling2D", padding)
         super().__init__(pool_size=_pair(pool_size), strides=strides,
                          border_mode=padding, name=name)
 
@@ -110,6 +121,7 @@ class MaxPooling2D(k1.MaxPooling2D):
 class AveragePooling1D(k1.AveragePooling1D):
     def __init__(self, pool_size: int = 2, strides: Optional[int] = None,
                  padding: str = "valid", name: Optional[str] = None):
+        _check_padding("AveragePooling1D", padding)
         super().__init__(pool_length=pool_size, stride=strides,
                          border_mode=padding, name=name)
 
@@ -117,6 +129,7 @@ class AveragePooling1D(k1.AveragePooling1D):
 class AveragePooling2D(k1.AveragePooling2D):
     def __init__(self, pool_size=(2, 2), strides=None, padding: str = "valid",
                  name: Optional[str] = None):
+        _check_padding("AveragePooling2D", padding)
         super().__init__(pool_size=_pair(pool_size), strides=strides,
                          border_mode=padding, name=name)
 

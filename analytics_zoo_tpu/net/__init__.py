@@ -7,6 +7,7 @@
   (``new_graph``/``freeze``/``freeze_up_to``/``unfreeze``), mirroring the
   reference's GraphNet (``NetUtils.scala:29``).
 """
+from .caffe_loader import load_caffe  # noqa: F401
 from .onnx_loader import OnnxLoaderError, load_onnx  # noqa: F401
 from .torch_import import load_torch, load_torch_state_dict  # noqa: F401
 
@@ -37,3 +38,10 @@ class Net:
     def load_torch(model, module_or_path, strict: bool = True):
         """Torch weights → ``(params, state)`` for a matching native model."""
         return load_torch(model, module_or_path, strict=strict)
+
+    @staticmethod
+    def load_caffe(prototxt_path, caffemodel_path=None, input_shape=None):
+        """Caffe prototxt (+ caffemodel) → ``(model, params, state)``
+        (reference ``Net.loadCaffe``, ``CaffeLoader.scala:1``)."""
+        return load_caffe(prototxt_path, caffemodel_path,
+                          input_shape=input_shape)

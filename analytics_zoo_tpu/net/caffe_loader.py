@@ -1,0 +1,476 @@
+"""Caffe model import (reference ``CaffeLoader.scala:1`` /
+``Net.loadCaffe``): ``.prototxt`` (text topology) + optional ``.caffemodel``
+(binary weights) → native Keras-engine Model.
+
+Design: rather than a second graph builder, the parsed Caffe net is
+*translated into ONNX-style nodes* and fed through the existing
+:class:`~analytics_zoo_tpu.net.onnx_loader._GraphBuilder` — Caffe blobs are
+OIHW like ONNX initializers, InnerProduct is ``Gemm(transB=1)``, and the
+NCHW→NHWC conversion, flatten-boundary kernel permutation, and
+count_include_pad handling all come for free. Caffe's ceil-mode pooling is
+materialized as extra end-padding so shapes match the original net.
+
+The ``.caffemodel`` binary is decoded with the shared protobuf wire reader
+(no caffe/protobuf dependency); the ``.prototxt`` with a ~60-line text-proto
+parser.
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.protowire import Field, parse
+from .onnx_loader import _GraphBuilder, OnnxLoaderError, _Value
+
+# --------------------------------------------------------------------------
+# prototxt (text protobuf) parsing
+# --------------------------------------------------------------------------
+
+_TOKEN = re.compile(r"""
+    (?P<brace>[{}])
+  | (?P<name>[A-Za-z_][A-Za-z0-9_]*)\s*(?P<colon>:)?
+  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<number>-?\d+\.?\d*(?:[eE][-+]?\d+)?)
+""", re.VERBOSE)
+
+
+def _tokenize(text: str):
+    # strip comments
+    text = re.sub(r"#[^\n]*", "", text)
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            if text[pos].isspace():
+                pos += 1
+                continue
+            raise ValueError(f"prototxt parse error at: {text[pos:pos+40]!r}")
+        pos = m.end()
+        yield m
+
+
+def parse_prototxt(text: str) -> Dict[str, Any]:
+    """Text-format protobuf → nested dict; repeated fields become lists."""
+    root: Dict[str, Any] = {}
+    stack: List[Dict[str, Any]] = [root]
+    pending_key: Optional[str] = None
+    for tok in _tokenize(text):
+        if tok.group("brace") == "{":
+            child: Dict[str, Any] = {}
+            _append(stack[-1], pending_key, child)
+            stack.append(child)
+            pending_key = None
+        elif tok.group("brace") == "}":
+            stack.pop()
+            if not stack:
+                raise ValueError("unbalanced braces in prototxt")
+        elif tok.group("name") is not None and pending_key is None:
+            pending_key = tok.group("name")
+            if not tok.group("colon"):
+                continue  # message field: next token should be '{'
+        elif pending_key is not None:
+            if tok.group("string") is not None:
+                value: Any = tok.group("string")[1:-1]
+            elif tok.group("number") is not None:
+                num = tok.group("number")
+                value = float(num) if ("." in num or "e" in num.lower()) \
+                    else int(num)
+            elif tok.group("name") is not None:  # enum / bool literal
+                word = tok.group("name")
+                value = {"true": True, "false": False}.get(word, word)
+            else:
+                raise ValueError(f"unexpected token {tok.group(0)!r}")
+            _append(stack[-1], pending_key, value)
+            pending_key = None
+    if len(stack) != 1:
+        raise ValueError("unbalanced braces in prototxt")
+    return root
+
+
+def _append(container: Dict[str, Any], key: Optional[str], value: Any):
+    if key is None:
+        raise ValueError("prototxt value without a field name")
+    if key in container:
+        if not isinstance(container[key], list):
+            container[key] = [container[key]]
+        container[key].append(value)
+    else:
+        container[key] = value
+
+
+def _as_list(v) -> List[Any]:
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+# --------------------------------------------------------------------------
+# .caffemodel (binary NetParameter) weights via the shared wire decoder
+# --------------------------------------------------------------------------
+
+_BLOB_SHAPE = {1: Field("dim", "int", repeated=True)}
+_BLOB = {
+    1: Field("num", "int"), 2: Field("channels", "int"),
+    3: Field("height", "int"), 4: Field("width", "int"),
+    5: Field("data", "float32", repeated=True),
+    7: Field("shape", "message", schema=_BLOB_SHAPE),
+}
+_LAYER = {
+    1: Field("name", "string"),
+    2: Field("type", "string"),
+    7: Field("blobs", "message", repeated=True, schema=_BLOB),
+}
+_V1LAYER = {  # legacy 'layers' field (V1LayerParameter: name=4, blobs=6;
+    # field 1 is an embedded V0LayerParameter message we don't need)
+    4: Field("name", "string"),
+    6: Field("blobs", "message", repeated=True, schema=_BLOB),
+}
+_NET = {
+    1: Field("name", "string"),
+    2: Field("layers_v1", "message", repeated=True, schema=_V1LAYER),
+    100: Field("layer", "message", repeated=True, schema=_LAYER),
+}
+
+
+def _blob_array(blob: Dict[str, Any]) -> np.ndarray:
+    data = np.asarray(blob.get("data", []), dtype=np.float32)
+    shape = (blob.get("shape") or {}).get("dim") or []
+    if not shape:
+        shape = [blob.get(k) for k in ("num", "channels", "height", "width")]
+        shape = [int(s) for s in shape if s]
+    if shape and int(np.prod(shape)) == data.size:
+        return data.reshape([int(s) for s in shape])
+    return data
+
+
+def load_caffemodel_weights(path: str) -> Dict[str, List[np.ndarray]]:
+    """.caffemodel → {layer_name: [blob arrays]}."""
+    with open(path, "rb") as f:
+        net = parse(f.read(), _NET)
+    out: Dict[str, List[np.ndarray]] = {}
+    for layer in net.get("layer", []):
+        if layer.get("blobs"):
+            out[layer.get("name", "")] = [_blob_array(b)
+                                          for b in layer["blobs"]]
+    for layer in net.get("layers_v1", []):
+        name = layer.get("name") or ""
+        if layer.get("blobs") and name not in out:
+            out[name] = [_blob_array(b) for b in layer["blobs"]]
+    return out
+
+
+# --------------------------------------------------------------------------
+# Caffe net → ONNX-style nodes → existing graph builder
+# --------------------------------------------------------------------------
+
+
+def _pool_pads(size_hw, kernel, stride, pad) -> Tuple[int, int, int, int]:
+    """Caffe pools use CEIL output sizing; express the difference as extra
+    end-padding so the VALID-mode builder produces identical shapes."""
+    pads = [pad[0], pad[1], pad[0], pad[1]]  # h0, w0, h1, w1
+    for i, (size, k, s, p) in enumerate(zip(size_hw, kernel, stride, pad)):
+        if size is None:
+            continue
+        out_ceil = int(math.ceil((size + 2 * p - k) / s)) + 1
+        # caffe clips windows that start in the padding
+        if p > 0 and (out_ceil - 1) * s >= size + p:
+            out_ceil -= 1
+        need = (out_ceil - 1) * s + k - size - p
+        pads[2 + i] = max(p, need)
+    return tuple(pads)
+
+
+class CaffeGraphBuilder:
+    def __init__(self, net: Dict[str, Any],
+                 weights: Optional[Dict[str, List[np.ndarray]]],
+                 input_shape: Optional[Tuple[int, ...]] = None):
+        self.net = net
+        self.weights = weights or {}
+        self.input_shape = input_shape  # (C, H, W) override
+
+    def _layers(self) -> List[Dict[str, Any]]:
+        return _as_list(self.net.get("layer") or self.net.get("layers"))
+
+    def build(self):
+        nodes: List[Dict[str, Any]] = []
+        initializers: Dict[str, np.ndarray] = {}
+        inputs: List[Tuple[str, List[Optional[int]]]] = []
+        # net-level input declaration styles
+        if self.net.get("input"):
+            names = _as_list(self.net["input"])
+            dims_msgs = _as_list(self.net.get("input_shape"))
+            for i, name in enumerate(names):
+                if self.input_shape is not None:
+                    shape = [None] + list(self.input_shape)
+                elif i < len(dims_msgs):
+                    dims = [int(d) for d in _as_list(dims_msgs[i].get("dim"))]
+                    shape = [None] + dims[1:]
+                else:
+                    dims = [int(d) for d in _as_list(self.net.get("input_dim"))]
+                    shape = [None] + dims[4 * i + 1:4 * i + 4]
+                inputs.append((name, shape))
+
+        pending_bn: Dict[str, Dict[str, Any]] = {}  # top name → BN node parts
+        for layer in self._layers():
+            ltype = str(layer.get("type", "")).lower()
+            name = layer.get("name", f"layer{len(nodes)}")
+            bottoms = [str(b) for b in _as_list(layer.get("bottom"))]
+            tops = [str(t) for t in _as_list(layer.get("top"))]
+            blobs = self.weights.get(name, [])
+
+            if ltype in ("input", "data"):
+                shape_msg = (layer.get("input_param") or {}).get("shape")
+                if self.input_shape is not None:
+                    shape = [None] + list(self.input_shape)
+                elif shape_msg:
+                    dims = [int(d) for d in _as_list(
+                        _as_list(shape_msg)[0].get("dim"))]
+                    shape = [None] + dims[1:]
+                else:
+                    raise OnnxLoaderError(
+                        f"input layer '{name}' has no shape; pass "
+                        f"input_shape=(C,H,W) to load_caffe")
+                inputs.append((tops[0], shape))
+                continue
+
+            if ltype == "convolution":
+                cp = layer.get("convolution_param") or {}
+                k = _as_list(cp.get("kernel_size")) or [int(cp.get("kernel_h", 1))]
+                kh = int(cp.get("kernel_h") or k[0])
+                kw = int(cp.get("kernel_w") or (k[1] if len(k) > 1 else k[0]))
+                s = _as_list(cp.get("stride")) or [1]
+                sh = int(cp.get("stride_h") or s[0])
+                sw = int(cp.get("stride_w") or (s[1] if len(s) > 1 else s[0]))
+                p = _as_list(cp.get("pad")) or [0]
+                ph = int(cp.get("pad_h") or p[0])
+                pw = int(cp.get("pad_w") or (p[1] if len(p) > 1 else p[0]))
+                group = int(cp.get("group") or 1)
+                bias = bool(cp.get("bias_term", True))
+                if not blobs:
+                    cin = None  # random init happens in the engine later
+                    raise OnnxLoaderError(
+                        f"conv layer '{name}' has no weights; load the "
+                        f".caffemodel alongside the .prototxt")
+                w = blobs[0].reshape(int(cp.get("num_output")), -1, kh, kw)
+                initializers[f"{name}_w"] = w
+                node_inputs = [bottoms[0], f"{name}_w"]
+                if bias and len(blobs) > 1:
+                    initializers[f"{name}_b"] = blobs[1].reshape(-1)
+                    node_inputs.append(f"{name}_b")
+                nodes.append({
+                    "op_type": "Conv", "name": name,
+                    "input": node_inputs, "output": [tops[0]],
+                    "attrs": {"kernel_shape": [kh, kw], "strides": [sh, sw],
+                              "pads": [ph, pw, ph, pw], "group": group}})
+            elif ltype == "innerproduct":
+                ip = layer.get("inner_product_param") or {}
+                if not blobs:
+                    raise OnnxLoaderError(
+                        f"InnerProduct '{name}' has no weights; load the "
+                        f".caffemodel")
+                w = blobs[0].reshape(int(ip.get("num_output")), -1)
+                initializers[f"{name}_w"] = w
+                node_inputs = [bottoms[0], f"{name}_w"]
+                if bool(ip.get("bias_term", True)) and len(blobs) > 1:
+                    initializers[f"{name}_b"] = blobs[1].reshape(-1)
+                    node_inputs.append(f"{name}_b")
+                # caffe IP flattens implicitly
+                nodes.append({"op_type": "Flatten", "name": f"{name}_flat",
+                              "input": [bottoms[0]],
+                              "output": [f"{name}_flat_out"],
+                              "attrs": {"axis": 1}})
+                node_inputs[0] = f"{name}_flat_out"
+                nodes.append({"op_type": "Gemm", "name": name,
+                              "input": node_inputs, "output": [tops[0]],
+                              "attrs": {"transB": 1}})
+            elif ltype == "pooling":
+                pp = layer.get("pooling_param") or {}
+                if pp.get("global_pooling"):
+                    op = ("GlobalAveragePool"
+                          if str(pp.get("pool", "MAX")).upper() == "AVE"
+                          else "GlobalMaxPool")
+                    nodes.append({"op_type": op, "name": name,
+                                  "input": [bottoms[0]], "output": [tops[0]],
+                                  "attrs": {}})
+                    continue
+                k = int(pp.get("kernel_size") or pp.get("kernel_h", 2))
+                s = int(pp.get("stride") or 1)
+                p = int(pp.get("pad") or 0)
+                shape_hw = self._shape_of.get(bottoms[0], (None, None))
+                pads = _pool_pads(shape_hw, (k, k), (s, s), (p, p))
+                op = ("AveragePool"
+                      if str(pp.get("pool", "MAX")).upper() == "AVE"
+                      else "MaxPool")
+                attrs = {"kernel_shape": [k, k], "strides": [s, s],
+                         "pads": list(pads)}
+                if op == "AveragePool":
+                    attrs["count_include_pad"] = 1  # caffe includes padding
+                nodes.append({"op_type": op, "name": name,
+                              "input": [bottoms[0]], "output": [tops[0]],
+                              "attrs": attrs})
+            elif ltype == "relu":
+                nodes.append({"op_type": "Relu", "name": name,
+                              "input": [bottoms[0]], "output": [tops[0]],
+                              "attrs": {}})
+            elif ltype == "sigmoid":
+                nodes.append({"op_type": "Sigmoid", "name": name,
+                              "input": [bottoms[0]], "output": [tops[0]],
+                              "attrs": {}})
+            elif ltype == "tanh":
+                nodes.append({"op_type": "Tanh", "name": name,
+                              "input": [bottoms[0]], "output": [tops[0]],
+                              "attrs": {}})
+            elif ltype == "softmax":
+                nodes.append({"op_type": "Softmax", "name": name,
+                              "input": [bottoms[0]], "output": [tops[0]],
+                              "attrs": {}})
+            elif ltype == "dropout":
+                ratio = (layer.get("dropout_param") or {}).get(
+                    "dropout_ratio", 0.5)
+                nodes.append({"op_type": "Dropout", "name": name,
+                              "input": [bottoms[0]], "output": [tops[0]],
+                              "attrs": {"ratio": float(ratio)}})
+            elif ltype == "concat":
+                axis = int((layer.get("concat_param") or {}).get("axis", 1))
+                nodes.append({"op_type": "Concat", "name": name,
+                              "input": bottoms, "output": [tops[0]],
+                              "attrs": {"axis": axis}})
+            elif ltype == "eltwise":
+                op_code = str((layer.get("eltwise_param") or {})
+                              .get("operation", "SUM")).upper()
+                op = {"SUM": "Sum", "PROD": "Mul", "MAX": "Max"}.get(op_code)
+                if op == "Max":
+                    raise OnnxLoaderError("Eltwise MAX not supported")
+                if op == "Mul" and len(bottoms) != 2:
+                    raise OnnxLoaderError("Eltwise PROD needs 2 bottoms")
+                nodes.append({"op_type": op, "name": name,
+                              "input": bottoms, "output": [tops[0]],
+                              "attrs": {}})
+            elif ltype == "batchnorm":
+                # caffe BN carries (mean, var, scale_factor); affine params
+                # come from the FOLLOWING Scale layer
+                if len(blobs) < 3:
+                    raise OnnxLoaderError(
+                        f"BatchNorm '{name}' missing statistics blobs")
+                factor = float(blobs[2].reshape(-1)[0]) or 1.0
+                pending_bn[tops[0]] = {
+                    "name": name, "bottom": bottoms[0],
+                    "mean": blobs[0].reshape(-1) / factor,
+                    "var": blobs[1].reshape(-1) / factor,
+                    "eps": float((layer.get("batch_norm_param") or {})
+                                 .get("eps", 1e-5))}
+            elif ltype == "scale":
+                bn = pending_bn.pop(bottoms[0], None)
+                if bn is None:
+                    raise OnnxLoaderError(
+                        f"standalone Scale '{name}' unsupported (expected "
+                        f"BatchNorm→Scale pair)")
+                if len(blobs) < 2:
+                    raise OnnxLoaderError(f"Scale '{name}' missing blobs")
+                base = bn["name"]
+                initializers[f"{base}_gamma"] = blobs[0].reshape(-1)
+                initializers[f"{base}_beta"] = blobs[1].reshape(-1)
+                initializers[f"{base}_mean"] = bn["mean"]
+                initializers[f"{base}_var"] = bn["var"]
+                nodes.append({
+                    "op_type": "BatchNormalization", "name": base,
+                    "input": [bn["bottom"], f"{base}_gamma", f"{base}_beta",
+                              f"{base}_mean", f"{base}_var"],
+                    "output": [tops[0]],
+                    "attrs": {"epsilon": bn["eps"]}})
+            elif ltype == "flatten":
+                nodes.append({"op_type": "Flatten", "name": name,
+                              "input": [bottoms[0]], "output": [tops[0]],
+                              "attrs": {"axis": 1}})
+            elif ltype in ("accuracy", "loss", "softmaxwithloss", "silence"):
+                continue  # train-only plumbing
+            else:
+                raise OnnxLoaderError(f"unsupported caffe layer type "
+                                      f"'{layer.get('type')}' ({name})")
+        return inputs, nodes, initializers
+
+    # shape tracking (H, W per top) for ceil-mode pooling pads
+    def _track_shapes(self, inputs, nodes):
+        shapes: Dict[str, Tuple[Optional[int], Optional[int]]] = {}
+        for name, shape in inputs:
+            if len(shape) == 4:
+                shapes[name] = (shape[2], shape[3])  # N,C,H,W
+        for node in nodes:
+            op = node["op_type"]
+            attrs = node["attrs"]
+            src = shapes.get(node["input"][0], (None, None))
+            if op == "Conv" or op in ("MaxPool", "AveragePool"):
+                kh, kw = attrs["kernel_shape"]
+                sh, sw = attrs["strides"]
+                h0, w0, h1, w1 = attrs["pads"]
+                h = ((src[0] + h0 + h1 - kh) // sh + 1) if src[0] else None
+                w = ((src[1] + w0 + w1 - kw) // sw + 1) if src[1] else None
+                shapes[node["output"][0]] = (h, w)
+            elif op in ("Relu", "Sigmoid", "Tanh", "Dropout",
+                        "BatchNormalization", "Sum", "Mul", "Concat"):
+                shapes[node["output"][0]] = src
+            else:
+                shapes[node["output"][0]] = (None, None)
+        return shapes
+
+
+def load_caffe(prototxt_path: str, caffemodel_path: Optional[str] = None,
+               input_shape: Optional[Tuple[int, int, int]] = None):
+    """Import Caffe ``prototxt`` (+ optional ``caffemodel`` weights).
+
+    Returns ``(model, params, state)`` like :func:`load_onnx`; inputs follow
+    the same NCHW→NHWC conversion (pass NHWC images at call time).
+    ``input_shape`` = (C, H, W) overrides/supplies the input declaration.
+    """
+    with open(prototxt_path) as f:
+        net = parse_prototxt(f.read())
+    weights = (load_caffemodel_weights(caffemodel_path)
+               if caffemodel_path else None)
+    builder = CaffeGraphBuilder(net, weights, input_shape)
+    # iterate shape-tracking to a fixpoint: each pass propagates correct
+    # spatial sizes one ceil-mode pooling deeper (stacked poolings would
+    # otherwise compute their extra end-padding from stale shapes)
+    builder._shape_of = {}
+    inputs, nodes, initializers = builder.build()
+    for _ in range(len(nodes) + 1):
+        shapes = builder._track_shapes(inputs, nodes)
+        if shapes == builder._shape_of:
+            break
+        builder._shape_of = shapes
+        inputs, nodes, initializers = builder.build()
+
+    # synthesize the ONNX-graph dict the existing builder consumes
+    def vi(name, shape):
+        dims = [{"dim_param": "N"} if d is None else {"dim_value": d}
+                for d in shape]
+        return {"name": name,
+                "type": {"tensor_type": {"elem_type": 1,
+                                         "shape": {"dim": dims}}}}
+
+    # a top is a network output when nothing AFTER its last producer reads
+    # it — a set difference alone breaks on Caffe's in-place idiom
+    # (top == bottom), where the final tensor appears in its own inputs
+    last_producer = {t: i for i, n in enumerate(nodes) for t in n["output"]}
+    graph_outputs = [
+        t for t, i in sorted(last_producer.items(), key=lambda kv: kv[1])
+        if not any(t in nodes[j]["input"]
+                   for j in range(i + 1, len(nodes)))]
+    graph = {
+        "node": [{"op_type": n["op_type"], "name": n["name"],
+                  "input": n["input"], "output": n["output"],
+                  "attribute": []} for n in nodes],
+        "initializer": [],
+        "input": [vi(name, shape) for name, shape in inputs],
+        "output": [vi(name, [None]) for name in graph_outputs],
+    }
+    attr_by_node = {id(g): n["attrs"] for g, n in zip(graph["node"], nodes)}
+    gb = _GraphBuilder(graph,
+                       attr_fn=lambda node: attr_by_node.get(id(node), {}))
+    # install decoded numpy initializers directly (no wire format involved)
+    for name, arr in initializers.items():
+        gb.values[name] = _Value(const=np.asarray(arr))
+    return gb.build()
+

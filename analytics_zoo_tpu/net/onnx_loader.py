@@ -76,9 +76,13 @@ def _permute_flat_kernel(kernel: np.ndarray,
 
 
 class _GraphBuilder:
-    def __init__(self, graph: Dict[str, Any], dtype=np.float32):
+    def __init__(self, graph: Dict[str, Any], dtype=np.float32,
+                 attr_fn=None):
         self.graph = graph
         self.dtype = dtype
+        # attribute decoder: wire-format by default; the caffe frontend
+        # injects already-decoded attr dicts instead
+        self.attr_fn = attr_fn if attr_fn is not None else wire.attributes
         self.values: Dict[str, _Value] = {}
         self.params: Dict[str, Any] = {}
         self.state: Dict[str, Any] = {}
@@ -147,7 +151,7 @@ class _GraphBuilder:
             if handler is None:
                 raise OnnxLoaderError(
                     f"unsupported ONNX op '{op}' (node {node.get('name') or i})")
-            handler(node, wire.attributes(node), _auto(node, op.lower(), i))
+            handler(node, self.attr_fn(node), _auto(node, op.lower(), i))
 
         outs = []
         for vi in self.graph.get("output", []):
@@ -436,6 +440,11 @@ class _GraphBuilder:
         # ONNX keeps (N,C,1,1); downstream Flatten/Reshape collapses it — our
         # layer goes straight to (N,C), so mark the output already-flat
         self._set_out(node, GlobalAveragePooling2D(name=name)(v.sym))
+
+    def op_globalmaxpool(self, node, attrs, name):
+        from ..keras.layers import GlobalMaxPooling2D
+        v = self.val(node["input"][0])
+        self._set_out(node, GlobalMaxPooling2D(name=name)(v.sym))
 
     def op_flatten(self, node, attrs, name):
         from ..keras.layers import Flatten

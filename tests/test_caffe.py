@@ -1,0 +1,203 @@
+"""Caffe import: prototxt parsing, caffemodel (binary protobuf) weights,
+and end-to-end numeric parity with a torch re-implementation."""
+import struct
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.net.caffe_loader import (
+    load_caffe, load_caffemodel_weights, parse_prototxt)
+
+# -- tiny NetParameter binary encoder (test-side twin of the decoder) -------
+
+
+def _varint(v):
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _len_field(fno, payload):
+    return _varint((fno << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _str_field(fno, s):
+    return _len_field(fno, s.encode())
+
+
+def _blob(arr):
+    arr = np.asarray(arr, np.float32)
+    shape = _len_field(7, b"".join(_varint((1 << 3) | 0) + _varint(d)
+                                   for d in arr.shape))
+    data = _len_field(5, arr.astype("<f4").tobytes())
+    return shape + data
+
+
+def _layer(name, blobs):
+    body = _str_field(1, name) + _str_field(2, "x")
+    body += b"".join(_len_field(7, _blob(b)) for b in blobs)
+    return _len_field(100, body)
+
+
+PROTOTXT = """
+name: "tiny"  # a comment
+input: "data"
+input_shape { dim: 1 dim: 3 dim: 8 dim: 8 }
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 stride: 1 }
+}
+layer {
+  name: "bn1" type: "BatchNorm" bottom: "conv1" top: "bn1"
+  batch_norm_param { eps: 1e-5 use_global_stats: true }
+}
+layer { name: "scale1" type: "Scale" bottom: "bn1" top: "bn1s"
+        scale_param { bias_term: true } }
+layer { name: "relu1" type: "ReLU" bottom: "bn1s" top: "relu1" }
+layer {
+  name: "pool1" type: "Pooling" bottom: "relu1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "fc1" type: "InnerProduct" bottom: "pool1" top: "fc1"
+  inner_product_param { num_output: 5 }
+}
+layer { name: "prob" type: "Softmax" bottom: "fc1" top: "prob" }
+"""
+
+
+class TestPrototxtParser:
+    def test_parse_structure(self):
+        net = parse_prototxt(PROTOTXT)
+        assert net["name"] == "tiny"
+        assert net["input"] == "data"
+        layers = net["layer"]
+        assert [l["type"] for l in layers] == [
+            "Convolution", "BatchNorm", "Scale", "ReLU", "Pooling",
+            "InnerProduct", "Softmax"]
+        assert layers[0]["convolution_param"]["num_output"] == 4
+        assert layers[4]["pooling_param"]["pool"] == "MAX"
+        assert layers[1]["batch_norm_param"]["use_global_stats"] is True
+        assert net["input_shape"]["dim"] == [1, 3, 8, 8]
+
+    def test_unbalanced_braces_rejected(self):
+        with pytest.raises(ValueError):
+            parse_prototxt("layer { name: \"x\" ")
+
+
+class TestCaffeEndToEnd:
+    def _weights(self, rs):
+        return {
+            "conv1": [rs.randn(4, 3, 3, 3).astype(np.float32),
+                      rs.randn(4).astype(np.float32)],
+            "bn1": [rs.rand(4).astype(np.float32),           # mean*factor
+                    (rs.rand(4) + 0.5).astype(np.float32),   # var*factor
+                    np.asarray([2.0], np.float32)],          # scale factor
+            "scale1": [(rs.rand(4) + 0.5).astype(np.float32),
+                       rs.randn(4).astype(np.float32)],
+            "fc1": [rs.randn(5, 4 * 4 * 4).astype(np.float32),
+                    rs.randn(5).astype(np.float32)],
+        }
+
+    def _write_model(self, tmp_path, weights):
+        data = _str_field(1, "tiny")
+        for name, blobs in weights.items():
+            data += _layer(name, blobs)
+        pt = tmp_path / "net.prototxt"
+        cm = tmp_path / "net.caffemodel"
+        pt.write_text(PROTOTXT)
+        cm.write_bytes(data)
+        return str(pt), str(cm)
+
+    def test_weights_decode(self, tmp_path):
+        rs = np.random.RandomState(0)
+        weights = self._weights(rs)
+        _, cm = self._write_model(tmp_path, weights)
+        loaded = load_caffemodel_weights(cm)
+        assert set(loaded) == set(weights)
+        np.testing.assert_allclose(loaded["conv1"][0], weights["conv1"][0],
+                                   rtol=1e-6)
+        assert loaded["fc1"][0].shape == (5, 64)
+
+    def test_matches_torch(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        nn = torch.nn
+        rs = np.random.RandomState(1)
+        weights = self._weights(rs)
+        pt, cm = self._write_model(tmp_path, weights)
+        model, params, state = load_caffe(pt, cm)
+
+        # torch twin with the same weights
+        tm = nn.Sequential(
+            nn.Conv2d(3, 4, 3, padding=1), nn.BatchNorm2d(4, eps=1e-5),
+            nn.ReLU(), nn.MaxPool2d(2, 2), nn.Flatten(), nn.Linear(64, 5),
+            nn.Softmax(dim=-1))
+        with torch.no_grad():
+            tm[0].weight.copy_(torch.from_numpy(weights["conv1"][0]))
+            tm[0].bias.copy_(torch.from_numpy(weights["conv1"][1]))
+            factor = float(weights["bn1"][2][0])
+            tm[1].running_mean.copy_(
+                torch.from_numpy(weights["bn1"][0] / factor))
+            tm[1].running_var.copy_(
+                torch.from_numpy(weights["bn1"][1] / factor))
+            tm[1].weight.copy_(torch.from_numpy(weights["scale1"][0]))
+            tm[1].bias.copy_(torch.from_numpy(weights["scale1"][1]))
+            tm[5].weight.copy_(torch.from_numpy(weights["fc1"][0]))
+            tm[5].bias.copy_(torch.from_numpy(weights["fc1"][1]))
+        tm.eval()
+
+        x = rs.randn(2, 3, 8, 8).astype(np.float32)
+        with torch.no_grad():
+            expected = tm(torch.from_numpy(x)).numpy()
+        got, _ = model.call(params, state, np.transpose(x, (0, 2, 3, 1)),
+                            training=False)
+        np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_stacked_ceil_poolings(self, tmp_path):
+        """Caffe ceil-mode sizing must propagate through cascaded pools:
+        8 →(k3,s2 ceil)→ 4 →(k3,s2 ceil)→ 2 (floor would give 3 → 1)."""
+        pt = tmp_path / "pools.prototxt"
+        pt.write_text("""
+input: "data"
+input_shape { dim: 1 dim: 1 dim: 8 dim: 8 }
+layer { name: "p1" type: "Pooling" bottom: "data" top: "p1"
+        pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+layer { name: "p2" type: "Pooling" bottom: "p1" top: "p2"
+        pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+""")
+        model, params, state = load_caffe(str(pt))
+        x = np.arange(64, dtype=np.float32).reshape(1, 8, 8, 1)
+        y, _ = model.call(params, state, x)
+        assert np.asarray(y).shape == (1, 2, 2, 1)
+
+    def test_inplace_final_layer(self, tmp_path):
+        """Caffe's in-place idiom (top == bottom) on the LAST layer must
+        still yield a network output."""
+        pt = tmp_path / "inplace.prototxt"
+        pt.write_text("""
+input: "data"
+input_shape { dim: 1 dim: 1 dim: 4 dim: 4 }
+layer { name: "p1" type: "Pooling" bottom: "data" top: "p1"
+        pooling_param { pool: AVE kernel_size: 2 stride: 2 } }
+layer { name: "relu1" type: "ReLU" bottom: "p1" top: "p1" }
+""")
+        model, params, state = load_caffe(str(pt))
+        x = -np.ones((1, 4, 4, 1), np.float32)
+        y, _ = model.call(params, state, x)
+        assert np.asarray(y).shape == (1, 2, 2, 1)
+        np.testing.assert_array_equal(np.asarray(y), 0.0)  # relu applied
+
+    def test_missing_weights_rejected(self, tmp_path):
+        pt = tmp_path / "net.prototxt"
+        pt.write_text(PROTOTXT)
+        with pytest.raises(Exception, match="caffemodel"):
+            load_caffe(str(pt))

@@ -50,8 +50,9 @@ class TestParallelSearch:
         par.compile(data=None, model_create_fn=None, recipe=_GridRecipe(),
                     metric="mse", fit_fn=_pid_trial)
         pids = {int(t.metric) for t in par.run()}
-        assert os.getpid() not in pids  # really ran elsewhere
-        assert len(pids) >= 2  # and on more than one worker
+        # really ran in worker processes (how many grab work is up to the
+        # pool's scheduling, so only the "not in-process" half is stable)
+        assert os.getpid() not in pids
 
     def test_unpicklable_trainable_rejected(self):
         par = ParallelSearchEngine(num_workers=2, seed=0)
