@@ -1,6 +1,8 @@
 from .image_set import DistributedImageSet, ImageSet, LocalImageSet  # noqa: F401
 from .transforms import (  # noqa: F401
     AspectScale, Brightness, CenterCrop, ChannelNormalize, ChannelOrder,
-    ColorJitter, Contrast, Expand, FixedCrop, Hue, ImageSetToSample,
-    MatToFloats, PixelBytesToMat, RandomCrop, RandomPreprocessing,
-    RandomTransformer, Resize, Saturation, HFlip)
+    ChannelScaledNormalizer, ColorJitter, Contrast, Expand, Filler,
+    FixedCrop, Grayscale, HFlip, Hue, ImageSetToSample, MatToFloats, Mirror,
+    PixelBytesToMat, PixelNormalizer, RandomAspectScale, RandomCrop,
+    RandomPreprocessing, RandomResize, RandomTransformer, Resize, Saturation,
+    VFlip)

@@ -267,3 +267,105 @@ class ImageSetToSample(ImageTransform):
 
     def apply(self, img):
         return np.ascontiguousarray(np.asarray(img, np.float32))
+
+
+class VFlip(ImageTransform):
+    """Vertical flip (reference ``ImageMirror``'s vertical mode)."""
+
+    def apply(self, img):
+        return np.ascontiguousarray(np.asarray(img)[::-1])
+
+
+Mirror = HFlip  # reference alias (``ImageMirror.scala``)
+
+
+class Filler(ImageTransform):
+    """Fill a normalized-coordinate sub-rectangle with a constant (reference
+    ``ImageFiller.scala`` — occlusion augmentation)."""
+
+    def __init__(self, start_x: float, start_y: float, end_x: float,
+                 end_y: float, value: float = 255.0):
+        if not (0 <= start_x <= end_x <= 1 and 0 <= start_y <= end_y <= 1):
+            raise ValueError("filler coords must satisfy "
+                             "0 <= start <= end <= 1")
+        self.box = (start_x, start_y, end_x, end_y)
+        self.value = value
+
+    def apply(self, img):
+        img = np.array(img, np.float32, copy=True)
+        h, w = img.shape[:2]
+        x0, y0, x1, y1 = self.box
+        img[int(y0 * h):int(y1 * h), int(x0 * w):int(x1 * w)] = self.value
+        return img
+
+
+class ChannelScaledNormalizer(ImageTransform):
+    """Per-channel mean subtract + single global scale (reference
+    ``ImageChannelScaledNormalizer.scala``)."""
+
+    def __init__(self, mean_r: float, mean_g: float, mean_b: float,
+                 scale: float = 1.0):
+        self.mean = np.asarray([mean_r, mean_g, mean_b], np.float32)
+        self.scale = scale
+
+    def apply(self, img):
+        return (np.asarray(img, np.float32) - self.mean) * self.scale
+
+
+class PixelNormalizer(ImageTransform):
+    """Subtract a full per-pixel mean image (reference
+    ``ImagePixelNormalizer.scala`` — e.g. the ImageNet mean image)."""
+
+    def __init__(self, means: np.ndarray):
+        self.means = np.asarray(means, np.float32)
+
+    def apply(self, img):
+        img = np.asarray(img, np.float32)
+        if img.shape != self.means.shape:
+            raise ValueError(f"mean image shape {self.means.shape} != image "
+                             f"shape {img.shape}")
+        return img - self.means
+
+
+class RandomResize(ImageTransform):
+    """Resize to a size drawn uniformly from [min, max] (reference
+    ``ImageRandomResize.scala``)."""
+
+    def __init__(self, min_size: int, max_size: int,
+                 seed: Optional[int] = None):
+        self.min_size, self.max_size = min_size, max_size
+        self._rng = random.Random(seed)
+
+    def apply(self, img):
+        size = self._rng.randint(self.min_size, self.max_size)
+        return Resize(size, size).apply(img)
+
+
+class RandomAspectScale(ImageTransform):
+    """Scale the short side to a randomly chosen length, capped by
+    ``max_size`` on the long side (reference ``RandomAspectScale``)."""
+
+    def __init__(self, scales: Sequence[int], max_size: int = 1000,
+                 seed: Optional[int] = None):
+        self.scales = list(scales)
+        self.max_size = max_size
+        self._rng = random.Random(seed)
+
+    def apply(self, img):
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        target = self._rng.choice(self.scales)
+        scale = target / min(h, w)
+        if round(scale * float(np.max((h, w)))) > self.max_size:
+            scale = self.max_size / float(np.max((h, w)))
+        return Resize(int(round(h * scale)),
+                      int(round(w * scale))).apply(img)
+
+
+class Grayscale(ImageTransform):
+    """RGB → single-channel luma, kept 3-channel for shape stability."""
+
+    def apply(self, img):
+        img = np.asarray(img, np.float32)
+        luma = img @ np.asarray([0.299, 0.587, 0.114], np.float32)
+        return np.repeat(luma[..., None], img.shape[-1], axis=-1)

@@ -1,0 +1,246 @@
+"""Autograd variable algebra (reference ``pipeline/api/autograd/math.scala:32-364``,
+``pyzoo/zoo/pipeline/api/autograd.py:256``): symbolic-tensor math for building
+model graphs and custom losses without writing Layer classes.
+
+Every function takes/returns :class:`~analytics_zoo_tpu.keras.engine.SymbolicTensor`
+and stamps a small functional layer into the graph; under jit the resulting
+ops fuse like any hand-written jax — the DSL costs nothing at run time.
+
+Also provides the reference's two autograd entry points beyond plain math:
+- :func:`Parameter` — a standalone trainable variable usable inside
+  expressions (``KerasParameter.scala:1``);
+- :class:`CustomLoss` — build a loss function from a symbolic expression of
+  ``(y_true, y_pred)`` (``CustomLoss.scala:29``).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import initializers
+from .engine import Input, Layer, Model, Node, SymbolicTensor
+from .layers.core import Lambda, merge
+
+Sym = SymbolicTensor
+
+
+def _unary(fn, name):
+    def op(x: Sym, **kw) -> Sym:
+        return Lambda(lambda t: fn(t, **kw), name=None)(x)
+    op.__name__ = name
+    return op
+
+
+def _pairwise(fn):
+    def op(a, b) -> Sym:
+        if isinstance(a, Sym) and isinstance(b, Sym):
+            return Lambda(lambda xs: fn(xs[0], xs[1]))([a, b])
+        if isinstance(a, Sym):
+            return Lambda(lambda t: fn(t, b))(a)
+        return Lambda(lambda t: fn(a, t))(b)
+    return op
+
+
+# -- elementwise unary (math.scala abs/exp/log/sqrt/square/...) -------------
+
+abs = _unary(jnp.abs, "abs")  # noqa: A001 - mirrors the reference API
+exp = _unary(jnp.exp, "exp")
+log = _unary(jnp.log, "log")
+sqrt = _unary(jnp.sqrt, "sqrt")
+square = _unary(jnp.square, "square")
+neg = _unary(jnp.negative, "neg")
+erf = _unary(jax.scipy.special.erf, "erf")
+relu = _unary(jax.nn.relu, "relu")
+softsign = _unary(jax.nn.soft_sign, "softsign")
+softplus = _unary(jax.nn.softplus, "softplus")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+tanh = _unary(jnp.tanh, "tanh")
+
+
+def epsilon() -> float:
+    """Fuzz factor (reference ``AutoGrad.epsilon``)."""
+    return 1e-7
+
+
+def clip(x: Sym, min_value: float, max_value: float) -> Sym:
+    return Lambda(lambda t: jnp.clip(t, min_value, max_value))(x)
+
+
+def pow(x: Sym, a: float) -> Sym:  # noqa: A001
+    return x ** a
+
+
+# -- reductions (axes follow the reference: 0 = first non-batch axis is 1) --
+
+
+def _reduce(fn):
+    def op(x: Sym, axis: int = 0, keepdims: bool = False) -> Sym:
+        # reference semantics: axis counts INCLUDE the batch dim (axis 0 =
+        # batch); most uses pass axis >= 1
+        return Lambda(lambda t: fn(t, axis=axis, keepdims=keepdims))(x)
+    return op
+
+
+mean = _reduce(jnp.mean)
+sum = _reduce(jnp.sum)  # noqa: A001
+max = _reduce(jnp.max)  # noqa: A001
+min = _reduce(jnp.min)  # noqa: A001
+
+
+def maximum(a, b) -> Sym:
+    return _pairwise(jnp.maximum)(a, b)
+
+
+def minimum(a, b) -> Sym:
+    return _pairwise(jnp.minimum)(a, b)
+
+
+# -- shape ops ---------------------------------------------------------------
+
+
+def expand_dims(x: Sym, axis: int) -> Sym:
+    return Lambda(lambda t: jnp.expand_dims(t, axis))(x)
+
+
+def squeeze(x: Sym, axis: int) -> Sym:
+    return Lambda(lambda t: jnp.squeeze(t, axis))(x)
+
+
+def reshape(x: Sym, shape: Sequence[int]) -> Sym:
+    """``shape`` excludes the batch dim (Keras convention)."""
+    return Lambda(lambda t: jnp.reshape(t, (t.shape[0],) + tuple(shape)))(x)
+
+
+def transpose(x: Sym, perm: Sequence[int]) -> Sym:
+    """``perm`` over non-batch axes, 1-based like keras Permute."""
+    return Lambda(lambda t: jnp.transpose(t, (0,) + tuple(perm)))(x)
+
+
+def stack(inputs: Sequence[Sym], axis: int = 1) -> Sym:
+    return Lambda(lambda xs: jnp.stack(xs, axis=axis))(list(inputs))
+
+
+def concat(inputs: Sequence[Sym], axis: int = -1) -> Sym:
+    return merge(list(inputs), mode="concat", concat_axis=axis)
+
+
+def index_select(x: Sym, dim: int, index: int) -> Sym:
+    """Select one slice along ``dim`` (reference ``indexSelect``)."""
+    return Lambda(lambda t: jnp.take(t, index, axis=dim))(x)
+
+
+def slice(x: Sym, dim: int, start: int, length: int) -> Sym:  # noqa: A001
+    return Lambda(lambda t: jax.lax.slice_in_dim(t, start, start + length,
+                                                 axis=dim))(x)
+
+
+# -- contractions ------------------------------------------------------------
+
+
+def mm(a: Sym, b: Sym, axes: Optional[Sequence[int]] = None) -> Sym:
+    """Batched matmul contracting ``axes`` (reference ``AutoGrad.mm``)."""
+    if axes is None:
+        return Lambda(lambda xs: jnp.matmul(xs[0], xs[1]))([a, b])
+
+    def dot(xs):
+        x, y = xs
+        return jax.lax.dot_general(
+            x, y, (((axes[0],), (axes[1],)), ((0,), (0,))))
+    return Lambda(dot)([a, b])
+
+
+batch_dot = mm
+
+
+def dot(a: Sym, b: Sym, axes: Sequence[int] = (1, 1)) -> Sym:
+    return mm(a, b, axes=axes)
+
+
+def l2_normalize(x: Sym, axis: int = -1) -> Sym:
+    return Lambda(lambda t: t / jnp.maximum(
+        jnp.linalg.norm(t, axis=axis, keepdims=True), epsilon()))(x)
+
+
+def softmax(x: Sym, axis: int = -1) -> Sym:
+    return Lambda(lambda t: jax.nn.softmax(t, axis=axis))(x)
+
+
+# -- trainable Parameter (KerasParameter.scala role) ------------------------
+
+
+class _ParameterLayer(Layer):
+    """A no-input node whose output IS its trainable weight."""
+
+    def __init__(self, shape: Sequence[int], init="glorot_uniform",
+                 trainable: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.shape = tuple(shape)
+        self.init = initializers.get(init)
+        self.trainable = trainable
+
+    def build(self, rng, input_shape):
+        return {"weight": self.init(rng, self.shape)}, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        w = params["weight"]
+        if not self.trainable:
+            w = jax.lax.stop_gradient(w)
+        return w, state
+
+    def compute_output_shape(self, input_shape):
+        return self.shape
+
+
+def Parameter(shape: Sequence[int], init="glorot_uniform",
+              trainable: bool = True, name: Optional[str] = None) -> Sym:
+    """A standalone trainable variable usable in autograd expressions.
+
+    Note the returned tensor has NO batch axis — broadcast it against
+    batch-shaped tensors with normal numpy semantics."""
+    layer = _ParameterLayer(shape, init=init, trainable=trainable, name=name)
+    node = Node(layer, [])
+    return SymbolicTensor(layer.shape, node, 0)
+
+
+# -- CustomLoss (CustomLoss.scala:29) ---------------------------------------
+
+
+class CustomLoss:
+    """Build a loss from a symbolic expression.
+
+    ``loss_expr(y_true, y_pred)`` receives two symbolic tensors and returns a
+    symbolic per-record (or scalar) loss; the result is mean-reduced. The
+    instance is directly usable as an Estimator/compile ``loss``.
+
+    Example::
+
+        def huber(y_true, y_pred):
+            err = abs(y_true - y_pred)
+            return mean(minimum(0.5 * err * err, err - 0.5), axis=1)
+        model.compile(optimizer="adam", loss=CustomLoss(huber, [1]))
+    """
+
+    def __init__(self, loss_expr, y_pred_shape: Sequence[int],
+                 y_true_shape: Optional[Sequence[int]] = None):
+        yt = Input(shape=tuple(y_true_shape or y_pred_shape),
+                   name="customloss_y_true")
+        yp = Input(shape=tuple(y_pred_shape), name="customloss_y_pred")
+        out = loss_expr(yt, yp)
+        self._model = Model([yt, yp], out)
+        self._params, self._state = self._model.build(jax.random.PRNGKey(7))
+        if jax.tree_util.tree_leaves(self._params):
+            raise ValueError(
+                "CustomLoss expressions must be parameter-free (use model "
+                "layers + a regular objective for trainable pieces)")
+
+    def __call__(self, y_true, y_pred):
+        y_true = jnp.asarray(y_true)
+        y_pred = jnp.asarray(y_pred)
+        if y_true.ndim == y_pred.ndim - 1:  # sparse labels convenience
+            y_true = y_true[..., None]
+        out, _ = self._model.call(self._params, self._state,
+                                  [y_true, y_pred], training=True)
+        return jnp.mean(out)

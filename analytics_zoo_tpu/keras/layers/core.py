@@ -171,7 +171,11 @@ class ElementwiseOp(Layer):
 
     def __init__(self, fn: Callable, symbol: str, scalar=None, binary=False,
                  name: Optional[str] = None):
-        super().__init__(name or f"{symbol}_{id(fn) % 10000}")
+        # auto-named: two `x * y` ops must get DISTINCT names (an id(fn)-based
+        # scheme collides for every use of the same ufunc); these layers are
+        # parameter-free so positional renaming costs nothing
+        super().__init__(name)
+        self.symbol = symbol
         self.fn = fn
         self.scalar = scalar
         self.binary = binary

@@ -190,6 +190,9 @@ class CaffeGraphBuilder:
         self.net = net
         self.weights = weights or {}
         self.input_shape = input_shape  # (C, H, W) override
+        # per-top (H, W); refined by load_caffe's fixpoint loop so ceil-mode
+        # pooling pads see real sizes
+        self._shape_of: Dict[str, Tuple[Optional[int], Optional[int]]] = {}
 
     def _layers(self) -> List[Dict[str, Any]]:
         return _as_list(self.net.get("layer") or self.net.get("layers"))
@@ -250,7 +253,6 @@ class CaffeGraphBuilder:
                 group = int(cp.get("group") or 1)
                 bias = bool(cp.get("bias_term", True))
                 if not blobs:
-                    cin = None  # random init happens in the engine later
                     raise OnnxLoaderError(
                         f"conv layer '{name}' has no weights; load the "
                         f".caffemodel alongside the .prototxt")
@@ -296,21 +298,42 @@ class CaffeGraphBuilder:
                                   "input": [bottoms[0]], "output": [tops[0]],
                                   "attrs": {}})
                     continue
-                k = int(pp.get("kernel_size") or pp.get("kernel_h", 2))
-                s = int(pp.get("stride") or 1)
-                p = int(pp.get("pad") or 0)
+                kh = int(pp.get("kernel_h") or pp.get("kernel_size") or 2)
+                kw = int(pp.get("kernel_w") or pp.get("kernel_size") or kh)
+                sh = int(pp.get("stride_h") or pp.get("stride") or 1)
+                sw = int(pp.get("stride_w") or pp.get("stride") or sh)
+                ph = int(pp.get("pad_h") or pp.get("pad") or 0)
+                pw = int(pp.get("pad_w") or pp.get("pad") or 0)
                 shape_hw = self._shape_of.get(bottoms[0], (None, None))
-                pads = _pool_pads(shape_hw, (k, k), (s, s), (p, p))
-                op = ("AveragePool"
-                      if str(pp.get("pool", "MAX")).upper() == "AVE"
-                      else "MaxPool")
-                attrs = {"kernel_shape": [k, k], "strides": [s, s],
-                         "pads": list(pads)}
-                if op == "AveragePool":
-                    attrs["count_include_pad"] = 1  # caffe includes padding
-                nodes.append({"op_type": op, "name": name,
-                              "input": [bottoms[0]], "output": [tops[0]],
-                              "attrs": attrs})
+                h0, w0, h1, w1 = _pool_pads(shape_hw, (kh, kw), (sh, sw),
+                                            (ph, pw))
+                eh, ew = h1 - ph, w1 - pw  # synthetic ceil-mode end extras
+                is_ave = str(pp.get("pool", "MAX")).upper() == "AVE"
+                if is_ave:
+                    # caffe AVE divides by the window CLIPPED at size+pad:
+                    # bake the declared pad into the data (zeros) and let the
+                    # excl-pad average see only the synthetic ceil extras
+                    src = bottoms[0]
+                    if ph or pw:
+                        nodes.append({
+                            "op_type": "Pad", "name": f"{name}_pad",
+                            "input": [src], "output": [f"{name}_pad_out"],
+                            "attrs": {"pads": [0, 0, ph, pw, 0, 0, ph, pw]}})
+                        src = f"{name}_pad_out"
+                    nodes.append({
+                        "op_type": "AveragePool", "name": name,
+                        "input": [src], "output": [tops[0]],
+                        "attrs": {"kernel_shape": [kh, kw],
+                                  "strides": [sh, sw],
+                                  "pads": [0, 0, max(0, eh), max(0, ew)],
+                                  "count_include_pad": 0}})
+                else:
+                    nodes.append({
+                        "op_type": "MaxPool", "name": name,
+                        "input": [bottoms[0]], "output": [tops[0]],
+                        "attrs": {"kernel_shape": [kh, kw],
+                                  "strides": [sh, sw],
+                                  "pads": [h0, w0, h1, w1]}})
             elif ltype == "relu":
                 nodes.append({"op_type": "Relu", "name": name,
                               "input": [bottoms[0]], "output": [tops[0]],
@@ -390,6 +413,12 @@ class CaffeGraphBuilder:
             else:
                 raise OnnxLoaderError(f"unsupported caffe layer type "
                                       f"'{layer.get('type')}' ({name})")
+        if pending_bn:
+            names = [bn["name"] for bn in pending_bn.values()]
+            raise OnnxLoaderError(
+                f"BatchNorm layer(s) {names} have no following Scale layer; "
+                f"affine-free BN import is unsupported — silently skipping "
+                f"normalization would corrupt the model")
         return inputs, nodes, initializers
 
     # shape tracking (H, W per top) for ceil-mode pooling pads
@@ -408,6 +437,11 @@ class CaffeGraphBuilder:
                 h0, w0, h1, w1 = attrs["pads"]
                 h = ((src[0] + h0 + h1 - kh) // sh + 1) if src[0] else None
                 w = ((src[1] + w0 + w1 - kw) // sw + 1) if src[1] else None
+                shapes[node["output"][0]] = (h, w)
+            elif op == "Pad":
+                p = attrs["pads"]  # NCHW begins+ends
+                h = (src[0] + p[2] + p[6]) if src[0] else None
+                w = (src[1] + p[3] + p[7]) if src[1] else None
                 shapes[node["output"][0]] = (h, w)
             elif op in ("Relu", "Sigmoid", "Tanh", "Dropout",
                         "BatchNormalization", "Sum", "Mul", "Concat"):

@@ -320,6 +320,34 @@ class _GraphBuilder:
         out = Lambda(lambda x: jnp.clip(x, lo, hi), name=name)(v.sym)
         self._set_out(node, out, layout=v.layout, nhwc_shape=v.nhwc_shape)
 
+    def op_pad(self, node, attrs, name):
+        from ..keras.layers import Lambda
+        import jax.numpy as jnp
+        v = self.val(node["input"][0])
+        pads = attrs.get("pads")
+        if pads is None and len(node["input"]) > 1:
+            pads = [int(x) for x in self.const(node["input"][1]).reshape(-1)]
+        if pads is None:
+            raise OnnxLoaderError("Pad without pads")
+        mode = attrs.get("mode") or "constant"
+        if isinstance(mode, bytes):
+            mode = mode.decode()
+        if mode != "constant":
+            raise OnnxLoaderError(f"Pad mode {mode!r} unsupported")
+        value = float(attrs.get("value") or 0.0)
+        ndim = len(pads) // 2
+        begins, ends = pads[:ndim], pads[ndim:]
+        if v.layout == "nhwc" and ndim == 4:
+            # pads arrive in NCHW axis order; the tensor is NHWC now
+            n, c, h, w = range(4)
+            spec = ((begins[n], ends[n]), (begins[h], ends[h]),
+                    (begins[w], ends[w]), (begins[c], ends[c]))
+        else:
+            spec = tuple(zip(begins, ends))
+        out = Lambda(lambda x: jnp.pad(x, spec, constant_values=value),
+                     name=name)(v.sym)
+        self._set_out(node, out, layout=v.layout)
+
     def op_constant(self, node, attrs, name):
         t = attrs.get("value")
         if t is None:

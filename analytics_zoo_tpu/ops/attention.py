@@ -71,10 +71,17 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         causal: bool = False,
                         scale: Optional[float] = None,
                         q_block: int = DEFAULT_Q_BLOCK,
-                        kv_block: int = DEFAULT_KV_BLOCK) -> jax.Array:
+                        kv_block: int = DEFAULT_KV_BLOCK,
+                        dropout_rate: float = 0.0,
+                        dropout_rng: Optional[jax.Array] = None) -> jax.Array:
     """Streaming-softmax attention over KV chunks; O(seq) memory.
 
     ``bias`` broadcasts against ``[batch, heads, q_len, kv_len]``.
+    Attention-probability dropout is applied per KV block (the mask derives
+    from ``fold_in(rng, block_index)``, so the full [q, kv] probability
+    matrix never materializes); the streaming denominator accumulates the
+    UNDROPPED weights, making the result exactly standard post-softmax
+    dropout.
     """
     b, h, q_len, d = q.shape
     kv_len = k.shape[-2]
@@ -89,6 +96,7 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     q = q.reshape(b, h, n_q, bq, d)
     k_chunks = k.reshape(b, h, n_kv, bk, d).transpose(2, 0, 1, 3, 4)
     v_chunks = v.reshape(b, h, n_kv, bk, d).transpose(2, 0, 1, 3, 4)
+    dropping = dropout_rate > 0.0 and dropout_rng is not None
 
     def one_q_chunk(args):
         qc, qi = args  # qc: [b, h, bq, d]
@@ -109,7 +117,15 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
             p = jnp.exp(s - m_new)
             corr = jnp.exp(m - m_new)
+            # the softmax DENOMINATOR accumulates the undropped weights, so
+            # the result equals standard post-softmax dropout exactly:
+            # (Σ dropped_p·v) / (Σ p) = Σ dropout(softmax(s))·v
             l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+            if dropping:
+                block_rng = jax.random.fold_in(dropout_rng, qi * n_kv + ki)
+                keep = jax.random.bernoulli(block_rng, 1.0 - dropout_rate,
+                                            p.shape)
+                p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
             acc_new = acc * corr + jnp.einsum(
                 "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32),
                 preferred_element_type=jnp.float32)
@@ -132,9 +148,15 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 # ---------------------------------------------------------------------------
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                      scale: float, causal: bool, bq: int, bk: int):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale: float, causal: bool,
+                      bq: int, bk: int, has_bias: bool):
     from jax.experimental import pallas as pl
+
+    if has_bias:
+        bias_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        bias_ref = None
+        o_ref, acc_ref, m_ref, l_ref = rest
 
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -158,6 +180,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if bias_ref is not None:
+            # per-key additive bias (padding mask), broadcast over query rows
+            s = s + bias_ref[0].astype(jnp.float32)  # [1, bk] broadcasts
         if causal:
             rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -178,8 +203,23 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
                     jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
 
 
+def _keybias_block(kv_len: int, kv_block: int) -> Optional[int]:
+    """KV block size usable for the bias operand: its (1, bk) VMEM tile must
+    have bk divisible by 128 or equal to kv_len (TPU lane tiling). Returns
+    None when no such block exists within reasonable VMEM."""
+    for c in range(min(kv_len, kv_block), 127, -128):
+        if kv_len % c == 0 and c % 128 == 0:
+            return c
+    if kv_len <= 4096:
+        return kv_len  # single block: tiny bias row, k/v tiles still fit
+    return None
+
+
 def _flash_fwd_pallas(q, k, v, scale: float, causal: bool,
-                      q_block: int, kv_block: int):
+                      q_block: int, kv_block: int,
+                      key_bias: Optional[jax.Array] = None):
+    """``key_bias``: optional [batch, kv_len] additive per-key bias (the
+    padding-mask form) applied inside the kernel."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -187,6 +227,12 @@ def _flash_fwd_pallas(q, k, v, scale: float, causal: bool,
     kv_len = k.shape[-2]
     bq = _largest_divisor_leq(q_len, q_block)
     bk = _largest_divisor_leq(kv_len, kv_block)
+    if key_bias is not None:
+        bk = _keybias_block(kv_len, kv_block)
+        assert bk is not None  # dispatch checks before routing here
+        # bias rides as [b, 1, kv_len] so its block's trailing dims obey the
+        # (8, 128) tiling rules with a unit sublane
+        key_bias = key_bias.reshape(b, 1, kv_len)
     bh = b * h
     qf = q.reshape(bh, q_len, d)
     kf = k.reshape(bh, kv_len, d)
@@ -194,19 +240,26 @@ def _flash_fwd_pallas(q, k, v, scale: float, causal: bool,
 
     grid = (bh, q_len // bq, kv_len // bk)
     kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk)
+                               bq=bq, bk=bk, has_bias=key_bias is not None)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda a, i, j: (a, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d), lambda a, i, j: (a, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d), lambda a, i, j: (a, j, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [qf, kf, vf]
+    if key_bias is not None:
+        in_specs.append(
+            pl.BlockSpec((1, 1, bk), lambda a, i, j, h=h: (a // h, 0, j),
+                         memory_space=pltpu.VMEM))
+        operands.append(key_bias)
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((bh, q_len, d), q.dtype),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda a, i, j: (a, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda a, i, j: (a, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda a, i, j: (a, j, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda a, i, j: (a, i, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
@@ -214,7 +267,7 @@ def _flash_fwd_pallas(q, k, v, scale: float, causal: bool,
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
-    )(qf, kf, vf)
+    )(*operands)
     return out.reshape(b, h, q_len, d)
 
 
@@ -248,6 +301,34 @@ def _flash_bwd(scale, causal, q_block, kv_block, residuals, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_keybias(q, k, v, key_bias, scale, causal, q_block, kv_block):
+    if _on_tpu():
+        return _flash_fwd_pallas(q, k, v, scale, causal, q_block, kv_block,
+                                 key_bias=key_bias)
+    return blockwise_attention(q, k, v, key_bias[:, None, None, :], causal,
+                               scale, q_block, kv_block)
+
+
+def _flash_keybias_fwd(q, k, v, key_bias, scale, causal, q_block, kv_block):
+    return (_flash_keybias(q, k, v, key_bias, scale, causal, q_block,
+                           kv_block), (q, k, v, key_bias))
+
+
+def _flash_keybias_bwd(scale, causal, q_block, kv_block, residuals, g):
+    q, k, v, key_bias = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, key_bias[:, None, None, :], causal, scale,
+            q_block, kv_block), q, k, v)
+    dq, dk, dv = vjp(g)
+    # the bias is a padding mask, not a trained quantity
+    return dq, dk, dv, jnp.zeros_like(key_bias)
+
+
+_flash_keybias.defvjp(_flash_keybias_fwd, _flash_keybias_bwd)
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     bias: Optional[jax.Array] = None,
                     causal: bool = False,
@@ -256,11 +337,19 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     kv_block: int = DEFAULT_KV_BLOCK) -> jax.Array:
     """Fused attention: pallas kernel on TPU, blockwise XLA elsewhere.
 
-    With a ``bias`` (additive mask) the blockwise path is used — the pallas
-    kernel covers the unbiased/causal hot path.
+    A per-key padding bias in the UNAMBIGUOUS ``[b, 1, 1, kv]`` form (what
+    the mask layers build) runs inside the pallas kernel; any other bias
+    shape (including 2-D, which has always meant a broadcast ``[q, kv]``
+    matrix) falls back to the blockwise path.
     """
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if bias is not None:
+        kv_len = k.shape[-2]
+        if bias.ndim == 4 and bias.shape[1] == 1 and bias.shape[2] == 1 \
+                and bias.shape[0] == q.shape[0] and bias.shape[3] == kv_len \
+                and _keybias_block(kv_len, kv_block) is not None:
+            return _flash_keybias(q, k, v, bias[:, 0, 0, :], scale, causal,
+                                  q_block, kv_block)
         return blockwise_attention(q, k, v, bias, causal, scale,
                                    q_block, kv_block)
     return _flash(q, k, v, scale, causal, q_block, kv_block)

@@ -199,3 +199,54 @@ class TestTransformerLayers:
         g = jax.grad(loss)(params)
         assert g["word_emb"].shape == (30, 8)
         assert float(jnp.abs(g["block_0"]["attn"]["q"]["kernel"]).sum()) > 0
+
+
+class TestBlockwiseDropout:
+    def test_zero_rate_matches_vanilla(self):
+        import jax
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.ops.attention import (
+            blockwise_attention, dot_product_attention)
+        rs = np.random.RandomState(0)
+        q, k, v = [jnp.asarray(rs.randn(2, 2, 16, 8), jnp.float32)
+                   for _ in range(3)]
+        out = blockwise_attention(q, k, v, dropout_rate=0.0,
+                                  dropout_rng=jax.random.PRNGKey(0),
+                                  q_block=8, kv_block=8)
+        ref = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_dropout_is_unbiased_post_softmax(self):
+        """Streaming per-block dropout must equal standard post-softmax
+        dropout in expectation: averaging over many rngs converges to the
+        undropped output (the denominator uses undropped weights)."""
+        import jax
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.ops.attention import (
+            blockwise_attention, dot_product_attention)
+        rs = np.random.RandomState(1)
+        q, k, v = [jnp.asarray(rs.randn(1, 1, 8, 4), jnp.float32)
+                   for _ in range(3)]
+        ref = np.asarray(dot_product_attention(q, k, v))
+        sample = jax.jit(lambda key: blockwise_attention(
+            q, k, v, dropout_rate=0.3, dropout_rng=key,
+            q_block=4, kv_block=4))
+        n = 300
+        acc = np.zeros_like(ref)
+        for i in range(n):
+            acc += np.asarray(sample(jax.random.PRNGKey(i)))
+        np.testing.assert_allclose(acc / n, ref, atol=0.08)
+
+    def test_dropout_actually_drops(self):
+        import jax
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.ops.attention import blockwise_attention
+        rs = np.random.RandomState(2)
+        q, k, v = [jnp.asarray(rs.randn(1, 1, 8, 4), jnp.float32)
+                   for _ in range(3)]
+        a = blockwise_attention(q, k, v, dropout_rate=0.5,
+                                dropout_rng=jax.random.PRNGKey(0))
+        b = blockwise_attention(q, k, v, dropout_rate=0.5,
+                                dropout_rng=jax.random.PRNGKey(1))
+        assert np.abs(np.asarray(a) - np.asarray(b)).max() > 1e-3

@@ -1,0 +1,169 @@
+"""Autograd variable algebra: symbolic math ops, Parameter variables,
+CustomLoss expressions (reference autograd/math.scala + CustomLoss.scala)."""
+import jax
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.keras import Input, Model, Sequential, autograd as A
+from analytics_zoo_tpu.keras.layers import Dense
+
+
+def _run(expr_builder, *input_shapes):
+    """Build Model(inputs → expr), run on random data, return (out, arrays)."""
+    rs = np.random.RandomState(0)
+    syms = [Input(shape=s) for s in input_shapes]
+    out_sym = expr_builder(*syms)
+    model = Model(syms if len(syms) > 1 else syms[0], out_sym)
+    params, state = model.build(jax.random.PRNGKey(0))
+    arrays = [rs.randn(3, *s).astype(np.float32) for s in input_shapes]
+    out, _ = model.call(params, state,
+                        arrays if len(arrays) > 1 else arrays[0])
+    return np.asarray(out), arrays
+
+
+class TestOps:
+    def test_unary_suite(self):
+        for fn, ref in [(A.abs, np.abs), (A.exp, np.exp),
+                        (A.square, np.square), (A.neg, lambda v: -v),
+                        (A.tanh, np.tanh), (A.relu, lambda v: np.maximum(v, 0))]:
+            out, (x,) = _run(fn, (4,))
+            np.testing.assert_allclose(out, ref(x), rtol=1e-5, atol=1e-6)
+
+    def test_sqrt_log_on_positive(self):
+        out, (x,) = _run(lambda s: A.sqrt(A.abs(s) + 1.0), (4,))
+        np.testing.assert_allclose(out, np.sqrt(np.abs(x) + 1), rtol=1e-5)
+
+    def test_clip(self):
+        out, (x,) = _run(lambda s: A.clip(s, -0.5, 0.5), (6,))
+        np.testing.assert_allclose(out, np.clip(x, -0.5, 0.5))
+
+    def test_reductions(self):
+        out, (x,) = _run(lambda s: A.mean(s, axis=1), (5,))
+        np.testing.assert_allclose(out, x.mean(axis=1), rtol=1e-6)
+        out, (x,) = _run(lambda s: A.sum(s, axis=1, keepdims=True), (5,))
+        np.testing.assert_allclose(out, x.sum(axis=1, keepdims=True),
+                                   rtol=1e-5)
+
+    def test_binary_and_pairwise(self):
+        out, (a, b) = _run(lambda x, y: A.maximum(x, y), (4,), (4,))
+        np.testing.assert_allclose(out, np.maximum(a, b))
+        out, (a, b) = _run(lambda x, y: x * y + 2.0, (4,), (4,))
+        np.testing.assert_allclose(out, a * b + 2, rtol=1e-6)
+
+    def test_shape_ops(self):
+        out, (x,) = _run(lambda s: A.expand_dims(s, 1), (4,))
+        assert out.shape == (3, 1, 4)
+        out, (x,) = _run(lambda s: A.reshape(s, [2, 3]), (6,))
+        np.testing.assert_allclose(out, x.reshape(3, 2, 3))
+        out, (x,) = _run(lambda s: A.transpose(s, [2, 1]), (2, 5))
+        np.testing.assert_allclose(out, np.transpose(x, (0, 2, 1)))
+
+    def test_stack_concat_select(self):
+        out, (a, b) = _run(lambda x, y: A.stack([x, y], axis=1), (4,), (4,))
+        assert out.shape == (3, 2, 4)
+        out, (a, b) = _run(lambda x, y: A.concat([x, y], axis=-1), (4,), (2,))
+        assert out.shape == (3, 6)
+        out, (x,) = _run(lambda s: A.index_select(s, 1, 2), (4,))
+        np.testing.assert_allclose(out, x[:, 2])
+        out, (x,) = _run(lambda s: A.slice(s, 1, 1, 2), (5,))
+        np.testing.assert_allclose(out, x[:, 1:3])
+
+    def test_mm_and_l2_normalize(self):
+        out, (a, b) = _run(lambda x, y: A.mm(x, y), (2, 3), (3, 4))
+        np.testing.assert_allclose(out, a @ b, rtol=1e-5, atol=1e-5)
+        out, (x,) = _run(lambda s: A.l2_normalize(s, axis=-1), (4,))
+        np.testing.assert_allclose(np.linalg.norm(out, axis=-1),
+                                   np.ones(3), rtol=1e-5)
+
+
+class TestParameter:
+    def test_parameter_trains(self):
+        """y = w*x with w a bare Parameter: fitting recovers the slope."""
+        x = Input(shape=(1,))
+        w = A.Parameter([1], init="ones", name="slope")
+        model = Model(x, x * w)
+        model.compile(optimizer="sgd", loss="mse")
+        rs = np.random.RandomState(0)
+        xs = rs.randn(64, 1).astype(np.float32)
+        ys = 3.0 * xs
+        model.fit(xs, ys, batch_size=16, nb_epoch=40)
+        west = float(np.asarray(model.get_weights()["slope"]["weight"])[0])
+        assert west == pytest.approx(3.0, abs=0.2)
+
+    def test_non_trainable_parameter_frozen(self):
+        x = Input(shape=(1,))
+        w = A.Parameter([1], init="ones", trainable=False, name="fixed")
+        model = Model(x, x * w)
+        model.compile(optimizer="sgd", loss="mse")
+        xs = np.ones((16, 1), np.float32)
+        model.fit(xs, 5 * xs, batch_size=16, nb_epoch=3)
+        assert float(np.asarray(
+            model.get_weights()["fixed"]["weight"])[0]) == 1.0
+
+
+class TestCustomLoss:
+    def test_custom_mae_matches_builtin(self):
+        def mae(y_true, y_pred):
+            return A.mean(A.abs(y_true - y_pred), axis=1)
+
+        loss = A.CustomLoss(mae, [2])
+        rs = np.random.RandomState(1)
+        yt = rs.randn(8, 2).astype(np.float32)
+        yp = rs.randn(8, 2).astype(np.float32)
+        got = float(loss(yt, yp))
+        assert got == pytest.approx(float(np.mean(np.abs(yt - yp))), rel=1e-5)
+
+    def test_custom_loss_trains_model(self):
+        def huber(y_true, y_pred):
+            err = A.abs(y_true - y_pred)
+            return A.mean(A.minimum(0.5 * err * err, err - 0.5), axis=1)
+
+        model = Sequential([Dense(1, name="d")])
+        model.compile(optimizer="adam", loss=A.CustomLoss(huber, [1]))
+        rs = np.random.RandomState(2)
+        xs = rs.randn(64, 3).astype(np.float32)
+        ys = (xs @ np.asarray([[1.0], [-2.0], [0.5]], np.float32))
+        r = model.fit(xs, ys, batch_size=16, nb_epoch=5)
+        assert r["loss_history"][-1] < r["loss_history"][0]
+
+    def test_parameterized_expression_rejected(self):
+        with pytest.raises(ValueError, match="parameter-free"):
+            A.CustomLoss(lambda yt, yp: Dense(1)(yp - yt), [2])
+
+
+class TestNewImageTransforms:
+    def test_filler_and_vflip(self):
+        from analytics_zoo_tpu.feature.image import Filler, VFlip
+        img = np.zeros((4, 4, 3), np.float32)
+        out = Filler(0.5, 0.0, 1.0, 0.5, value=9).apply(img)
+        assert out[0, 3, 0] == 9 and out[3, 0, 0] == 0
+        np.testing.assert_array_equal(VFlip().apply(out), out[::-1])
+
+    def test_channel_scaled_and_pixel_normalizer(self):
+        from analytics_zoo_tpu.feature.image import (
+            ChannelScaledNormalizer, PixelNormalizer)
+        img = np.full((2, 2, 3), 10.0, np.float32)
+        out = ChannelScaledNormalizer(1, 2, 3, scale=0.5).apply(img)
+        np.testing.assert_allclose(out[0, 0], [4.5, 4.0, 3.5])
+        means = np.ones((2, 2, 3), np.float32)
+        np.testing.assert_allclose(PixelNormalizer(means).apply(img),
+                                   img - 1)
+
+    def test_random_resize_and_aspect_scale(self):
+        from analytics_zoo_tpu.feature.image import (
+            RandomAspectScale, RandomResize)
+        img = np.zeros((20, 10, 3), np.uint8)
+        out = RandomResize(5, 8, seed=0).apply(img)
+        assert 5 <= out.shape[0] <= 8 and out.shape[0] == out.shape[1]
+        out = RandomAspectScale([12], max_size=30, seed=0).apply(img)
+        assert min(out.shape[:2]) == 12  # short side scaled to target
+        # long-side cap: with max_size=20 the scale clamps to 1.0
+        out = RandomAspectScale([12], max_size=20, seed=0).apply(img)
+        assert out.shape[:2] == (20, 10)
+
+    def test_grayscale(self):
+        from analytics_zoo_tpu.feature.image import Grayscale
+        img = np.random.RandomState(0).rand(3, 3, 3).astype(np.float32)
+        out = Grayscale().apply(img)
+        assert out.shape == (3, 3, 3)
+        np.testing.assert_allclose(out[..., 0], out[..., 1])

@@ -196,6 +196,57 @@ layer { name: "relu1" type: "ReLU" bottom: "p1" top: "p1" }
         assert np.asarray(y).shape == (1, 2, 2, 1)
         np.testing.assert_array_equal(np.asarray(y), 0.0)  # relu applied
 
+    def test_ave_pool_ceil_matches_torch(self, tmp_path):
+        """Caffe AVE pooling: ceil sizing + divisor clipped at size+pad —
+        torch's AvgPool2d(ceil_mode=True, count_include_pad=True) implements
+        the same contract."""
+        torch = pytest.importorskip("torch")
+        pt = tmp_path / "ave.prototxt"
+        pt.write_text("""
+input: "data"
+input_shape { dim: 1 dim: 1 dim: 6 dim: 6 }
+layer { name: "p1" type: "Pooling" bottom: "data" top: "p1"
+        pooling_param { pool: AVE kernel_size: 3 stride: 2 pad: 1 } }
+""")
+        model, params, state = load_caffe(str(pt))
+        x = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+        with torch.no_grad():
+            expected = torch.nn.AvgPool2d(
+                3, 2, padding=1, ceil_mode=True, count_include_pad=True)(
+                torch.from_numpy(x)).numpy()
+        y, _ = model.call(params, state, np.transpose(x, (0, 2, 3, 1)))
+        np.testing.assert_allclose(np.transpose(np.asarray(y), (0, 3, 1, 2)),
+                                   expected, rtol=1e-5)
+
+    def test_rectangular_pooling(self, tmp_path):
+        pt = tmp_path / "rect.prototxt"
+        pt.write_text("""
+input: "data"
+input_shape { dim: 1 dim: 1 dim: 8 dim: 9 }
+layer { name: "p1" type: "Pooling" bottom: "data" top: "p1"
+        pooling_param { pool: MAX kernel_h: 2 kernel_w: 3
+                        stride_h: 2 stride_w: 3 } }
+""")
+        model, params, state = load_caffe(str(pt))
+        x = np.arange(72, dtype=np.float32).reshape(1, 8, 9, 1)
+        y, _ = model.call(params, state, x)
+        assert np.asarray(y).shape == (1, 4, 3, 1)
+        assert np.asarray(y)[0, 0, 0, 0] == x[0, 0:2, 0:3, 0].max()
+
+    def test_unpaired_batchnorm_rejected(self, tmp_path):
+        pt = tmp_path / "bn.prototxt"
+        pt.write_text("""
+input: "data"
+input_shape { dim: 1 dim: 1 dim: 4 dim: 4 }
+layer { name: "bn" type: "BatchNorm" bottom: "data" top: "bn" }
+""")
+        cm = tmp_path / "bn.caffemodel"
+        cm.write_bytes(_str_field(1, "n") + _layer("bn", [
+            np.zeros(1, np.float32), np.ones(1, np.float32),
+            np.ones(1, np.float32)]))
+        with pytest.raises(Exception, match="Scale"):
+            load_caffe(str(pt), str(cm))
+
     def test_missing_weights_rejected(self, tmp_path):
         pt = tmp_path / "net.prototxt"
         pt.write_text(PROTOTXT)
