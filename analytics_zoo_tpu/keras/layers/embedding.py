@@ -65,6 +65,65 @@ class WordEmbedding(Embedding):
         super().__init__(weights.shape[0], weights.shape[1],
                          weights=weights, trainable=trainable, name=name)
 
+    @staticmethod
+    def read_glove(path: str, word_index: Optional[dict] = None):
+        """Parse a GloVe-format text file (``word v1 v2 ...`` per line;
+        reference ``WordEmbedding.getWordEmbedding``).
+
+        With ``word_index`` (word → 1-based id, the TextSet convention, 0 =
+        padding), returns a ``[len(index)+1, dim]`` table holding only the
+        indexed words (missing words stay zero). Without it, returns
+        ``(table, word_index)`` over the whole file.
+        """
+        vectors = {}
+        dim = None
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                parts = line.rstrip().split(" ")
+                if len(parts) < 3:
+                    continue
+                try:
+                    # glove.840B-style files contain multi-token "words"
+                    # (e.g. ". . ."): once dim is known, take the LAST dim
+                    # fields as the vector and the rest as the word
+                    if dim is not None and len(parts) != dim + 1:
+                        vec = np.asarray(parts[-dim:], dtype=np.float32)
+                        word = " ".join(parts[:-dim])
+                    else:
+                        vec = np.asarray(parts[1:], dtype=np.float32)
+                        word = parts[0]
+                except ValueError:
+                    continue  # unparseable line — skip, don't abort the file
+                if dim is None:
+                    dim = len(vec)
+                elif len(vec) != dim:
+                    continue
+                vectors[word] = vec
+        if dim is None:
+            raise ValueError(f"no embeddings parsed from {path}")
+        if word_index is not None:
+            table = np.zeros((max(word_index.values()) + 1, dim), np.float32)
+            for word, idx in word_index.items():
+                if word in vectors:
+                    table[idx] = vectors[word]
+            return table
+        word_index = {w: i + 1 for i, w in enumerate(vectors)}
+        table = np.zeros((len(vectors) + 1, dim), np.float32)
+        for w, i in word_index.items():
+            table[i] = vectors[w]
+        return table, word_index
+
+    @classmethod
+    def from_glove(cls, path: str, word_index: Optional[dict] = None,
+                   trainable: bool = False, name: Optional[str] = None):
+        """Build the layer straight from a GloVe file (+ optional TextSet
+        word index)."""
+        if word_index is not None:
+            table = cls.read_glove(path, word_index)
+        else:
+            table, _ = cls.read_glove(path)
+        return cls(table, trainable=trainable, name=name)
+
 
 class SparseEmbedding(Embedding):
     """Embedding over sparse one-hot-style inputs (reference

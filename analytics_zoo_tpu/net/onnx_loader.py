@@ -335,6 +335,8 @@ class _GraphBuilder:
         if mode != "constant":
             raise OnnxLoaderError(f"Pad mode {mode!r} unsupported")
         value = float(attrs.get("value") or 0.0)
+        if len(node["input"]) > 2 and node["input"][2]:
+            value = float(self.const(node["input"][2]))  # opset >= 11
         ndim = len(pads) // 2
         begins, ends = pads[:ndim], pads[ndim:]
         if v.layout == "nhwc" and ndim == 4:
@@ -526,6 +528,11 @@ class _GraphBuilder:
         from ..keras.layers import merge
         vals = [self.val(i) for i in node["input"]]
         axis = int(attrs.get("axis") or 0)
+        if all(v.const is not None for v in vals):
+            # shape-arithmetic chains (Shape→Concat→Reshape) fold statically
+            self.set(node["output"][0], _Value(const=np.concatenate(
+                [np.atleast_1d(v.const) for v in vals], axis=axis)))
+            return
         if vals[0].layout == "nhwc":
             # NCHW axes → NHWC: C(1)→3, H(2)→1, W(3)→2
             axis = {1: 3, 2: 1, 3: 2}.get(axis, axis)
@@ -589,10 +596,282 @@ class _GraphBuilder:
         layout = v.layout if (keep and v.layout == "nhwc") else None
         self._set_out(node, out, layout=layout)
 
+    # -- additional elementwise / reduction / shape ops ---------------------
+
+    def _unary_lambda(self, node, name, fn):
+        v = self.val(node["input"][0])
+        if v.const is not None:
+            self.set(node["output"][0], _Value(const=np.asarray(fn(v.const))))
+            return
+        from ..keras.layers import Lambda
+        self._set_out(node, Lambda(fn, name=name)(v.sym),
+                      layout=v.layout, nhwc_shape=v.nhwc_shape)
+
+    def op_abs(self, node, attrs, name):
+        import jax.numpy as jnp
+        self._unary_lambda(node, name, jnp.abs)
+
+    def op_neg(self, node, attrs, name):
+        import jax.numpy as jnp
+        self._unary_lambda(node, name, jnp.negative)
+
+    def op_sqrt(self, node, attrs, name):
+        import jax.numpy as jnp
+        self._unary_lambda(node, name, jnp.sqrt)
+
+    def op_reciprocal(self, node, attrs, name):
+        import jax.numpy as jnp
+        self._unary_lambda(node, name, jnp.reciprocal)
+
+    def op_erf(self, node, attrs, name):
+        import jax
+        self._unary_lambda(node, name, jax.scipy.special.erf)
+
+    def op_floor(self, node, attrs, name):
+        import jax.numpy as jnp
+        self._unary_lambda(node, name, jnp.floor)
+
+    def op_log(self, node, attrs, name):
+        import jax.numpy as jnp
+        self._unary_lambda(node, name, jnp.log)
+
+    def op_hardsigmoid(self, node, attrs, name):
+        import jax.numpy as jnp
+        alpha = attrs["alpha"] if attrs.get("alpha") is not None else 0.2
+        beta = attrs["beta"] if attrs.get("beta") is not None else 0.5
+        self._unary_lambda(node, name,
+                           lambda t: jnp.clip(alpha * t + beta, 0.0, 1.0))
+
+    def op_prelu(self, node, attrs, name):
+        from ..keras.layers import Lambda
+        import jax.numpy as jnp
+        v = self.val(node["input"][0])
+        slope = self.const(node["input"][1]).astype(np.float32)
+        if v.layout == "nhwc" and slope.ndim >= 3:
+            slope = np.moveaxis(slope, -3, -1)  # channel axis to the end
+        slope = np.squeeze(slope) if slope.size > 1 else slope.reshape(())
+        out = Lambda(lambda t, s=slope: jnp.where(t >= 0, t, t * s),
+                     name=name)(v.sym)
+        self._set_out(node, out, layout=v.layout, nhwc_shape=v.nhwc_shape)
+
+    def _nary_minmax(self, node, name, fn):
+        from ..keras.layers import Lambda
+        vals = [self.val(i) for i in node["input"]]
+        if all(v.const is not None for v in vals):
+            out = vals[0].const
+            for v in vals[1:]:
+                out = fn(out, v.const)
+            self.set(node["output"][0], _Value(const=np.asarray(out)))
+            return
+        # mixed operands (e.g. Max(x, const) clip patterns): fold the
+        # constants together, close them over the lambda
+        syms = [v.sym for v in vals if v.sym is not None]
+        consts = [v.const for v in vals if v.const is not None]
+        cfold = None
+        if consts:
+            cfold = consts[0]
+            for c in consts[1:]:
+                cfold = fn(cfold, c)
+            cfold = np.asarray(cfold, dtype=self.dtype)
+
+        def apply(xs, c=cfold):
+            xs = xs if isinstance(xs, (list, tuple)) else [xs]
+            out = xs[0]
+            for x in xs[1:]:
+                out = fn(out, x)
+            if c is not None:
+                out = fn(out, c)
+            return out
+        ref = next(v for v in vals if v.sym is not None)
+        self._set_out(node, Lambda(apply, name=name)(
+            syms if len(syms) > 1 else syms[0]),
+            layout=ref.layout, nhwc_shape=ref.nhwc_shape)
+
+    def op_min(self, node, attrs, name):
+        import jax.numpy as jnp
+        self._nary_minmax(node, name, jnp.minimum)
+
+    def op_max(self, node, attrs, name):
+        import jax.numpy as jnp
+        self._nary_minmax(node, name, jnp.maximum)
+
+    def _reduce_op(self, node, attrs, name, fn):
+        from ..keras.layers import Lambda
+        v = self.val(node["input"][0])
+        axes = attrs.get("axes")
+        if axes is None and len(node["input"]) > 1 and node["input"][1]:
+            axes = [int(x) for x in self.const(node["input"][1]).reshape(-1)]
+        axes = tuple(axes or ())
+        keep = bool(attrs.get("keepdims", 1))
+        if v.layout == "nhwc" and axes:
+            axes = tuple({1: 3, 2: 1, 3: 2}.get(a, a) for a in axes)
+        out = Lambda(lambda t: fn(t, axis=axes or None, keepdims=keep),
+                     name=name)(v.sym)
+        layout = v.layout if (keep and v.layout == "nhwc") else None
+        self._set_out(node, out, layout=layout)
+
+    def op_reducesum(self, node, attrs, name):
+        import jax.numpy as jnp
+        self._reduce_op(node, attrs, name, jnp.sum)
+
+    def op_reducemax(self, node, attrs, name):
+        import jax.numpy as jnp
+        self._reduce_op(node, attrs, name, jnp.max)
+
+    def op_reducemin(self, node, attrs, name):
+        import jax.numpy as jnp
+        self._reduce_op(node, attrs, name, jnp.min)
+
+    def op_argmax(self, node, attrs, name):
+        from ..keras.layers import Lambda
+        import jax.numpy as jnp
+        v = self.val(node["input"][0])
+        axis = int(attrs.get("axis") or 0)
+        if v.layout == "nhwc":
+            axis = {1: 3, 2: 1, 3: 2}.get(axis, axis)
+        keep = bool(attrs.get("keepdims", 1))
+        out = Lambda(lambda t: jnp.argmax(t, axis=axis, keepdims=keep)
+                     .astype(jnp.int32), name=name)(v.sym)
+        self._set_out(node, out)
+
+    def op_shape(self, node, attrs, name):
+        """Static shape as a constant — exporters use Shape→Gather→Concat→
+        Reshape chains for flattens; returning the ONNX-layout (NCHW) shape
+        keeps that arithmetic consistent. The batch dim is emitted as -1
+        (unknown at import time; Reshape treats leading -1 as batch)."""
+        v = self.val(node["input"][0])
+        dims = list(v.sym.shape)
+        if v.layout == "nhwc" and len(dims) == 4:
+            n, h, w, c = dims
+            dims = [n, c, h, w]
+        out = np.asarray([-1 if d is None else int(d) for d in dims],
+                         dtype=np.int64)
+        self.set(node["output"][0], _Value(const=out))
+
+    def op_slice(self, node, attrs, name):
+        from ..keras.layers import Lambda
+        v = self.val(node["input"][0])
+        starts = attrs.get("starts")
+        ends = attrs.get("ends")
+        axes = attrs.get("axes")
+        steps = None
+        if starts is None and len(node["input"]) > 1:
+            starts = [int(x) for x in self.const(node["input"][1]).reshape(-1)]
+            ends = [int(x) for x in self.const(node["input"][2]).reshape(-1)]
+            if len(node["input"]) > 3 and node["input"][3]:
+                axes = [int(x) for x in
+                        self.const(node["input"][3]).reshape(-1)]
+            if len(node["input"]) > 4 and node["input"][4]:
+                steps = [int(x) for x in
+                         self.const(node["input"][4]).reshape(-1)]
+        axes = axes or list(range(len(starts)))
+        steps = steps or [1] * len(starts)
+        int_max = 2 ** 31 - 1
+
+        def spec(ndim):
+            sl = [slice(None)] * ndim
+            for a, s, e, st in zip(axes, starts, ends, steps):
+                end = None if (st > 0 and e >= int_max) \
+                    or (st < 0 and e <= -int_max) else e
+                sl[a] = slice(s, end, st)
+            return tuple(sl)
+
+        if v.const is not None:
+            self.set(node["output"][0], _Value(const=v.const[spec(v.const.ndim)]))
+            return
+        if v.layout == "nhwc":
+            axes = [{1: 3, 2: 1, 3: 2}.get(a, a) for a in axes]
+        self._set_out(node, Lambda(lambda t: t[spec(t.ndim)], name=name)(v.sym),
+                      layout=v.layout)
+
+    def op_split(self, node, attrs, name):
+        from ..keras.layers import Lambda
+        import jax
+        v = self.val(node["input"][0])
+        axis = int(attrs.get("axis") or 0)
+        if v.layout == "nhwc":
+            axis = {1: 3, 2: 1, 3: 2}.get(axis, axis)
+        sizes = attrs.get("split")
+        if sizes is None and len(node["input"]) > 1 and node["input"][1]:
+            sizes = [int(x) for x in self.const(node["input"][1]).reshape(-1)]
+        n_out = len(node["output"])
+        if sizes is None:
+            dim = v.sym.shape[axis]
+            sizes = [dim // n_out] * n_out
+        offsets = np.cumsum([0] + list(sizes))
+        for i, out_name in enumerate(node["output"]):
+            s, e = int(offsets[i]), int(offsets[i + 1])
+            sym = Lambda(
+                lambda t, s=s, e=e: jax.lax.slice_in_dim(t, s, e, axis=axis),
+                name=f"{name}_{i}")(v.sym)
+            self.set(out_name, _Value(sym=sym, layout=v.layout))
+
+    def op_expand(self, node, attrs, name):
+        from ..keras.layers import Lambda
+        import jax.numpy as jnp
+        v = self.val(node["input"][0])
+        target = [int(x) for x in self.const(node["input"][1]).reshape(-1)]
+        if v.const is not None:
+            self.set(node["output"][0], _Value(
+                const=np.broadcast_to(v.const, target).copy()))
+            return
+
+        def expand(t):
+            # ONNX Expand is numpy-style RIGHT-aligned broadcasting; a target
+            # dim of 1 (or -1) keeps the input's dim
+            shape = list(target)
+            offset = len(shape) - t.ndim
+            for i in range(t.ndim):
+                if shape[offset + i] in (1, -1) and t.shape[i] != 1:
+                    shape[offset + i] = t.shape[i]
+            return jnp.broadcast_to(t, tuple(shape))
+        self._set_out(node, Lambda(expand, name=name)(v.sym))
+
+    def op_resize(self, node, attrs, name):
+        """Nearest/linear upsampling with constant scales (NHWC path)."""
+        from ..keras.layers import Lambda
+        import jax
+        v = self.val(node["input"][0])
+        mode = attrs.get("mode") or "nearest"
+        if isinstance(mode, bytes):
+            mode = mode.decode()
+        scales = sizes = None
+        if attrs.get("scales"):  # Upsample opset 7/8: attribute
+            scales = np.asarray(attrs["scales"], np.float32)
+        elif node.get("op_type") == "Upsample" and len(node["input"]) > 1:
+            scales = self.const(node["input"][1]).reshape(-1)  # opset 9
+        if scales is None and len(node["input"]) > 2 and node["input"][2]:
+            scales = self.const(node["input"][2]).reshape(-1)
+        if len(node["input"]) > 3 and node["input"][3]:
+            sizes = [int(x) for x in self.const(node["input"][3]).reshape(-1)]
+        if v.layout != "nhwc" or len(v.sym.shape) != 4:
+            raise OnnxLoaderError("Resize supported on 4-D conv tensors only")
+        _, h, w, c = v.sym.shape
+        if sizes is not None:
+            nh, nw = sizes[2], sizes[3]  # NCHW order
+        elif scales is not None and len(scales) == 4:
+            nh, nw = int(round(h * scales[2])), int(round(w * scales[3]))
+        else:
+            raise OnnxLoaderError("Resize needs scales or sizes")
+        method = {"nearest": "nearest", "linear": "bilinear"}.get(mode)
+        if method is None:
+            raise OnnxLoaderError(f"Resize mode {mode!r} unsupported")
+        out = Lambda(lambda t: jax.image.resize(
+            t, (t.shape[0], nh, nw, t.shape[3]), method=method),
+            name=name)(v.sym)
+        self._set_out(node, out, layout="nhwc")
+
+    op_upsample = op_resize
+
     def op_gather(self, node, attrs, name):
         from ..keras.layers import Embedding
         v = self.val(node["input"][0])
         idx = self.val(node["input"][1])
+        if v.const is not None and idx.const is not None:
+            self.set(node["output"][0], _Value(const=np.take(
+                v.const, idx.const.astype(np.int64),
+                axis=int(attrs.get("axis") or 0))))
+            return
         if v.const is not None and idx.sym is not None and v.const.ndim == 2 \
                 and int(attrs.get("axis") or 0) == 0:
             # embedding lookup: table is the constant, indices are runtime

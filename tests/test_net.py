@@ -315,6 +315,197 @@ class TestOnnxNumericEdges:
                                       [[0.0, 0.0, 3.0, 6.0]])
 
 
+class TestOnnxExtendedOps:
+    def test_shape_gather_concat_reshape_idiom(self):
+        """The standard exporter flatten: Reshape(x, Concat(Gather(Shape(x),
+        0), [-1])) must fold statically and flatten correctly."""
+        rs = np.random.RandomState(0)
+        w = rs.randn(5, 12, 2).astype(np.float32)  # conv-free: 3D input
+        nodes = [
+            _node("Shape", ["x"], ["shp"], "shape0"),
+            _node("Gather", ["shp", "zero"], ["b"], "gather0",
+                  attrs=[_attr_int("axis", 0)]),
+            _node("Unsqueeze", ["b"], ["b1"], "unsq0",
+                  attrs=[_attr_ints("axes", [0])]),
+            _node("Concat", ["b1", "minus1"], ["tgt"], "cat0",
+                  attrs=[_attr_int("axis", 0)]),
+            _node("Reshape", ["x", "tgt"], ["flat"], "reshape0"),
+            _node("Gemm", ["flat", "wT", "bias"], ["y"], "fc",
+                  attrs=[_attr_int("transB", 1)]),
+        ]
+        fc_w = rs.randn(3, 10).astype(np.float32)
+        fc_b = rs.randn(3).astype(np.float32)
+        graph = _graph(
+            nodes, inputs=[_value_info("x", [None, 5, 2])],
+            outputs=[_value_info("y", [None, 3])],
+            initializers=[_tensor("zero", np.asarray(0, np.int64)),
+                          _tensor("minus1", np.asarray([-1], np.int64)),
+                          _tensor("wT", fc_w), _tensor("bias", fc_b)])
+        model, params, state = load_onnx(_model(graph))
+        x = rs.randn(4, 5, 2).astype(np.float32)
+        y, _ = model.call(params, state, x)
+        expected = x.reshape(4, 10) @ fc_w.T + fc_b
+        np.testing.assert_allclose(np.asarray(y), expected, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_elementwise_and_reductions(self):
+        rs = np.random.RandomState(1)
+        nodes = [
+            _node("Abs", ["x"], ["a"], "abs0"),
+            _node("Sqrt", ["a"], ["s"], "sqrt0"),
+            _node("ReduceSum", ["s"], ["r"], "rsum",
+                  attrs=[_attr_ints("axes", [1]), _attr_int("keepdims", 0)]),
+            _node("Neg", ["r"], ["y"], "neg0"),
+        ]
+        graph = _graph(nodes, inputs=[_value_info("x", [None, 6])],
+                       outputs=[_value_info("y", [None])], initializers=[])
+        model, params, state = load_onnx(_model(graph))
+        x = rs.randn(3, 6).astype(np.float32)
+        y, _ = model.call(params, state, x)
+        np.testing.assert_allclose(np.asarray(y),
+                                   -np.sqrt(np.abs(x)).sum(axis=1),
+                                   rtol=1e-5)
+
+    def test_slice_split_minmax(self):
+        rs = np.random.RandomState(2)
+        nodes = [
+            _node("Slice", ["x"], ["sl"], "slice0", attrs=[
+                _attr_ints("starts", [1]), _attr_ints("ends", [5]),
+                _attr_ints("axes", [1])]),
+            _node("Split", ["sl"], ["p1", "p2"], "split0",
+                  attrs=[_attr_int("axis", 1), _attr_ints("split", [2, 2])]),
+            _node("Max", ["p1", "p2"], ["y"], "max0"),
+        ]
+        graph = _graph(nodes, inputs=[_value_info("x", [None, 6])],
+                       outputs=[_value_info("y", [None, 2])], initializers=[])
+        model, params, state = load_onnx(_model(graph))
+        x = rs.randn(3, 6).astype(np.float32)
+        y, _ = model.call(params, state, x)
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.maximum(x[:, 1:3], x[:, 3:5]))
+
+    def test_resize_nearest_nhwc(self):
+        nodes = [
+            _node("Conv", ["x", "w"], ["c"], "conv0", attrs=[
+                _attr_ints("kernel_shape", [1, 1]),
+                _attr_ints("strides", [1, 1])]),
+            _node("Resize", ["c", "roi", "scales"], ["y"], "resize0",
+                  attrs=[]),
+        ]
+        w = np.ones((2, 1, 1, 1), np.float32)
+        graph = _graph(
+            nodes, inputs=[_value_info("x", [None, 1, 2, 2])],
+            outputs=[_value_info("y", [None, 2, 4, 4])],
+            initializers=[_tensor("w", w),
+                          _tensor("roi", np.zeros(0, np.float32)),
+                          _tensor("scales",
+                                  np.asarray([1, 1, 2, 2], np.float32))])
+        model, params, state = load_onnx(_model(graph))
+        x = np.arange(4, dtype=np.float32).reshape(1, 2, 2, 1)
+        y, _ = model.call(params, state, x)
+        assert np.asarray(y).shape == (1, 4, 4, 2)
+        # nearest: each pixel repeats 2x2
+        np.testing.assert_array_equal(np.asarray(y)[0, :2, :2, 0],
+                                      np.full((2, 2), x[0, 0, 0, 0]))
+
+    def test_strided_and_reversed_slice(self):
+        rs = np.random.RandomState(3)
+        nodes = [_node("Slice", ["x", "st", "en", "ax", "sp"], ["y"],
+                       "slice0")]
+        graph = _graph(
+            nodes, inputs=[_value_info("x", [None, 6])],
+            outputs=[_value_info("y", [None, 3])],
+            initializers=[
+                _tensor("st", np.asarray([0], np.int64)),
+                _tensor("en", np.asarray([6], np.int64)),
+                _tensor("ax", np.asarray([1], np.int64)),
+                _tensor("sp", np.asarray([2], np.int64))])
+        model, params, state = load_onnx(_model(graph))
+        x = rs.randn(2, 6).astype(np.float32)
+        y, _ = model.call(params, state, x)
+        np.testing.assert_allclose(np.asarray(y), x[:, ::2])
+
+    def test_expand_rank_extend(self):
+        nodes = [_node("Expand", ["x", "tgt"], ["y"], "exp0")]
+        graph = _graph(
+            nodes, inputs=[_value_info("x", [None, 3])],
+            outputs=[_value_info("y", [None, 2, 3])],
+            initializers=[_tensor("tgt", np.asarray([2, 2, 3], np.int64))])
+        model, params, state = load_onnx(_model(graph))
+        x = np.asarray([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], np.float32)
+        y, _ = model.call(params, state, x)
+        assert np.asarray(y).shape == (2, 2, 3)
+        # right-aligned: the [2,3] input tiles along the new middle axis
+        np.testing.assert_allclose(np.asarray(y)[0], x)
+        np.testing.assert_allclose(np.asarray(y)[1], x)
+
+    def test_max_with_constant(self):
+        nodes = [_node("Max", ["x", "floor"], ["y"], "max0")]
+        graph = _graph(
+            nodes, inputs=[_value_info("x", [None, 3])],
+            outputs=[_value_info("y", [None, 3])],
+            initializers=[_tensor("floor",
+                                  np.asarray([0.5], np.float32))])
+        model, params, state = load_onnx(_model(graph))
+        x = np.asarray([[-1.0, 0.7, 0.2]], np.float32)
+        y, _ = model.call(params, state, x)
+        np.testing.assert_allclose(np.asarray(y), [[0.5, 0.7, 0.5]])
+
+    def test_prelu(self):
+        nodes = [_node("PRelu", ["x", "slope"], ["y"], "prelu0")]
+        graph = _graph(nodes, inputs=[_value_info("x", [None, 3])],
+                       outputs=[_value_info("y", [None, 3])],
+                       initializers=[_tensor(
+                           "slope", np.asarray([0.1, 0.2, 0.3], np.float32))])
+        model, params, state = load_onnx(_model(graph))
+        x = np.asarray([[-1.0, -1.0, 2.0]], np.float32)
+        y, _ = model.call(params, state, x)
+        np.testing.assert_allclose(np.asarray(y), [[-0.1, -0.2, 2.0]],
+                                   rtol=1e-5)
+
+
+class TestGlove:
+    def test_read_and_build(self, tmp_path):
+        from analytics_zoo_tpu.keras.layers import WordEmbedding
+        glove = tmp_path / "glove.txt"
+        glove.write_text("the 0.1 0.2 0.3\ncat 0.4 0.5 0.6\nsat 0.7 0.8 0.9\n")
+        table, index = WordEmbedding.read_glove(str(glove))
+        assert table.shape == (4, 3)  # + padding row 0
+        np.testing.assert_allclose(table[index["cat"]], [0.4, 0.5, 0.6])
+        np.testing.assert_allclose(table[0], 0.0)
+
+    def test_with_word_index(self, tmp_path):
+        from analytics_zoo_tpu.keras.layers import WordEmbedding
+        glove = tmp_path / "glove.txt"
+        glove.write_text("the 0.1 0.2\ncat 0.4 0.5\n")
+        table = WordEmbedding.read_glove(str(glove),
+                                         {"cat": 1, "unknown": 2})
+        assert table.shape == (3, 2)
+        np.testing.assert_allclose(table[1], [0.4, 0.5])
+        np.testing.assert_allclose(table[2], 0.0)  # missing word stays zero
+
+    def test_multi_token_words_skipped_not_fatal(self, tmp_path):
+        """glove.840B-style files contain '. . . 0.1 0.2' lines; loading
+        must not abort (and once dim is known, the vector still parses)."""
+        from analytics_zoo_tpu.keras.layers import WordEmbedding
+        glove = tmp_path / "glove.txt"
+        glove.write_text("the 0.1 0.2\n. . . 0.3 0.4\ncat 0.5 0.6\n")
+        table, index = WordEmbedding.read_glove(str(glove))
+        np.testing.assert_allclose(table[index["cat"]], [0.5, 0.6])
+        np.testing.assert_allclose(table[index[". . ."]], [0.3, 0.4])
+
+    def test_layer_from_glove(self, tmp_path):
+        import jax
+        from analytics_zoo_tpu.keras.layers import WordEmbedding
+        glove = tmp_path / "glove.txt"
+        glove.write_text("a 1 0\nb 0 1\n")
+        layer = WordEmbedding.from_glove(str(glove), {"a": 1, "b": 2})
+        params, state = layer.build(jax.random.PRNGKey(0), (None, 2))
+        out, _ = layer.call(params, state, np.asarray([[1, 2]]))
+        np.testing.assert_allclose(np.asarray(out),
+                                   [[[1, 0], [0, 1]]])
+
+
 class TestTorchImport:
     def test_mlp_state_dict(self):
         torch = pytest.importorskip("torch")
