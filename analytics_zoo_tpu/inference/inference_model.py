@@ -126,6 +126,19 @@ class InferenceModel:
         self._keep_alive = loaded
         return self
 
+    def load_onnx(self, path: str) -> "InferenceModel":
+        """ONNX file → native model pool entry (≙ the OpenVINO-IR load role;
+        imports through the dependency-free ONNX loader)."""
+        from ..net import load_onnx as _load
+        return self.load_keras(*_load(path))
+
+    def load_caffe(self, prototxt_path: str,
+                   caffemodel_path: Optional[str] = None) -> "InferenceModel":
+        """Caffe prototxt+caffemodel → native model pool entry
+        (≙ doLoadCaffe)."""
+        from ..net import load_caffe as _load
+        return self.load_keras(*_load(prototxt_path, caffemodel_path))
+
     def load_torch(self, path: str) -> "InferenceModel":
         """TorchScript model on host CPU (≙ doLoadPyTorch / TorchNet JNI).
         Runs outside XLA; the pool semaphore is the real concurrency guard."""

@@ -10,7 +10,8 @@ from typing import Any, Dict, Optional, Sequence
 @dataclass
 class ServingConfig:
     model_path: str = ""
-    model_type: str = "zoo"  # zoo | savedmodel | torch
+    model_type: str = "zoo"  # zoo | savedmodel | torch | onnx | caffe
+    model_weight_path: str = ""  # caffe: path to the .caffemodel
     data_src: str = "dir:///tmp/zoo_serving"
     image_shape: Sequence[int] = (224, 224, 3)
     filter_top_n: Optional[int] = None
@@ -32,6 +33,8 @@ class ServingConfig:
         cfg = ServingConfig()
         cfg.model_path = model.get("path", cfg.model_path)
         cfg.model_type = model.get("type", cfg.model_type)
+        cfg.model_weight_path = model.get("weight_path",
+                                          cfg.model_weight_path)
         cfg.data_src = data.get("src") or cfg.data_src
         if data.get("image_shape"):
             shape = data["image_shape"]

@@ -53,6 +53,10 @@ class ClusterServing:
             im.load_savedmodel(cfg.model_path)
         elif cfg.model_type == "torch":
             im.load_torch(cfg.model_path)
+        elif cfg.model_type == "onnx":
+            im.load_onnx(cfg.model_path)
+        elif cfg.model_type == "caffe":
+            im.load_caffe(cfg.model_path, cfg.model_weight_path or None)
         else:
             raise ValueError(f"unknown model_type {cfg.model_type}")
         if cfg.quantize:

@@ -292,3 +292,33 @@ class TestInferenceModel:
         im = InferenceModel().load_torch(path)
         x = np.random.rand(4, 3).astype(np.float32)
         np.testing.assert_allclose(im.predict(x), 3 * x, atol=1e-5)
+
+
+class TestImportedModelServing:
+    def test_load_onnx_into_pool(self, tmp_path):
+        from test_net import _mlp_onnx
+        rs = np.random.RandomState(0)
+        data, (w1, b1, w2, b2) = _mlp_onnx(rs)
+        path = tmp_path / "m.onnx"
+        path.write_bytes(data)
+        from analytics_zoo_tpu.inference import InferenceModel
+        im = InferenceModel(concurrent_num=2).load_onnx(str(path))
+        x = rs.randn(4, 4).astype(np.float32)
+        out = np.asarray(im.predict(x))
+        expected = np.maximum(x @ w1 + b1, 0) @ w2 + b2
+        np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-4)
+
+    def test_load_caffe_into_pool(self, tmp_path):
+        pt = tmp_path / "net.prototxt"
+        pt.write_text("""
+input: "data"
+input_shape { dim: 1 dim: 1 dim: 4 dim: 4 }
+layer { name: "p1" type: "Pooling" bottom: "data" top: "p1"
+        pooling_param { pool: AVE kernel_size: 2 stride: 2 } }
+""")
+        from analytics_zoo_tpu.inference import InferenceModel
+        im = InferenceModel().load_caffe(str(pt))
+        x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+        out = np.asarray(im.predict(x))
+        assert out.shape == (1, 2, 2, 1)
+        assert out[0, 0, 0, 0] == x[0, :2, :2, 0].mean()
