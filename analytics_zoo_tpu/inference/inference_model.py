@@ -133,11 +133,15 @@ class InferenceModel:
         return self.load_keras(*_load(path))
 
     def load_caffe(self, prototxt_path: str,
-                   caffemodel_path: Optional[str] = None) -> "InferenceModel":
+                   caffemodel_path: Optional[str] = None,
+                   input_shape: Optional[Sequence[int]] = None
+                   ) -> "InferenceModel":
         """Caffe prototxt+caffemodel → native model pool entry
-        (≙ doLoadCaffe)."""
+        (≙ doLoadCaffe). ``input_shape``: (C, H, W), for deploy prototxts
+        that declare no input shape."""
         from ..net import load_caffe as _load
-        return self.load_keras(*_load(prototxt_path, caffemodel_path))
+        return self.load_keras(*_load(prototxt_path, caffemodel_path,
+                                      input_shape=input_shape))
 
     def load_torch(self, path: str) -> "InferenceModel":
         """TorchScript model on host CPU (≙ doLoadPyTorch / TorchNet JNI).

@@ -360,6 +360,67 @@ class _TrainableMixin:
     def load_weights(self, path: str) -> None:
         self.get_estimator().load_checkpoint(path)
 
+    def summary(self, input_shape=None, print_fn=print) -> str:
+        """Keras-style layer/shape/param table (reference
+        ``KerasNet.summary``, Topology.scala:138). For a Sequential not yet
+        built, pass ``input_shape`` (without the batch dim)."""
+        if isinstance(self, Model):
+            layers = [n.layer for n in self._nodes]
+            shape = None
+        else:
+            layers = list(getattr(self, "layers", []))
+            shape = ((None,) + tuple(input_shape) if input_shape is not None
+                     else self.built_shape)
+            if shape is None:
+                raise ValueError("summary() on an unbuilt Sequential needs "
+                                 "input_shape")
+        # abstract build — a failure here is a real model bug and must
+        # surface, not render as an all-zero table
+        out = jax.eval_shape(
+            lambda r: (self.build(r, shape) if shape is not None
+                       else self.build(r)), jax.random.PRNGKey(0))
+        param_shapes = out[0]
+
+        def count(tree):
+            return sum(int(np.prod(l.shape)) for l in
+                       jax.tree_util.tree_leaves(tree))
+
+        frozen = self.frozen_layers
+        lines = [f"{'Layer (type)':<34}{'Output shape':<22}{'Params':>10}",
+                 "-" * 66]
+        total = trainable = 0
+        counted = set()  # a shared layer's params count once
+        cur = shape
+        for layer in layers:
+            if isinstance(layer, InputLayer):
+                continue
+            if isinstance(self, Model):
+                if id(layer) in counted:
+                    continue  # graph dedup: one row per shared layer
+                out_shape = ""  # graph layers: shapes live on the symbols
+            else:
+                # Sequential chains shapes through EVERY application,
+                # including repeats of a shared layer
+                cur = layer.compute_output_shape(cur)
+                out_shape = str(cur)
+            n = count(param_shapes.get(layer.name, {}))
+            if id(layer) in counted:
+                n = 0  # shown again, but params already counted
+            counted.add(id(layer))
+            total += n
+            if layer.name not in frozen:
+                trainable += n
+            mark = " (frozen)" if layer.name in frozen and n else ""
+            lines.append(f"{layer.name + ' (' + type(layer).__name__ + ')':<34}"
+                         f"{out_shape:<22}{n:>10,}{mark}")
+        lines += ["-" * 66,
+                  f"Total params: {total:,}   trainable: {trainable:,}   "
+                  f"frozen: {total - trainable:,}"]
+        text = "\n".join(lines)
+        if print_fn is not None:
+            print_fn(text)
+        return text
+
 
 class Sequential(Layer, _TrainableMixin):
     """Linear stack of layers (reference ``Sequential``, Topology.scala:464)."""

@@ -56,7 +56,9 @@ class ClusterServing:
         elif cfg.model_type == "onnx":
             im.load_onnx(cfg.model_path)
         elif cfg.model_type == "caffe":
-            im.load_caffe(cfg.model_path, cfg.model_weight_path or None)
+            h, w, c = cfg.image_shape
+            im.load_caffe(cfg.model_path, cfg.model_weight_path or None,
+                          input_shape=(c, h, w))
         else:
             raise ValueError(f"unknown model_type {cfg.model_type}")
         if cfg.quantize:

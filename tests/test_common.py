@@ -101,3 +101,27 @@ class TestTriggersSliced:
         fired = [s for s in range(1, 9)
                  if t(TrainingState(num_slices=4, slice_index=s, epoch_finished=True))]
         assert fired == [4, 8]
+
+
+class TestSummary:
+    def test_sequential_summary(self):
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras.layers import Activation, Dense
+        m = Sequential([Dense(64, name="d1"), Activation("relu"),
+                        Dense(2, name="d2")])
+        text = m.summary(input_shape=(20,), print_fn=None)
+        assert "d1 (Dense)" in text and "(None, 64)" in text
+        assert "Total params: 1,474" in text
+
+    def test_model_summary_counts_frozen(self):
+        import jax
+        from analytics_zoo_tpu.keras import Input, Model
+        from analytics_zoo_tpu.keras.layers import Dense
+        x = Input(shape=(4,))
+        h = Dense(8, name="backbone")(x)
+        y = Dense(2, name="head")(h)
+        model = Model(x, y)
+        model.freeze(["backbone"])
+        text = model.summary(print_fn=None)
+        assert "(frozen)" in text
+        assert "trainable: 18" in text  # head: 8*2+2
