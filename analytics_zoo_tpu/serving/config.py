@@ -14,6 +14,9 @@ class ServingConfig:
     model_weight_path: str = ""  # caffe: path to the .caffemodel
     data_src: str = "dir:///tmp/zoo_serving"
     image_shape: Sequence[int] = (224, 224, 3)
+    input_dtype: str = "float32"  # "uint8" halves x4 the host->device bytes
+    #   (pair with a model that normalizes on device, e.g.
+    #   resnet(preprocess="imagenet_uint8"))
     filter_top_n: Optional[int] = None
     batch_size: int = 4
     batch_wait_ms: int = 20  # micro-batch window
@@ -36,6 +39,10 @@ class ServingConfig:
         cfg.model_weight_path = model.get("weight_path",
                                           cfg.model_weight_path)
         cfg.data_src = data.get("src") or cfg.data_src
+        cfg.input_dtype = data.get("input_dtype", cfg.input_dtype)
+        if cfg.input_dtype not in ("float32", "uint8"):
+            raise ValueError(f"input_dtype must be float32 or uint8, got "
+                             f"{cfg.input_dtype!r}")
         if data.get("image_shape"):
             shape = data["image_shape"]
             if isinstance(shape, str):

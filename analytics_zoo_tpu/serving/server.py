@@ -75,8 +75,11 @@ class ClusterServing:
             if img.shape[:2] != (h, w):
                 import cv2
                 img = cv2.resize(img, (w, h))
-            return np.asarray(img, np.float32)
-        if "tensor" in record:  # raw numeric payload
+            # uint8 wire applies to IMAGES only (pixels are uint8 by nature)
+            dtype = np.uint8 if cfg.input_dtype == "uint8" else np.float32
+            return np.asarray(img, dtype)
+        if "tensor" in record:  # raw numeric payload: always float32 — a
+            # uint8 cast would silently truncate/wrap client floats
             return np.asarray(record["tensor"], np.float32)
         raise ValueError(f"record has neither image nor tensor: "
                          f"{sorted(record)}")
