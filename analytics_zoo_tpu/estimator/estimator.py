@@ -194,7 +194,16 @@ class Estimator:
                 # loss in float32 regardless of activation dtype
                 y_pred = jax.tree_util.tree_map(
                     lambda t: t.astype(jnp.float32), y_pred)
-                return loss_fn(y, y_pred), new_state
+                loss = loss_fn(y, y_pred)
+                # the `__aux_loss__` state contract: layers (MoE router
+                # balance, activation regularizers...) publish scalar
+                # penalties in their state; they join the objective here
+                for path, leaf in jax.tree_util.tree_flatten_with_path(
+                        new_state)[0]:
+                    if path and str(getattr(path[-1], "key", "")
+                                    ) == "__aux_loss__":
+                        loss = loss + leaf
+                return loss, new_state
 
             (loss, new_state), grads = jax.value_and_grad(
                 compute_loss, has_aux=True)(params)

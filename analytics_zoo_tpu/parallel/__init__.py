@@ -3,3 +3,6 @@ from .mesh import (  # noqa: F401
     global_batch_shapes, param_sharding, replicated, shard_batch)
 from .ring_attention import (  # noqa: F401
     ring_attention, ring_self_attention, ulysses_attention)
+from .moe import MoE, moe_sharding_rule  # noqa: F401
+from .pipeline import (  # noqa: F401
+    PIPE_AXIS, gpipe, pipeline_apply, stack_stage_params)

@@ -1,0 +1,152 @@
+"""Mixture-of-Experts with expert parallelism.
+
+The reference has no MoE (SURVEY §5: no expert parallelism anywhere); this
+is new TPU-native capability completing the mesh-axis set (dp/tp/sp/ep).
+
+Design: top-1 ("switch") routing with DENSE dispatch — per-token gate
+probabilities become a one-hot combine matrix and expert computation is one
+batched einsum over [experts, capacity, d]. No gather/scatter with dynamic
+shapes, so XLA tiles everything onto the MXU and the `expert` mesh axis
+shards the expert dimension of both the parameters and the dispatched
+tokens; the all-to-all that moves tokens to their experts is the einsum's
+collective, inserted by XLA from the shardings.
+
+``MoE`` is a Keras-engine layer (drop into Sequential/Model); pass
+``param_sharding_rules=[moe_sharding_rule]`` to the Estimator to place the
+expert axis on the mesh.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..keras import initializers
+from ..keras.engine import Layer
+
+EXPERT_AXIS = "expert"
+
+
+class MoE(Layer):
+    """Switch-style MoE feed-forward block: ``y = combine(expert_ffn(
+    dispatch(x)))`` with a load-balancing auxiliary loss folded into the
+    output via a straight-through penalty term.
+
+    Input ``[batch, seq, d]`` (or ``[batch, d]``); each token routes to its
+    top-1 expert, subject to ``capacity_factor`` (tokens over capacity are
+    passed through the residual path untouched).
+    """
+
+    def __init__(self, num_experts: int, hidden_dim: int,
+                 capacity_factor: float = 1.25,
+                 aux_loss_weight: float = 1e-2,
+                 group_size: int = 4096,
+                 activation: str = "relu",
+                 init: str = "glorot_uniform",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.num_experts = num_experts
+        self.hidden_dim = hidden_dim
+        self.capacity_factor = capacity_factor
+        self.aux_loss_weight = aux_loss_weight
+        # routing happens within fixed-size token GROUPS so the dispatch
+        # one-hot stays linear in the token count (a single global group
+        # would be O(tokens^2) memory)
+        self.group_size = group_size
+        self.activation = activation
+        self.init = initializers.get(init)
+
+    def build(self, rng, input_shape):
+        d = input_shape[-1]
+        k1, k2, k3 = jax.random.split(rng, 3)
+        params = {
+            "gate": self.init(k1, (d, self.num_experts)),
+            # expert-major parameter blocks: axis 0 shards over `expert`
+            "w_in": self.init(k2, (self.num_experts, d, self.hidden_dim)),
+            "b_in": jnp.zeros((self.num_experts, self.hidden_dim)),
+            "w_out": self.init(k3, (self.num_experts, self.hidden_dim, d)),
+            "b_out": jnp.zeros((self.num_experts, d)),
+        }
+        # the load-balance loss travels through state under the generic
+        # `__aux_loss__` contract: the Estimator adds it to the objective
+        return params, {"__aux_loss__": jnp.zeros((), jnp.float32)}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        from ..keras.layers.core import get_activation
+        act = get_activation(self.activation)
+        squeeze = inputs.ndim == 2
+        x = inputs[:, None, :] if squeeze else inputs
+        b, s, d = x.shape
+        n_tok = b * s
+        e = self.num_experts
+
+        flat = x.reshape(n_tok, d)
+        gsz = min(self.group_size, n_tok)
+        pad = (-n_tok) % gsz
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad, d), flat.dtype)])
+        g = flat.shape[0] // gsz
+        grouped = flat.reshape(g, gsz, d)
+        cap = max(1, int(self.capacity_factor * gsz / e))
+
+        logits = jnp.einsum("gtd,de->gte", grouped,
+                            params["gate"].astype(flat.dtype)
+                            ).astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)            # [g, t, e]
+        expert_idx = jnp.argmax(probs, axis=-1)            # [g, t]
+        gate = jnp.max(probs, axis=-1)                     # [g, t]
+
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+        # position of each token within its expert's per-group queue
+        pos = (jnp.cumsum(onehot, axis=1) - 1.0) * onehot  # [g, t, e]
+        pos_in_expert = jnp.sum(pos, axis=-1).astype(jnp.int32)
+        keep = pos_in_expert < cap                         # capacity mask
+
+        # dispatch tensor [g, t, e, cap]: one-hot over (expert, slot)
+        slot_onehot = jax.nn.one_hot(pos_in_expert, cap, dtype=flat.dtype)
+        dispatch = (onehot.astype(flat.dtype)[..., None]
+                    * slot_onehot[..., None, :]
+                    * keep.astype(flat.dtype)[..., None, None])
+        # expert inputs [g, e, cap, d] — the contraction over tokens is
+        # where XLA inserts the all-to-all under expert sharding
+        xin = jnp.einsum("gtec,gtd->gecd", dispatch, grouped)
+        h = act(jnp.einsum("gecd,edh->gech", xin,
+                           params["w_in"].astype(flat.dtype))
+                + params["b_in"].astype(flat.dtype)[None, :, None, :])
+        out = (jnp.einsum("gech,ehd->gecd", h,
+                          params["w_out"].astype(flat.dtype))
+               + params["b_out"].astype(flat.dtype)[None, :, None, :])
+        # combine back to tokens, weighted by the gate probability
+        combined = jnp.einsum("gtec,gecd->gtd", dispatch, out)
+        combined = combined * gate.astype(flat.dtype)[..., None]
+        # dropped tokens (over capacity) ride the residual path
+        y = jnp.where(keep[..., None], combined, grouped)
+        y = y.reshape(-1, d)[:n_tok].reshape(b, s, d)
+
+        # switch-transformer load-balance loss: e * Σ_e (frac_tokens_e *
+        # frac_probs_e), averaged over groups; the Estimator consumes it
+        # from state via the `__aux_loss__` contract
+        frac_tokens = jnp.mean(onehot, axis=1)             # [g, e]
+        frac_probs = jnp.mean(probs, axis=1)
+        aux = e * jnp.mean(jnp.sum(frac_tokens * frac_probs, axis=-1))
+        new_state = {"__aux_loss__": (aux * self.aux_loss_weight
+                                      ).astype(jnp.float32)}
+        return (y[:, 0, :] if squeeze else y), new_state
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+def moe_sharding_rule(path, leaf):
+    """Estimator ``param_sharding_rules`` entry: shard expert-major MoE
+    parameter blocks over the ``expert`` mesh axis. Matches the LEAF key
+    exactly — substring matching over the joined path would capture
+    unrelated params whose names merely contain e.g. ``w_out``."""
+    from jax.sharding import PartitionSpec as P
+    leaf_key = str(getattr(path[-1], "key", path[-1])) if path else ""
+    if leaf_key in ("w_in", "w_out", "b_in", "b_out") and leaf.ndim >= 2:
+        return P(EXPERT_AXIS, *([None] * (leaf.ndim - 1)))
+    return None

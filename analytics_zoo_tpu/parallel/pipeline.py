@@ -1,0 +1,132 @@
+"""Pipeline parallelism (GPipe-style microbatch streaming).
+
+The reference has no pipeline parallelism (SURVEY §5); this completes the
+mesh-axis set. TPU-native design: one stage per device along a ``pipe``
+mesh axis, activations hop stage→stage via ``lax.ppermute`` inside a
+``lax.scan`` over ticks — the classic SPMD pipeline from the scaling
+playbook. With ``M`` microbatches and ``P`` stages the schedule runs
+``M + P - 1`` ticks; bubble fraction ``(P-1)/(M+P-1)`` shrinks as M grows.
+
+Differentiable end to end: scan + ppermute autodiff gives the reverse
+pipeline (grads hop backwards) for free — no hand-written backward schedule.
+
+Usage (under ``shard_map`` over the ``pipe`` axis, stage-stacked params
+sharded on their leading axis)::
+
+    fn = shard_map(partial(pipeline_apply, stage_fn, n_microbatches=M),
+                   mesh=mesh,
+                   in_specs=(P("pipe"), P(None)), out_specs=P(None))
+    y = fn(stacked_params, x)   # x: [batch, d]; y: [batch, d_out]
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PIPE_AXIS = "pipe"
+
+
+def stack_stage_params(per_stage_params) -> Any:
+    """[stage0_tree, stage1_tree, ...] → one tree with a leading stage axis
+    (shard it over the ``pipe`` axis)."""
+    return jax.tree_util.tree_map(lambda *leaves: jnp.stack(leaves),
+                                  *per_stage_params)
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stage_params: Any, x: jax.Array,
+                   n_microbatches: int = 4,
+                   axis_name: str = PIPE_AXIS) -> jax.Array:
+    """Per-shard body: run ``x [batch, ...]`` through the stage pipeline.
+
+    ``stage_params`` is this device's slice of the stage-stacked tree (a
+    leading axis of size 1, from sharding the stage axis over ``pipe``).
+    Every stage must preserve the activation SHAPE (classic GPipe constraint
+    for the rotating buffer); project before/after the pipelined trunk if
+    widths differ.
+    """
+    p = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    if leaves and leaves[0].shape[0] != 1:
+        raise ValueError(
+            f"pipeline_apply expects ONE stage per device; this shard holds "
+            f"{leaves[0].shape[0]} stages — the stage count must equal the "
+            f"'{axis_name}' mesh axis size")
+    local_params = jax.tree_util.tree_map(lambda l: l[0], stage_params)
+    m = n_microbatches
+    batch = x.shape[0]
+    if batch % m:
+        raise ValueError(f"batch {batch} not divisible by "
+                         f"n_microbatches {m}")
+    mb = batch // m
+    micro = x.reshape(m, mb, *x.shape[1:])
+
+    # probe the output shape (same as input by contract); the initial carry
+    # must already carry the device-varying type scan requires under
+    # shard_map (the ppermute makes later carries varying)
+    def _vary(a):
+        try:
+            return lax.pcast(a, (axis_name,), to="varying")
+        except (AttributeError, TypeError):
+            try:
+                return lax.pvary(a, axis_name)  # older spelling
+            except AttributeError:  # oldest: multiply by a varying zero
+                return a + jnp.zeros((), a.dtype) * lax.axis_index(axis_name)
+    buf0 = _vary(jnp.zeros_like(micro[0]))
+    out_acc0 = _vary(jnp.zeros((m,) + micro[0].shape, micro[0].dtype))
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def tick(carry, t):
+        buf, out_acc = carry
+        # stage 0 ingests microbatch t (while t < m); later stages consume
+        # the activation that just hopped in from the previous stage
+        feed = micro[jnp.minimum(t, m - 1)]
+        inp = jnp.where(stage == 0, feed, buf)
+        out = stage_fn(local_params, inp)
+        # the LAST stage's output for tick t is microbatch t-(p-1)
+        out_idx = t - (p - 1)
+        is_valid = jnp.logical_and(stage == p - 1, out_idx >= 0)
+        updated = lax.dynamic_update_index_in_dim(
+            out_acc, out, jnp.maximum(out_idx, 0), 0)
+        out_acc = jnp.where(is_valid, updated, out_acc)
+        buf = lax.ppermute(out, axis_name, perm)
+        return (buf, out_acc), None
+
+    (_, out_acc), _ = lax.scan(tick, (buf0, out_acc0),
+                               jnp.arange(m + p - 1))
+    # every device returns the same logical result: broadcast the last
+    # stage's accumulator around the ring so out_specs can be replicated
+    out_acc = lax.psum(
+        jnp.where(stage == p - 1, out_acc, jnp.zeros_like(out_acc)),
+        axis_name)
+    return out_acc.reshape(batch, *out_acc.shape[2:])
+
+
+def gpipe(mesh, stage_fn: Callable, per_stage_params,
+          n_microbatches: int = 4, axis_name: str = PIPE_AXIS):
+    """Global entry: returns ``(stacked_params, fn)`` where ``fn(params, x)``
+    runs the pipelined forward over ``mesh[axis_name]`` and is fully
+    differentiable (use inside a loss under ``jax.grad``)."""
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n_stages = len(per_stage_params)
+    axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    if n_stages != axis_size:
+        raise ValueError(f"{n_stages} stages but the '{axis_name}' mesh "
+                         f"axis has {axis_size} devices (one stage each)")
+    stacked = stack_stage_params(per_stage_params)
+    fn = shard_map(
+        partial(pipeline_apply, stage_fn, n_microbatches=n_microbatches,
+                axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(axis_name), stacked),
+                  P()),
+        out_specs=P())
+    return stacked, fn
