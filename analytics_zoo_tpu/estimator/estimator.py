@@ -182,28 +182,33 @@ class Estimator:
         # weight-decay terms can't drift them either)
         frozen = frozenset(getattr(model, "frozen_layers", ()) or ())
 
+        from ..keras.engine import AUX_LOSS_KEY
+
+        def fold_aux(loss, new_state):
+            # the AUX_LOSS_KEY state contract: layers (MoE router balance,
+            # activation regularizers...) publish scalar penalties in their
+            # state; they join the objective here — on BOTH the model.call
+            # and the direct-loss (capture) paths
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                    new_state)[0]:
+                if path and str(getattr(path[-1], "key", "")) == AUX_LOSS_KEY:
+                    loss = loss + leaf
+            return loss
+
         def train_step(params, opt_state, model_state, rng, x, y):
             def compute_loss(p):
                 if frozen:
                     p = {k: jax.lax.stop_gradient(v) if k in frozen else v
                          for k, v in p.items()}
                 if direct is not None:
-                    return direct(p, model_state, rng, x, y)
+                    loss, new_state = direct(p, model_state, rng, x, y)
+                    return fold_aux(loss, new_state), new_state
                 y_pred, new_state = model.call(p, model_state, cast(x),
                                                training=True, rng=rng)
                 # loss in float32 regardless of activation dtype
                 y_pred = jax.tree_util.tree_map(
                     lambda t: t.astype(jnp.float32), y_pred)
-                loss = loss_fn(y, y_pred)
-                # the `__aux_loss__` state contract: layers (MoE router
-                # balance, activation regularizers...) publish scalar
-                # penalties in their state; they join the objective here
-                for path, leaf in jax.tree_util.tree_flatten_with_path(
-                        new_state)[0]:
-                    if path and str(getattr(path[-1], "key", "")
-                                    ) == "__aux_loss__":
-                        loss = loss + leaf
-                return loss, new_state
+                return fold_aux(loss_fn(y, y_pred), new_state), new_state
 
             (loss, new_state), grads = jax.value_and_grad(
                 compute_loss, has_aux=True)(params)

@@ -29,6 +29,11 @@ import jax.numpy as jnp
 import numpy as np
 
 Shape = Tuple[Optional[int], ...]
+
+# state-contract key: layers publish scalar penalties (MoE router balance,
+# activation regularizers, ...) under this key in their returned state; the
+# Estimator adds them to the training objective
+AUX_LOSS_KEY = "__aux_loss__"
 _name_counters: Dict[str, "itertools.count"] = defaultdict(lambda: itertools.count(1))
 
 

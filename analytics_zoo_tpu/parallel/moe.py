@@ -17,22 +17,22 @@ expert axis on the mesh.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..keras import initializers
-from ..keras.engine import Layer
+from ..keras.engine import AUX_LOSS_KEY, Layer
 
 EXPERT_AXIS = "expert"
 
 
 class MoE(Layer):
     """Switch-style MoE feed-forward block: ``y = combine(expert_ffn(
-    dispatch(x)))`` with a load-balancing auxiliary loss folded into the
-    output via a straight-through penalty term.
+    dispatch(x)))`` with a load-balancing auxiliary loss published through
+    the ``AUX_LOSS_KEY`` state contract (the Estimator adds it to the
+    objective with a fixed weight).
 
     Input ``[batch, seq, d]`` (or ``[batch, d]``); each token routes to its
     top-1 expert, subject to ``capacity_factor`` (tokens over capacity are
@@ -71,7 +71,7 @@ class MoE(Layer):
         }
         # the load-balance loss travels through state under the generic
         # `__aux_loss__` contract: the Estimator adds it to the objective
-        return params, {"__aux_loss__": jnp.zeros((), jnp.float32)}
+        return params, {AUX_LOSS_KEY: jnp.zeros((), jnp.float32)}
 
     def call(self, params, state, inputs, *, training=False, rng=None):
         from ..keras.layers.core import get_activation
@@ -92,6 +92,10 @@ class MoE(Layer):
         grouped = flat.reshape(g, gsz, d)
         cap = max(1, int(self.capacity_factor * gsz / e))
 
+        # alignment pad rows must neither consume expert capacity nor
+        # count in the balance statistics
+        valid = (jnp.arange(g * gsz) < n_tok).reshape(g, gsz)
+
         logits = jnp.einsum("gtd,de->gte", grouped,
                             params["gate"].astype(flat.dtype)
                             ).astype(jnp.float32)
@@ -99,7 +103,8 @@ class MoE(Layer):
         expert_idx = jnp.argmax(probs, axis=-1)            # [g, t]
         gate = jnp.max(probs, axis=-1)                     # [g, t]
 
-        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+        onehot = (jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+                  * valid.astype(jnp.float32)[..., None])
         # position of each token within its expert's per-group queue
         pos = (jnp.cumsum(onehot, axis=1) - 1.0) * onehot  # [g, t, e]
         pos_in_expert = jnp.sum(pos, axis=-1).astype(jnp.int32)
@@ -129,11 +134,13 @@ class MoE(Layer):
         # switch-transformer load-balance loss: e * Σ_e (frac_tokens_e *
         # frac_probs_e), averaged over groups; the Estimator consumes it
         # from state via the `__aux_loss__` contract
-        frac_tokens = jnp.mean(onehot, axis=1)             # [g, e]
-        frac_probs = jnp.mean(probs, axis=1)
+        denom = jnp.maximum(jnp.sum(valid, axis=1, keepdims=True), 1.0)
+        frac_tokens = jnp.sum(onehot, axis=1) / denom      # [g, e]
+        vprobs = probs * valid.astype(probs.dtype)[..., None]
+        frac_probs = jnp.sum(vprobs, axis=1) / denom
         aux = e * jnp.mean(jnp.sum(frac_tokens * frac_probs, axis=-1))
-        new_state = {"__aux_loss__": (aux * self.aux_loss_weight
-                                      ).astype(jnp.float32)}
+        new_state = {AUX_LOSS_KEY: (aux * self.aux_loss_weight
+                                    ).astype(jnp.float32)}
         return (y[:, 0, :] if squeeze else y), new_state
 
     def compute_output_shape(self, input_shape):

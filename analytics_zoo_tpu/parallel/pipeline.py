@@ -20,7 +20,7 @@ sharded on their leading axis)::
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,80 @@
+"""Expert + pipeline parallelism on a simulated multi-chip mesh.
+
+Runs everywhere: with no real multi-chip hardware it provisions virtual CPU
+devices, exactly how CI validates the sharded paths. Shows the two newest
+mesh axes — a switch-MoE block training over a (data, expert) mesh, and a
+GPipe pipeline streaming microbatches over a `pipe` axis.
+"""
+import argparse
+import os
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--real", action="store_true",
+                    help="use the attached accelerators instead of a "
+                         "simulated CPU mesh (needs >= --devices chips)")
+    args = ap.parse_args()
+
+    import jax
+    if not args.real:  # simulate the mesh on virtual CPU devices; this must
+        # happen before ANY backend initialization
+        os.environ["XLA_FLAGS"] = " ".join(
+            [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+            + [f"--xla_force_host_platform_device_count={args.devices}"])
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from analytics_zoo_tpu.estimator import Estimator
+    from analytics_zoo_tpu.feature import FeatureSet
+    from analytics_zoo_tpu.keras import Sequential, objectives, optimizers
+    from analytics_zoo_tpu.keras.layers import Dense
+    from analytics_zoo_tpu.parallel import MoE, gpipe, moe_sharding_rule
+
+    n = jax.device_count()
+    ep = next((c for c in (4, 2) if n % c == 0), 1)
+    mesh = Mesh(np.asarray(jax.devices()).reshape(n // ep, ep),
+                ("data", "expert"))
+
+    # --- expert parallelism: MoE classifier over (data, expert) ----------
+    model = Sequential([Dense(16, name="proj"),
+                        MoE(num_experts=ep, hidden_dim=32, name="moe"),
+                        Dense(2, activation="softmax", name="head")])
+    est = Estimator(model=model,
+                    loss_fn=objectives.get("sparse_categorical_crossentropy"),
+                    optimizer=optimizers.Adam(1e-2), mesh=mesh,
+                    param_sharding_rules=[moe_sharding_rule])
+    rs = np.random.RandomState(0)
+    x = rs.randn(32 * n, 8, 16).astype(np.float32)
+    y = (x.mean(axis=-1) > 0).astype(np.float32)
+    with mesh:
+        result = est.train(FeatureSet.from_ndarrays(x, y),
+                           batch_size=8 * n, epochs=2 if args.smoke else 8)
+    print(f"MoE over dp={n // ep} x ep={ep}: loss "
+          f"{result['loss_history'][-1]:.4f}; expert table sharding: "
+          f"{est.params['moe']['w_in'].sharding.spec}")
+
+    # --- pipeline parallelism: GPipe microbatch streaming ----------------
+    pipe_mesh = Mesh(np.asarray(jax.devices()), ("pipe",))
+    rngs = jax.random.split(jax.random.PRNGKey(0), n)
+    stages = [{"w": jax.random.normal(r, (16, 16)) * 0.3,
+               "b": jnp.zeros(16)} for r in rngs]
+
+    def stage_fn(p, a):
+        return jnp.tanh(a @ p["w"] + p["b"])
+
+    stacked, fn = gpipe(pipe_mesh, stage_fn, stages, n_microbatches=4)
+    xb = jax.random.normal(jax.random.PRNGKey(1), (32, 16))
+    grads = jax.jit(jax.grad(lambda s: jnp.sum(fn(s, xb) ** 2)))(stacked)
+    print(f"pipeline over {n} stages: fwd+bwd ok, grad norm "
+          f"{float(jnp.linalg.norm(grads['w'])):.3f}, bubble fraction "
+          f"{(n - 1) / (4 + n - 1):.2f}")
+
+
+if __name__ == "__main__":
+    main()

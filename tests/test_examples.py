@@ -18,6 +18,7 @@ EXAMPLES = [
     "examples/serving/serving_example.py",
     "examples/zouwu/forecast_example.py",
     "examples/cluster/pod_train.py",
+    "examples/parallel/moe_pipeline_example.py",
 ]
 
 

@@ -118,15 +118,14 @@ class TestMoE:
         np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
                                    rtol=1e-4, atol=1e-5)
 
+
+class TestPipeline:
     def test_stage_count_mismatch_rejected(self):
-        from analytics_zoo_tpu.parallel.pipeline import gpipe
         mesh = Mesh(np.asarray(jax.devices()[:4]), ("pipe",))
         stages = [{"w": jnp.eye(4), "b": jnp.zeros(4)}] * 8
         with pytest.raises(ValueError, match="stages"):
             gpipe(mesh, lambda p, x: x, stages)
 
-
-class TestPipeline:
     def _stages(self, p=4, d=8):
         rngs = jax.random.split(jax.random.PRNGKey(4), p)
         return [{"w": jax.random.normal(r, (d, d)) * 0.3,
