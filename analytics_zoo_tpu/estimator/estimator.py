@@ -17,6 +17,11 @@ jitted train step over a device mesh:
   (``Topology.scala:1180-1262``) is reproduced: on failure, reload the newest
   checkpoint within a retry budget (``failure.retry_times`` /
   ``failure.retry_interval_s`` config, ≙ ``bigdl.failure.retryTimes``).
+- the reference's straggler mitigation (``dropPercentage`` — drop the
+  slowest tasks' results per iteration, ``Topology.scala:1096-1099``) is
+  DESIGNED AWAY: synchronous SPMD over ICI has no per-worker task results to
+  drop — chips run one lock-step program, and a slow/failed chip surfaces as
+  a step failure handled by the elastic retry above.
 - TensorBoard scalars Loss/LearningRate/Throughput per iteration + validation
   scalars per metric (``Topology.scala:206-238``).
 """
