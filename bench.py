@@ -334,7 +334,10 @@ def bench_input_pipeline(batch_size: int = 256, steps: int = 30):
         detail={"batch_size": batch_size, "image": "224x224x3",
                 "device_normalize_uint8_transfer": round(dev_rate, 1),
                 "host_normalize_f32_transfer": round(host_rate, 1),
-                "includes": "shuffle+gather+device_put+normalize"})
+                "includes": "shuffle+gather+device_put+normalize",
+                "note": "bench-host bound: absolute rate tracks the TPU "
+                        "tunnel's transfer bandwidth, which varies run to "
+                        "run; the uint8-vs-f32 RATIO is the stable signal"})
 
 
 def bench_serving(requests: int = 512, batch_size: int = 64):
