@@ -95,19 +95,55 @@ def encode_example(features: Dict[str, Any]) -> bytes:
 
 
 class TFRecordWriter:
-    """Write framed records (CRC32C), same framing as the event writer."""
+    """Write framed records (CRC32C), same framing as the event writer.
+
+    Framing + checksums run in the native library when available (the CRC
+    is the hot loop for large payloads); Python fallback otherwise.
+    """
 
     def __init__(self, path: str):
-        self._f = open(path, "wb")
+        self._handle = None
+        self._f = None
+        lib = _NativeReader.lib()
+        if lib is not None and hasattr(lib, "ztw_open"):
+            self._lib = lib
+            self._handle = lib.ztw_open(path.encode())
+        if self._handle is None:
+            self._f = open(path, "wb")
 
     def write(self, record: bytes) -> None:
+        if self._handle is not None:
+            if self._lib.ztw_write(self._handle, record, len(record)) != 0:
+                raise IOError("native TFRecord write failed (disk full?)")
+            return
         self._f.write(frame_record(record))
 
     def write_example(self, features: Dict[str, Any]) -> None:
         self.write(encode_example(features))
 
+    def flush(self) -> None:
+        if self._handle is not None:
+            if self._lib.ztw_flush(self._handle) != 0:
+                raise IOError("TFRecord flush failed (disk full?)")
+        elif self._f is not None:
+            self._f.flush()
+
     def close(self) -> None:
-        self._f.close()
+        if self._handle is not None:
+            handle, self._handle = self._handle, None
+            if self._lib.ztw_close(handle) != 0:
+                raise IOError(
+                    "TFRecord close failed — the file may be truncated")
+        elif self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __del__(self):
+        # refcount cleanup must not leak the native FILE* or its buffer
+        try:
+            self.close()
+        except Exception:
+            pass  # destructors must not raise; use close() to see errors
 
     def __enter__(self):
         return self
@@ -151,6 +187,17 @@ class _NativeReader:
                 lib.ztr_total_bytes.argtypes = [ctypes.c_void_p, ctypes.c_long,
                                                 ctypes.c_long]
                 lib.ztr_close.argtypes = [ctypes.c_void_p]
+                if hasattr(lib, "ztw_open"):  # writer half (newer builds)
+                    lib.ztw_open.restype = ctypes.c_void_p
+                    lib.ztw_open.argtypes = [ctypes.c_char_p]
+                    lib.ztw_write.restype = ctypes.c_int
+                    lib.ztw_write.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_char_p,
+                                              ctypes.c_uint64]
+                    lib.ztw_flush.restype = ctypes.c_int
+                    lib.ztw_flush.argtypes = [ctypes.c_void_p]
+                    lib.ztw_close.restype = ctypes.c_int
+                    lib.ztw_close.argtypes = [ctypes.c_void_p]
                 cls._lib = lib
         return cls._lib
 

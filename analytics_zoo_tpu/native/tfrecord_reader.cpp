@@ -169,3 +169,55 @@ void ztr_close(void* h) {
 }
 
 }  // extern "C"
+
+// ---- writer: buffered framed-record output (CRC32C in native code) ----
+
+#include <cstdio>
+
+namespace {
+struct Writer {
+  FILE* f = nullptr;
+};
+}  // namespace
+
+extern "C" {
+
+void* ztw_open(const char* path) {
+  init_table();
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return nullptr;
+  auto* w = new Writer();
+  w->f = f;
+  setvbuf(f, nullptr, _IOFBF, 1 << 20);  // 1MB buffered
+  return w;
+}
+
+// Frame one record: u64 len | masked_crc(len) | data | masked_crc(data).
+int ztw_write(void* h, const uint8_t* data, uint64_t len) {
+  auto* w = static_cast<Writer*>(h);
+  uint8_t header[8];
+  std::memcpy(header, &len, 8);
+  uint32_t hcrc = masked_crc(header, 8);
+  uint32_t dcrc = masked_crc(data, len);
+  if (std::fwrite(header, 1, 8, w->f) != 8) return -1;
+  if (std::fwrite(&hcrc, 1, 4, w->f) != 4) return -1;
+  if (len && std::fwrite(data, 1, len, w->f) != len) return -1;
+  if (std::fwrite(&dcrc, 1, 4, w->f) != 4) return -1;
+  return 0;
+}
+
+int ztw_flush(void* h) {
+  return std::fflush(static_cast<Writer*>(h)->f);
+}
+
+// Returns 0 on success; nonzero if the final flush/close failed (ENOSPC
+// etc.) — callers must surface this, a truncated file must not look ok.
+int ztw_close(void* h) {
+  auto* w = static_cast<Writer*>(h);
+  int rc = 0;
+  if (w->f) rc = std::fclose(w->f);
+  delete w;
+  return rc;
+}
+
+}  // extern "C"

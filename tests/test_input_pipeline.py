@@ -195,3 +195,31 @@ class TestStrings:
         np.testing.assert_array_equal(
             np.asarray(fs.features),
             [[1, 2, 0], [2, 3, 4], [1, 0, 0]])
+
+
+class TestNativeWriter:
+    def test_native_writer_roundtrips_with_all_readers(self, tmp_path):
+        """Records framed by the C++ writer must read back through the
+        native reader, the Python reader, AND tensorboard's parser."""
+        path = str(tmp_path / "nw.tfrecord")
+        payloads = [b"x" * n for n in (0, 1, 7, 8, 9, 1000)]
+        with TFRecordWriter(path) as w:
+            for p in payloads:
+                w.write(p)
+        assert [open_tfrecord(path).read(i)
+                for i in range(len(payloads))] == payloads
+        assert _PythonReader(path, verify_crc=True).read_batch(
+            0, len(payloads)) == payloads
+        tb = pytest.importorskip("tensorboard")
+        del tb
+        from tensorboard.backend.event_processing.event_file_loader import (
+            RawEventFileLoader)
+        assert list(RawEventFileLoader(path).Load()) == payloads
+
+    def test_writer_used_native_path(self, tmp_path):
+        if _NativeReader.lib() is None or not hasattr(
+                _NativeReader.lib(), "ztw_open"):
+            pytest.skip("native writer unavailable")
+        w = TFRecordWriter(str(tmp_path / "n.tfrecord"))
+        assert w._handle is not None  # really on the C++ path
+        w.close()
