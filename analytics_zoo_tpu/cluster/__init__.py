@@ -3,3 +3,4 @@
 re-designed for TPU pods on ``jax.distributed``)."""
 from .launcher import (  # noqa: F401
     PodLauncher, PodLaunchError, WorkerResult, run_pod)
+from .torch_trainer import TorchTrainer  # noqa: F401

@@ -86,6 +86,13 @@ class PodLauncher:
         logs: List[str] = []
         base_env = dict(os.environ)
         base_env.update(self.env)
+        # workers must resolve imports the way the driver does (repo
+        # checkouts on sys.path, the user's creator modules, ...) — same
+        # contract as Ray's runtime-env path propagation
+        inherited = [p for p in base_env.get("PYTHONPATH", "").split(os.pathsep)
+                     if p]
+        base_env["PYTHONPATH"] = os.pathsep.join(
+            dict.fromkeys([p for p in sys.path if p] + inherited))
         base_env.update({
             "ZOO_TPU_COORD": coord,
             "ZOO_TPU_NPROCS": str(self.num_processes),

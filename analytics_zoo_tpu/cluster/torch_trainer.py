@@ -1,0 +1,153 @@
+"""Data-parallel training of a *foreign-framework* (PyTorch) model across pod
+workers — the role MXNet-on-Ray plays in the reference.
+
+The reference's ``MXNetTrainer`` (``pyzoo/zoo/ray/mxnet/mxnet_trainer.py:26``,
+``mxnet_runner.py:1``) takes creator functions (model/optimizer/data), spawns
+Ray actors as workers, and runs synchronous data-parallel training with a
+KVStore. The TPU-native equivalent keeps the creator-function contract but
+rides this framework's own orchestration: :class:`~.launcher.PodLauncher`
+spawns and guards the workers (parent-death guard, fail-fast reaping), and
+gradient sync is a ``torch.distributed`` gloo all-reduce — host-CPU training
+for models that live outside the JAX/XLA world, coordinated by the same pod
+machinery the JAX path uses.
+
+Creator functions must be picklable (module-level functions) — the same
+contract Ray's cloudpickle imposes on the reference's creators.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+from typing import Any, Callable, Dict, List, Optional
+
+from .launcher import PodLauncher, _free_port
+
+__all__ = ["TorchTrainer"]
+
+
+def _worker(spec_path: str) -> int:
+    """Pod worker: rank/world come from the launcher's env; rendezvous over
+    gloo; synchronous data-parallel SGD with a flat-bucket all-reduce."""
+    import torch
+    import torch.distributed as dist
+
+    with open(spec_path, "rb") as f:
+        spec = pickle.load(f)
+    rank = int(os.environ["ZOO_TPU_PROC_ID"])
+    world = int(os.environ["ZOO_TPU_NPROCS"])
+    # explicit tcp:// rendezvous: an inherited MASTER_ADDR/MASTER_PORT (e.g.
+    # from a SLURM/torchrun parent) must not override the port this launch
+    # allocated
+    dist.init_process_group(
+        "gloo",
+        init_method=f"tcp://{spec['master_addr']}:{spec['master_port']}",
+        rank=rank, world_size=world)
+    try:
+        torch.manual_seed(spec["seed"])
+        model = spec["model_fn"]()
+        # every rank starts from rank 0's init so replicas are identical
+        for p in model.parameters():
+            dist.broadcast(p.data, src=0)
+        optimizer = spec["optimizer_fn"](model)
+        loss_fn = spec["loss_fn"]()
+        history: List[float] = []
+        for _ in range(spec["epochs"]):
+            data = spec["data_fn"](rank, world)
+            total, count = 0.0, 0
+            for x, y in data:
+                x = torch.as_tensor(x)
+                y = torch.as_tensor(y)
+                optimizer.zero_grad()
+                loss = loss_fn(model(x), y)
+                loss.backward()
+                # one flat bucket: a single collective per step, not one per
+                # parameter (the KVStore-push/pull role)
+                grads = [p.grad for p in model.parameters()
+                         if p.grad is not None]
+                flat = torch.cat([g.reshape(-1) for g in grads])
+                dist.all_reduce(flat, op=dist.ReduceOp.SUM)
+                flat /= world
+                off = 0
+                for g in grads:
+                    n = g.numel()
+                    g.copy_(flat[off:off + n].reshape(g.shape))
+                    off += n
+                optimizer.step()
+                total += float(loss.detach())
+                count += 1
+            history.append(total / max(count, 1))
+        if rank == 0:
+            torch.save(model.state_dict(), spec["state_path"])
+            with open(spec["result_path"], "w") as f:
+                json.dump({"loss_history": history}, f)
+    finally:
+        dist.destroy_process_group()
+    return 0
+
+
+class TorchTrainer:
+    """Synchronous data-parallel trainer for a PyTorch model over pod workers.
+
+    Args:
+      model_fn: ``() -> torch.nn.Module`` (module-level function).
+      optimizer_fn: ``(model) -> torch.optim.Optimizer``.
+      loss_fn: ``() -> callable(pred, target)``.
+      data_fn: ``(rank, world_size) -> iterable of (x, y)`` — each worker's
+        shard of the data, re-invoked at every epoch boundary.
+      num_workers: pod size.
+      seed: broadcast-identical init seed.
+    """
+
+    def __init__(self, model_fn: Callable[[], Any],
+                 optimizer_fn: Callable[[Any], Any],
+                 loss_fn: Callable[[], Any],
+                 data_fn: Callable[[int, int], Any],
+                 num_workers: int = 2, seed: int = 0,
+                 log_dir: Optional[str] = None):
+        self.spec = dict(model_fn=model_fn, optimizer_fn=optimizer_fn,
+                         loss_fn=loss_fn, data_fn=data_fn, seed=seed)
+        self.num_workers = num_workers
+        self.log_dir = log_dir
+        self._state_dict: Optional[Dict[str, Any]] = None
+        self.loss_history: List[float] = []
+
+    def train(self, epochs: int = 1,
+              timeout: Optional[float] = None) -> List[float]:
+        """Run ``epochs`` over the pod; returns rank-0's per-epoch mean loss.
+        The trained weights are available as :meth:`state_dict` after."""
+        workdir = tempfile.mkdtemp(prefix="zoo_torch_pod_")
+        try:
+            spec = dict(self.spec, epochs=epochs,
+                        master_addr="127.0.0.1", master_port=_free_port(),
+                        state_path=os.path.join(workdir, "state.pt"),
+                        result_path=os.path.join(workdir, "result.json"))
+            spec_path = os.path.join(workdir, "spec.pkl")
+            with open(spec_path, "wb") as f:
+                pickle.dump(spec, f)
+            # platform=cpu: these workers must not contend for the TPU chip,
+            # and N>1 processes cannot share it anyway
+            launcher = PodLauncher(num_processes=self.num_workers,
+                                   platform="cpu", log_dir=self.log_dir)
+            launcher.run("analytics_zoo_tpu.cluster.torch_trainer:_worker",
+                         args=[spec_path], timeout=timeout)
+            import torch
+            self._state_dict = torch.load(spec["state_path"],
+                                          weights_only=True)
+            with open(spec["result_path"]) as f:
+                self.loss_history = json.load(f)["loss_history"]
+            return self.loss_history
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    def state_dict(self) -> Dict[str, Any]:
+        if self._state_dict is None:
+            raise RuntimeError("train() has not completed")
+        return self._state_dict
+
+    def load_into(self, model) -> Any:
+        """Load the trained weights into a freshly built torch module."""
+        model.load_state_dict(self.state_dict())
+        return model

@@ -116,6 +116,8 @@ class TFRecordWriter:
             if self._lib.ztw_write(self._handle, record, len(record)) != 0:
                 raise IOError("native TFRecord write failed (disk full?)")
             return
+        if self._f is None:
+            raise ValueError("write to a closed TFRecordWriter")
         self._f.write(frame_record(record))
 
     def write_example(self, features: Dict[str, Any]) -> None:

@@ -326,6 +326,8 @@ class _TrainableMixin:
 
     def fit(self, x, y=None, batch_size=32, nb_epoch=1, validation_data=None,
             featureset=None, **kwargs):
+        # keras-2 callers say epochs=, keras-1 (the reference) says nb_epoch=
+        nb_epoch = kwargs.pop("epochs", nb_epoch)
         est = self.get_estimator()
         for attr, setter in (("_tb", "set_tensorboard"), ("_ckpt", "set_checkpoint"),
                              ("_clip", "set_gradient_clipping")):

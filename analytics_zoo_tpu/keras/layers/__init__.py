@@ -5,7 +5,7 @@ from .embedding import (  # noqa: F401
     Embedding, SparseDense, SparseEmbedding, WordEmbedding)
 from .norm import BatchNormalization, LayerNormalization  # noqa: F401
 from .recurrent import (  # noqa: F401
-    GRU, LSTM, Bidirectional, ConvLSTM2D, SimpleRNN)
+    GRU, LSTM, Bidirectional, ConvLSTM2D, ConvLSTM3D, SimpleRNN)
 from .conv import (  # noqa: F401
     AveragePooling2D, Conv1D, Conv2D, Convolution1D, Convolution2D,
     GlobalAveragePooling1D, GlobalAveragePooling2D, GlobalMaxPooling1D,
@@ -20,7 +20,7 @@ from .conv_extended import (  # noqa: F401
     ZeroPadding3D)
 from .advanced import (  # noqa: F401
     AddConstant, BinaryThreshold, CAdd, CMul, ELU, Exp, Expand, ExpandDim,
-    GaussianDropout, GaussianNoise, GaussianSampler, HardShrink, HardTanh,
+    GaussianDropout, GaussianNoise, GaussianSampler, GetShape, HardShrink, HardTanh,
     Highway, Identity, LeakyReLU, Log, Masking, Max, MaxoutDense, Mul,
     MulConstant, Narrow, Negative, Power, PReLU, RReLU, Scale, SelectTable,
     Softmax, SoftShrink, SpatialDropout1D, SpatialDropout2D, SpatialDropout3D,

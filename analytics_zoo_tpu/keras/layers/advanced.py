@@ -559,3 +559,15 @@ class Scale(Layer):
     def call(self, params, state, inputs, *, training=False, rng=None):
         return (inputs * params["weight"].astype(inputs.dtype)
                 + params["bias"].astype(inputs.dtype)), state
+
+
+class GetShape(Layer):
+    """Returns the (static) shape of the input as a 1-D int32 tensor
+    (reference ``GetShape.scala``). Under jit shapes are static, so this is a
+    compile-time constant — free on device."""
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return jnp.asarray(inputs.shape, dtype=jnp.int32), state
+
+    def compute_output_shape(self, input_shape):
+        return (len(input_shape),)

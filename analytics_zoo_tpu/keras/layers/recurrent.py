@@ -301,3 +301,80 @@ class ConvLSTM2D(Layer):
         if self.return_sequences:
             return (n, t, ho, wo, self.filters)
         return (n, ho, wo, self.filters)
+
+
+class ConvLSTM3D(Layer):
+    """Convolutional LSTM over [B, T, D, H, W, C] volumes (reference
+    ``ConvLSTM3D.scala``). Same fused-gate design as :class:`ConvLSTM2D`,
+    with a single 3D conv producing all four gates per scan step."""
+
+    def __init__(self, nb_filter: int, nb_kernel: int, subsample=(1, 1, 1),
+                 border_mode: str = "same", return_sequences: bool = False,
+                 go_backwards: bool = False, init="glorot_uniform",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = nb_filter
+        self.kernel_size = (nb_kernel,) * 3 if isinstance(nb_kernel, int) \
+            else tuple(nb_kernel)
+        self.strides = (subsample,) * 3 if isinstance(subsample, int) \
+            else tuple(subsample)
+        if border_mode != "same":
+            raise ValueError("ConvLSTM3D supports border_mode='same' only")
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+        self.init = initializers.get(init)
+
+    def build(self, rng, input_shape):
+        # input_shape: (B, T, D, H, W, C)
+        cin = input_shape[-1]
+        kd, kh, kw = self.kernel_size
+        u = self.filters
+        kernel = self.init(rng, (kd, kh, kw, cin + u, 4 * u))
+        bias = jnp.zeros((4 * u,)).at[u:2 * u].set(1.0)  # forget bias 1
+        return {"kernel": kernel, "bias": bias}, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        from jax import lax
+        u = self.filters
+        kernel = params["kernel"].astype(inputs.dtype)
+        bias = params["bias"].astype(inputs.dtype)
+        B, T, D, H, W, C = inputs.shape
+        sd, sh, sw = self.strides
+        Do, Ho, Wo = -(-D // sd), -(-H // sh), -(-W // sw)
+
+        def step(carry, x_t):
+            h, c = carry
+            if (sd, sh, sw) != (1, 1, 1):
+                h_in = jnp.repeat(jnp.repeat(jnp.repeat(
+                    h, sd, axis=1), sh, axis=2), sw, axis=3)[:, :D, :H, :W]
+            else:
+                h_in = h
+            z = lax.conv_general_dilated(
+                jnp.concatenate([x_t, h_in], axis=-1), kernel,
+                window_strides=self.strides, padding="SAME",
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC")) + bias
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+
+        xs = jnp.swapaxes(inputs, 0, 1)  # [T, B, D, H, W, C]
+        if self.go_backwards:
+            xs = xs[::-1]
+        zeros = jnp.zeros((B, Do, Ho, Wo, u), inputs.dtype)
+        (h, c), ys = jax.lax.scan(step, (zeros, zeros), xs)
+        if self.go_backwards:
+            ys = ys[::-1]
+        if self.return_sequences:
+            return jnp.swapaxes(ys, 0, 1), state
+        return h, state
+
+    def compute_output_shape(self, input_shape):
+        n, t, d, h, w, _ = input_shape
+        sd, sh, sw = self.strides
+        do = None if d is None else -(-d // sd)
+        ho = None if h is None else -(-h // sh)
+        wo = None if w is None else -(-w // sw)
+        if self.return_sequences:
+            return (n, t, do, ho, wo, self.filters)
+        return (n, do, ho, wo, self.filters)

@@ -335,6 +335,24 @@ class TestConvLSTM:
         y2, _, _ = run_layer(ConvLSTM2D(6, 3, return_sequences=True), x)
         assert y2.shape == (2, 3, 8, 8, 6)
 
+    def test_conv_lstm3d_shapes_and_grad(self):
+        from analytics_zoo_tpu.keras.layers import ConvLSTM3D
+        x = np.random.RandomState(0).randn(2, 2, 4, 4, 4, 3).astype(np.float32)
+        layer = ConvLSTM3D(5, 3)
+        y, params, _ = run_layer(layer, x)
+        assert y.shape == (2, 4, 4, 4, 5)
+        assert params["kernel"].shape == (3, 3, 3, 8, 20)
+        y2, _, _ = run_layer(ConvLSTM3D(5, 3, return_sequences=True), x)
+        assert y2.shape == (2, 2, 4, 4, 4, 5)
+        g = jax.grad(lambda p: layer.call(p, {}, jnp.asarray(x))[0].sum())(
+            params)
+        assert g["kernel"].shape == params["kernel"].shape
+
+    def test_get_shape(self):
+        from analytics_zoo_tpu.keras.layers import GetShape
+        y, _, _ = run_layer(GetShape(), np.zeros((2, 3, 5), np.float32))
+        np.testing.assert_array_equal(np.asarray(y), [2, 3, 5])
+
     def test_conv_lstm_grad(self):
         x = jnp.ones((1, 2, 4, 4, 2))
         layer = ConvLSTM2D(3, 3)

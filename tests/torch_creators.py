@@ -1,0 +1,48 @@
+"""Module-level creator functions for the TorchTrainer tests (the pickled
+creator contract requires importable module-level functions — same constraint
+Ray's cloudpickle puts on the reference's MXNetTrainer creators)."""
+import numpy as np
+
+W_TRUE = np.array([[2.0], [-3.0]], dtype=np.float32)
+
+
+def make_model():
+    import torch
+    torch.manual_seed(7)
+    return torch.nn.Linear(2, 1)
+
+
+def make_optimizer(model):
+    import torch
+    return torch.optim.SGD(model.parameters(), lr=0.2)
+
+
+def make_loss():
+    import torch
+    return torch.nn.MSELoss()
+
+
+def make_data(rank, world):
+    rs = np.random.RandomState(100 + rank)  # disjoint shards per rank
+    x = rs.rand(64, 2).astype(np.float32)
+    y = x @ W_TRUE + 0.5
+    return [(x[i:i + 16], y[i:i + 16]) for i in range(0, 64, 16)]
+
+
+def _fixed_xy():
+    rs = np.random.RandomState(3)
+    x = rs.rand(32, 2).astype(np.float32)
+    y = (x @ W_TRUE).astype(np.float32)
+    return x, y
+
+
+def data_halves(rank, world):
+    x, y = _fixed_xy()
+    n = len(x) // world
+    lo = rank * n
+    return [(x[lo:lo + n], y[lo:lo + n])]
+
+
+def data_full(rank, world):
+    x, y = _fixed_xy()
+    return [(x, y)]
