@@ -126,20 +126,23 @@ class GANEstimator:
                 g_hist.append(float(g))
             pending.clear()
 
-        for _ in range(steps):
-            real, _ = next(feed)
-            self._ensure_initialized(real)
-            if self._step_fn is None:
-                self._step_fn = self._build_step()
-            self.rng, step_rng = jax.random.split(self.rng)
-            (self.g_params, self.d_params, self.g_opt_state, self.d_opt_state,
-             dl, gl) = self._step_fn(self.g_params, self.d_params,
-                                     self.g_opt_state, self.d_opt_state,
-                                     step_rng, real)
-            self.global_step += 1
-            pending.append((dl, gl))
-            if len(pending) >= drain_every:
-                drain()
+        try:
+            for _ in range(steps):
+                real, _ = next(feed)
+                self._ensure_initialized(real)
+                if self._step_fn is None:
+                    self._step_fn = self._build_step()
+                self.rng, step_rng = jax.random.split(self.rng)
+                (self.g_params, self.d_params, self.g_opt_state,
+                 self.d_opt_state, dl, gl) = self._step_fn(
+                    self.g_params, self.d_params, self.g_opt_state,
+                    self.d_opt_state, step_rng, real)
+                self.global_step += 1
+                pending.append((dl, gl))
+                if len(pending) >= drain_every:
+                    drain()
+        finally:
+            feed.close()  # train stops mid-iterator; stop the producer thread
         drain()
         return {"d_loss_history": d_hist, "g_loss_history": g_hist,
                 "iterations": self.global_step}

@@ -414,6 +414,10 @@ class Estimator:
                 state.epoch = self.epoch
                 state.iteration = self.global_step
                 continue
+            finally:
+                # epochs usually end by `break` with the feed still mid-epoch;
+                # stop its producer thread and release prefetched device batches
+                feed.close()
             state.epoch_finished = False
 
         if pending:

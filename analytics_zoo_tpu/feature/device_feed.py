@@ -1,42 +1,111 @@
 """Double-buffered host→device feed.
 
 The reference hides host→engine latency behind cached-RDD iterators and
-per-core replica threads; on TPU the equivalent is overlapping ``device_put``
-(async dispatch) with the previous step's compute. ``DeviceFeed`` keeps
-``prefetch`` batches in flight, each already sharded over the mesh's data
-axis, so the TPU never waits on the host (SURVEY.md §7 hard part (c)).
+per-core replica threads; on TPU the equivalent is overlapping host-side work
+(shuffle gather, transforms) and ``device_put`` (async dispatch) with the
+previous step's compute. ``DeviceFeed`` runs a background producer thread
+that keeps ``prefetch`` batches in flight, each already sharded over the
+mesh's data axis, so the TPU never waits on the host (SURVEY.md §7 hard
+part (c)).
 """
 from __future__ import annotations
 
-import collections
-from typing import Any, Iterator, Optional
+import queue
+import threading
+from typing import Any, Iterator, List, Optional
 
 from jax.sharding import Mesh
 
 from ..common.config import global_config
-from .preprocessing import Preprocessing
 from ..parallel.mesh import shard_batch
+
+_SENTINEL = object()
+
+
+def _put_until_stopped(q: "queue.Queue", stop: threading.Event,
+                       item: Any) -> bool:
+    """Blocking put that aborts when ``stop`` is set. True if delivered."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _produce(it: Iterator[Any], mesh: Mesh, q: "queue.Queue",
+             stop: threading.Event, errbox: List[BaseException]) -> None:
+    # module-level on purpose: the thread must NOT hold a reference to the
+    # DeviceFeed, or an abandoned feed could never be garbage-collected and
+    # its __del__-triggered stop would never fire
+    try:
+        for batch in it:
+            if not _put_until_stopped(q, stop, shard_batch(mesh, batch)):
+                return
+    except BaseException as e:  # surfaced on the consumer side
+        errbox.append(e)
+    finally:
+        _put_until_stopped(q, stop, _SENTINEL)
 
 
 class DeviceFeed:
+    """Iterate device-resident sharded batches from a host iterator.
+
+    A daemon producer thread pulls from ``host_iterator``, shards each batch
+    onto the mesh (``device_put`` dispatches asynchronously), and parks it in
+    a bounded queue of depth ``prefetch`` — so host gather/decode for batch
+    N+1..N+k overlaps the consumer's compute on batch N. The producer stops
+    at the end of the host iterator or when the feed is ``close()``d or
+    garbage-collected; a producer-side exception is re-raised on the consumer
+    thread at the point of ``next()``.
+    """
+
     def __init__(self, host_iterator: Iterator[Any], mesh: Mesh,
                  prefetch: Optional[int] = None):
-        self._it = host_iterator
-        self._mesh = mesh
-        depth = prefetch if prefetch is not None else global_config().get("data.prefetch")
-        self._depth = max(1, int(depth))
-        self._buffer: collections.deque = collections.deque()
+        depth = prefetch if prefetch is not None \
+            else global_config().get("data.prefetch")
+        self._queue: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._errbox: List[BaseException] = []
+        self._thread = threading.Thread(
+            target=_produce,
+            args=(host_iterator, mesh, self._queue, self._stop, self._errbox),
+            daemon=True, name="device-feed")
+        self._thread.start()
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        while len(self._buffer) < self._depth:
-            try:
-                batch = next(self._it)
-            except StopIteration:
-                break
-            self._buffer.append(shard_batch(self._mesh, batch))
-        if not self._buffer:
+        if self._stop.is_set():  # already exhausted or closed
             raise StopIteration
-        return self._buffer.popleft()
+        item = self._queue.get()
+        if item is _SENTINEL:
+            self._stop.set()
+            if self._errbox:
+                raise self._errbox[0]
+            raise StopIteration
+        return item
+
+    def _drain(self) -> None:
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+
+    def close(self) -> None:
+        """Stop the producer; safe to call more than once."""
+        self._stop.set()
+        self._drain()  # unblock a producer waiting on a full queue
+        self._thread.join(timeout=5)
+        # a producer blocked in put() may have delivered one last batch
+        # between the drain and the stop check; release it deterministically
+        self._drain()
+
+    def __del__(self):
+        try:
+            self._stop.set()
+        except Exception:
+            pass

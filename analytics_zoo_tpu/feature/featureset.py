@@ -412,6 +412,8 @@ class StreamingFeatureSet:
                     yield batch
                 skip = 0  # fast-forward applies to the resumed epoch only
 
+        from .device_feed import _put_until_stopped
+
         q: "queue_mod.Queue" = queue_mod.Queue(maxsize=self.prefetch)
         stop = threading.Event()
 
@@ -419,22 +421,13 @@ class StreamingFeatureSet:
             def __init__(self, exc):
                 self.exc = exc
 
-        def put_or_stop(item) -> bool:
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.5)
-                    return True
-                except queue_mod.Full:
-                    continue
-            return False
-
         def producer():
             try:
                 for batch in endless():
-                    if not put_or_stop(batch):
+                    if not _put_until_stopped(q, stop, batch):
                         return
             except BaseException as e:  # surface generator errors to consumer
-                put_or_stop(_Error(e))
+                _put_until_stopped(q, stop, _Error(e))
 
         t = threading.Thread(target=producer, daemon=True,
                              name="streaming-featureset")

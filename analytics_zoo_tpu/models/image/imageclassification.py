@@ -17,7 +17,7 @@ from ..common import ZooModel, register_zoo_model
 from ...keras import Input, Model
 from ...keras.layers import (
     Activation, AveragePooling2D, BatchNormalization, Convolution2D, Dense,
-    Flatten, GlobalAveragePooling2D, Lambda, MaxPooling2D, merge)
+    Dropout, Flatten, GlobalAveragePooling2D, Lambda, MaxPooling2D, merge)
 
 _RESNET_BLOCKS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3),
                   101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
@@ -132,12 +132,176 @@ def mobilenet(num_classes: int = 1000,
     return Model(inp, out, name="mobilenet")
 
 
+def inception_v1(num_classes: int = 1000,
+                 input_shape: Tuple[int, int, int] = (224, 224, 3),
+                 include_top: bool = True) -> Model:
+    """GoogLeNet / Inception-v1 (reference examples/inception +
+    ImageClassifier ``inception-v1`` config). Plain conv+relu as in the
+    original (no BN); the four parallel branches of every inception module
+    are independent convs XLA schedules back-to-back on the MXU."""
+    def conv(x, filters, k, stride=1, name=""):
+        x = Convolution2D(filters, k, k, subsample=(stride, stride),
+                          border_mode="same", name=f"{name}_conv")(x)
+        return Activation("relu", name=f"{name}_act")(x)
+
+    def module(x, f1, f3r, f3, f5r, f5, fp, name):
+        b1 = conv(x, f1, 1, 1, f"{name}_b1")
+        b3 = conv(conv(x, f3r, 1, 1, f"{name}_b3r"), f3, 3, 1, f"{name}_b3")
+        b5 = conv(conv(x, f5r, 1, 1, f"{name}_b5r"), f5, 5, 1, f"{name}_b5")
+        bp = MaxPooling2D((3, 3), strides=(1, 1), border_mode="same",
+                          name=f"{name}_pool")(x)
+        bp = conv(bp, fp, 1, 1, f"{name}_bp")
+        return merge([b1, b3, b5, bp], mode="concat", name=f"{name}_out")
+
+    inp = Input(input_shape, name="image")
+    x = conv(inp, 64, 7, 2, "stem1")
+    x = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same",
+                     name="stem1_pool")(x)
+    x = conv(x, 64, 1, 1, "stem2a")
+    x = conv(x, 192, 3, 1, "stem2b")
+    x = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same",
+                     name="stem2_pool")(x)
+    x = module(x, 64, 96, 128, 16, 32, 32, "inc3a")
+    x = module(x, 128, 128, 192, 32, 96, 64, "inc3b")
+    x = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same",
+                     name="inc3_pool")(x)
+    x = module(x, 192, 96, 208, 16, 48, 64, "inc4a")
+    x = module(x, 160, 112, 224, 24, 64, 64, "inc4b")
+    x = module(x, 128, 128, 256, 24, 64, 64, "inc4c")
+    x = module(x, 112, 144, 288, 32, 64, 64, "inc4d")
+    x = module(x, 256, 160, 320, 32, 128, 128, "inc4e")
+    x = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same",
+                     name="inc4_pool")(x)
+    x = module(x, 256, 160, 320, 32, 128, 128, "inc5a")
+    x = module(x, 384, 192, 384, 48, 128, 128, "inc5b")
+    if not include_top:
+        return Model(inp, x, name="inception_v1_features")
+    x = GlobalAveragePooling2D(name="avg_pool")(x)
+    x = Dropout(0.4, name="drop")(x)
+    out = Dense(num_classes, activation="softmax", name="logits")(x)
+    return Model(inp, out, name="inception_v1")
+
+
+def vgg(depth: int = 16, num_classes: int = 1000,
+        input_shape: Tuple[int, int, int] = (224, 224, 3),
+        include_top: bool = True, fc_dim: int = 4096) -> Model:
+    """VGG-16/19 (reference ImageClassifier ``vgg-16``/``vgg-19`` configs;
+    also the SSD backbone family). ``fc_dim`` is parameterized so small
+    deployments can shrink the two giant FC layers."""
+    cfg = {16: (2, 2, 3, 3, 3), 19: (2, 2, 4, 4, 4)}
+    if depth not in cfg:
+        raise ValueError(f"unsupported VGG depth {depth}; have {sorted(cfg)}")
+    inp = Input(input_shape, name="image")
+    x, filters = inp, 64
+    for stage, n in enumerate(cfg[depth]):
+        for i in range(n):
+            x = Convolution2D(min(filters, 512), 3, 3, border_mode="same",
+                              activation="relu",
+                              name=f"block{stage + 1}_conv{i + 1}")(x)
+        x = MaxPooling2D((2, 2), name=f"block{stage + 1}_pool")(x)
+        filters *= 2
+    if not include_top:
+        return Model(inp, x, name=f"vgg{depth}_features")
+    x = Flatten(name="flatten")(x)
+    x = Dense(fc_dim, activation="relu", name="fc1")(x)
+    x = Dropout(0.5, name="fc1_drop")(x)
+    x = Dense(fc_dim, activation="relu", name="fc2")(x)
+    x = Dropout(0.5, name="fc2_drop")(x)
+    out = Dense(num_classes, activation="softmax", name="logits")(x)
+    return Model(inp, out, name=f"vgg{depth}")
+
+
+def squeezenet(num_classes: int = 1000,
+               input_shape: Tuple[int, int, int] = (224, 224, 3),
+               include_top: bool = True) -> Model:
+    """SqueezeNet v1.1 (reference ImageClassifier ``squeezenet`` config):
+    fire modules = 1x1 squeeze then parallel 1x1/3x3 expand concat."""
+    def fire(x, squeeze, expand, name):
+        s = Convolution2D(squeeze, 1, 1, activation="relu",
+                          name=f"{name}_sq")(x)
+        e1 = Convolution2D(expand, 1, 1, activation="relu",
+                           name=f"{name}_e1")(s)
+        e3 = Convolution2D(expand, 3, 3, border_mode="same",
+                           activation="relu", name=f"{name}_e3")(s)
+        return merge([e1, e3], mode="concat", name=f"{name}_out")
+
+    inp = Input(input_shape, name="image")
+    x = Convolution2D(64, 3, 3, subsample=(2, 2), activation="relu",
+                      name="stem")(inp)
+    x = MaxPooling2D((3, 3), strides=(2, 2), name="pool1")(x)
+    x = fire(x, 16, 64, "fire2")
+    x = fire(x, 16, 64, "fire3")
+    x = MaxPooling2D((3, 3), strides=(2, 2), name="pool3")(x)
+    x = fire(x, 32, 128, "fire4")
+    x = fire(x, 32, 128, "fire5")
+    x = MaxPooling2D((3, 3), strides=(2, 2), name="pool5")(x)
+    x = fire(x, 48, 192, "fire6")
+    x = fire(x, 48, 192, "fire7")
+    x = fire(x, 64, 256, "fire8")
+    x = fire(x, 64, 256, "fire9")
+    if not include_top:
+        return Model(inp, x, name="squeezenet_features")
+    x = Dropout(0.5, name="drop")(x)
+    x = Convolution2D(num_classes, 1, 1, activation="relu", name="conv10")(x)
+    x = GlobalAveragePooling2D(name="avg_pool")(x)
+    out = Activation("softmax", name="probs")(x)
+    return Model(inp, out, name="squeezenet")
+
+
+def densenet(depth: int = 121, num_classes: int = 1000,
+             input_shape: Tuple[int, int, int] = (224, 224, 3),
+             include_top: bool = True, growth_rate: int = 32) -> Model:
+    """DenseNet-121/169 (reference ImageClassifier ``densenet-161`` role).
+    BN→relu→conv pre-activation ordering; each dense layer's output is
+    concatenated onto the running feature map."""
+    cfg = {121: (6, 12, 24, 16), 169: (6, 12, 32, 32)}
+    if depth not in cfg:
+        raise ValueError(f"unsupported DenseNet depth {depth}; "
+                         f"have {sorted(cfg)}")
+
+    def bn_relu_conv(x, filters, k, name):
+        x = BatchNormalization(name=f"{name}_bn")(x)
+        x = Activation("relu", name=f"{name}_act")(x)
+        return Convolution2D(filters, k, k, border_mode="same", bias=False,
+                             name=f"{name}_conv")(x)
+
+    inp = Input(input_shape, name="image")
+    x = _conv_bn(inp, 64, 7, 2, "relu", "stem")
+    x = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same",
+                     name="stem_pool")(x)
+    channels = 64
+    for stage, n in enumerate(cfg[depth]):
+        for i in range(n):
+            name = f"dense{stage + 1}_{i + 1}"
+            y = bn_relu_conv(x, 4 * growth_rate, 1, f"{name}_a")
+            y = bn_relu_conv(y, growth_rate, 3, f"{name}_b")
+            x = merge([x, y], mode="concat", name=f"{name}_cat")
+            channels += growth_rate
+        if stage < len(cfg[depth]) - 1:  # transition halves channels + size
+            channels //= 2
+            x = bn_relu_conv(x, channels, 1, f"trans{stage + 1}")
+            x = AveragePooling2D((2, 2), name=f"trans{stage + 1}_pool")(x)
+    x = BatchNormalization(name="final_bn")(x)
+    x = Activation("relu", name="final_act")(x)
+    if not include_top:
+        return Model(inp, x, name=f"densenet{depth}_features")
+    x = GlobalAveragePooling2D(name="avg_pool")(x)
+    out = Dense(num_classes, activation="softmax", name="logits")(x)
+    return Model(inp, out, name=f"densenet{depth}")
+
+
 _BACKBONES: Dict[str, Callable] = {
     "resnet18": lambda n, s: resnet(18, n, s),
     "resnet34": lambda n, s: resnet(34, n, s),
     "resnet50": lambda n, s: resnet(50, n, s),
     "resnet101": lambda n, s: resnet(101, n, s),
+    "resnet152": lambda n, s: resnet(152, n, s),
     "mobilenet": lambda n, s: mobilenet(n, s),
+    "inception-v1": lambda n, s: inception_v1(n, s),
+    "vgg-16": lambda n, s: vgg(16, n, s),
+    "vgg-19": lambda n, s: vgg(19, n, s),
+    "squeezenet": lambda n, s: squeezenet(n, s),
+    "densenet-121": lambda n, s: densenet(121, n, s),
 }
 
 
@@ -190,11 +354,8 @@ class ImageClassifier(ZooModel):
         ``ImageClassifier.predictImageSet`` + label map output)."""
         fs = image_set.transform(self.preprocessing()).to_featureset(
             shuffle=False, shard=False)
-        probs = np.asarray(self.predict(None, batch_size=batch_size,
-                                        featureset=fs)
-                           if False else
-                           self._ensure_built().get_estimator().predict(
-                               fs, batch_size=batch_size))
+        probs = np.asarray(self._ensure_built().get_estimator().predict(
+            fs, batch_size=batch_size))
         top = np.argsort(-probs, axis=1)[:, :top_k]
         out = []
         for row, p in zip(top, probs):

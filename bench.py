@@ -13,6 +13,7 @@ XLA's compiled cost analysis. The reference publishes no absolute numbers
 Usage: ``python bench.py [all|resnet50|ncf|widedeep|bert]`` (default all).
 """
 import json
+import os
 import sys
 import time
 
@@ -303,20 +304,23 @@ def bench_input_pipeline(batch_size: int = 256, steps: int = 30):
 
     def run(fs, device_fn=None):
         feed = DeviceFeed(fs.train_iterator(batch_size), ctx.mesh)
-        x, y = next(feed)
-        if device_fn is not None:
-            x = device_fn(x)
-        jax.block_until_ready(x)
-        start = time.perf_counter()
-        done = 0
-        for x, y in feed:
+        try:
+            x, y = next(feed)
             if device_fn is not None:
                 x = device_fn(x)
             jax.block_until_ready(x)
-            done += 1
-            if done >= steps:
-                break
-        return batch_size * done / (time.perf_counter() - start)
+            start = time.perf_counter()
+            done = 0
+            for x, y in feed:
+                if device_fn is not None:
+                    x = device_fn(x)
+                jax.block_until_ready(x)
+                done += 1
+                if done >= steps:
+                    break
+            return batch_size * done / (time.perf_counter() - start)
+        finally:
+            feed.close()  # endless iterator: stop the producer thread
 
     host_fs = FeatureSet.from_ndarrays(raw, labels, shuffle=True).transform(
         BatchLambda(lambda b: (b.astype(np.float32) - mean) / std))
@@ -407,18 +411,56 @@ _WORKLOADS = {
 }
 
 
+_MARKER = "BENCH_RESULT_JSON:"
+
+
+def _run_isolated(name: str) -> "_BenchResult":
+    """Run one workload in a fresh interpreter. Workloads pollute each other
+    inside one process (device buffers from earlier models linger, compile
+    caches interact — the input-pipeline rate measured 16x slower after the
+    BERT bench than standalone), so `all` isolates each in a subprocess."""
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--one", name],
+        capture_output=True, text=True, timeout=3000,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    for line in proc.stdout.splitlines():
+        if line.startswith(_MARKER):
+            return _BenchResult(json.loads(line[len(_MARKER):]))
+    raise RuntimeError(
+        f"workload {name} produced no result (rc={proc.returncode}): "
+        f"{proc.stdout[-500:]}\n{proc.stderr[-1500:]}")
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which == "--one":
+        name = sys.argv[2]
+        result = _WORKLOADS[name]()
+        result.setdefault("detail", {})
+        from analytics_zoo_tpu.common.context import init_tpu_context
+        child_ctx = init_tpu_context()  # cached: the workload already made it
+        result["detail"]["platform"] = child_ctx.platform
+        result["detail"]["num_devices"] = child_ctx.num_devices
+        print(_MARKER + json.dumps(dict(result)))
+        return 0
     names = list(_WORKLOADS) if which == "all" else [which]
-    from analytics_zoo_tpu.common.context import init_tpu_context
-    ctx = init_tpu_context()
+    isolate = which == "all"
+    ctx = None
+    if not isolate:
+        # isolated mode must NOT grab the TPU in the parent: on single-host
+        # hardware libtpu is process-exclusive, so holding it here would make
+        # every child's init fail. Platform info comes back from the children.
+        from analytics_zoo_tpu.common.context import init_tpu_context
+        ctx = init_tpu_context()
     results = {}
     for name in names:
         # the tunnel to the remote compile service occasionally drops the
         # response mid-body on big HLO programs; retry before giving up
         for attempt in range(3):
             try:
-                results[name] = _WORKLOADS[name]()
+                results[name] = (_run_isolated(name) if isolate
+                                 else _WORKLOADS[name]())
                 break
             except Exception as e:  # keep the headline line even if one fails
                 results[name] = _BenchResult(metric=f"{name}_failed", value=None,
@@ -428,14 +470,27 @@ def main():
                     break
                 time.sleep(5 * (attempt + 1))
     head = results.get("resnet50") or next(iter(results.values()))
+    if ctx is not None:
+        platform, num_devices = ctx.platform, ctx.num_devices
+    else:  # isolated mode: take it from any child that reported
+        platform, num_devices = "unknown", None
+        for r in results.values():
+            d = r.get("detail") or {}
+            if "platform" in d:
+                platform, num_devices = d["platform"], d["num_devices"]
+                break
+        for r in results.values():  # drop the per-child copies from the rows
+            d = r.get("detail") or {}
+            d.pop("platform", None)
+            d.pop("num_devices", None)
     print(json.dumps({
         "metric": head["metric"],
         "value": head["value"],
         "unit": head["unit"],
         "vs_baseline": None,
         "detail": {
-            "platform": ctx.platform,
-            "num_devices": ctx.num_devices,
+            "platform": platform,
+            "num_devices": num_devices,
             "mfu": head.get("mfu"),
             "workloads": {n: {"metric": r["metric"], "value": r["value"],
                               "unit": r["unit"], "mfu": r.get("mfu"),

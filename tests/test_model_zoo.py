@@ -207,3 +207,44 @@ class TestSeq2seq:
         m.default_compile()
         m.fit([enc, dec], target, batch_size=8, nb_epoch=1)
         assert m.predict([enc, dec], batch_size=8).shape == (8, 3, 2)
+
+
+class TestImageClassifierBackbones:
+    """Construct + forward for the classifier config family (reference
+    ImageClassifier per-model configs: inception-v1/vgg/squeezenet/densenet)."""
+
+    @pytest.mark.parametrize("name", ["inception-v1", "squeezenet"])
+    def test_forward(self, ctx, name):
+        from analytics_zoo_tpu.models.image.imageclassification import (
+            ImageClassifier)
+        clf = ImageClassifier(name, num_classes=3, input_shape=(64, 64, 3))
+        clf.default_compile()
+        probs = np.asarray(clf.predict(
+            np.random.rand(4, 64, 64, 3).astype(np.float32), batch_size=4))
+        assert probs.shape == (4, 3)
+        assert np.allclose(probs.sum(-1), 1.0, atol=1e-3)
+
+    def test_construct_only(self):
+        from analytics_zoo_tpu.models.image.imageclassification import (
+            densenet, vgg)
+        assert vgg(19, 5, (32, 32, 3), fc_dim=16).name == "vgg19"
+        assert densenet(121, 5, (64, 64, 3)).name == "densenet121"
+        with pytest.raises(ValueError):
+            vgg(13, 5, (32, 32, 3))
+
+    def test_predict_image_set_labels(self, ctx):
+        from analytics_zoo_tpu.feature.image import ImageSet
+        from analytics_zoo_tpu.models.image.imageclassification import (
+            ImageClassifier)
+        # ragged input sizes: the model's preprocessing chain must resize
+        imgs = [np.random.randint(0, 255, (h, w, 3), np.uint8)
+                for h, w in [(70, 50), (64, 64), (50, 70), (80, 90)]]
+        clf = ImageClassifier("squeezenet", num_classes=3,
+                              input_shape=(32, 32, 3),
+                              labels=["cat", "dog", "fish"])
+        clf.default_compile()
+        out = clf.predict_image_set(ImageSet.from_arrays(imgs), top_k=2)
+        assert len(out) == 4 and all(len(r) == 2 for r in out)
+        for r in out:
+            assert all(lbl in ("cat", "dog", "fish") for lbl, _ in r)
+            assert r[0][1] >= r[1][1]
