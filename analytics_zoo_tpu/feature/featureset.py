@@ -54,6 +54,23 @@ def _tree_leaves(tree: ArrayTree):
     return [tree]
 
 
+def column_matrix(df, cols) -> np.ndarray:
+    """DataFrame columns → ``[n, d]`` float32 matrix; array-valued cells
+    stack, scalar columns contribute one dimension each (``(n, 1)`` for a
+    single scalar column). Shared by NNFrames and XShard lowering."""
+    if isinstance(cols, str):
+        cols = [cols]
+    parts = []
+    for c in cols:
+        col = df[c].to_numpy()
+        if len(col) and isinstance(col[0], (list, tuple, np.ndarray)):
+            parts.append(np.stack([np.asarray(v, np.float32) for v in col]))
+        else:
+            parts.append(col.astype(np.float32)[:, None])
+    out = np.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+    return np.ascontiguousarray(out, dtype=np.float32)
+
+
 def _spill_to_disk(arr: np.ndarray, directory: str, name: str) -> np.ndarray:
     path = os.path.join(directory, f"{name}.mmap")
     mm = np.memmap(path, dtype=arr.dtype, mode="w+", shape=arr.shape)
@@ -128,11 +145,19 @@ class FeatureSet:
     @classmethod
     def from_dataframe(cls, df, feature_cols: Sequence[str],
                        label_cols: Optional[Sequence[str]] = None,
-                       **kwargs) -> "FeatureSet":
-        """Build from a pandas DataFrame (the NNFrames/DataFrameDataset path)."""
-        feats = tuple(np.asarray(df[c].to_numpy()) for c in feature_cols)
-        if len(feats) == 1:
-            feats = feats[0]
+                       stack: bool = False, **kwargs) -> "FeatureSet":
+        """Build from a pandas DataFrame (the NNFrames/DataFrameDataset path).
+
+        ``stack=False`` (default) keeps each feature column a separate model
+        input; ``stack=True`` assembles them into one ``[B, K]`` float matrix
+        (the reference's VectorAssembler-style tabular contract, ``(B, 1)``
+        for a single column)."""
+        if stack:
+            feats: Any = column_matrix(df, feature_cols)
+        else:
+            feats = tuple(np.asarray(df[c].to_numpy()) for c in feature_cols)
+            if len(feats) == 1:
+                feats = feats[0]
         labels = None
         if label_cols:
             labels = tuple(np.asarray(df[c].to_numpy()) for c in label_cols)

@@ -335,9 +335,16 @@ class _TrainableMixin:
                 getattr(est, setter)(*getattr(self, attr)) if attr != "_clip" \
                     else est.set_gradient_clipping(getattr(self, attr))
         from ..feature import FeatureSet
+        from ..feature.featureset import StreamingFeatureSet
         if featureset is None:
-            featureset = FeatureSet.from_ndarrays(x, y)
+            featureset = x if isinstance(x, (FeatureSet, StreamingFeatureSet)) \
+                else FeatureSet.from_ndarrays(x, y)
         if validation_data is not None and not isinstance(validation_data, FeatureSet):
+            if isinstance(validation_data, StreamingFeatureSet):
+                raise ValueError(
+                    "streaming sets cannot be used for validation (they have "
+                    "no bounded eval iterator); materialize the validation "
+                    "split with FeatureSet.from_generator(streaming=False)")
             validation_data = FeatureSet.from_ndarrays(*validation_data)
         return est.train(featureset, batch_size=batch_size, epochs=nb_epoch,
                          validation_set=validation_data, **kwargs)
@@ -345,8 +352,16 @@ class _TrainableMixin:
     def evaluate(self, x, y=None, batch_size=32, featureset=None):
         est = self.get_estimator()
         from ..feature import FeatureSet
+        from ..feature.featureset import StreamingFeatureSet
+        if isinstance(x, StreamingFeatureSet) or \
+                isinstance(featureset, StreamingFeatureSet):
+            raise ValueError(
+                "streaming sets cannot be evaluated (no bounded eval "
+                "iterator); materialize the eval split with "
+                "FeatureSet.from_generator(streaming=False)")
         if featureset is None:
-            featureset = FeatureSet.from_ndarrays(x, y)
+            featureset = x if isinstance(x, FeatureSet) \
+                else FeatureSet.from_ndarrays(x, y)
         return est.evaluate(featureset, batch_size=batch_size)
 
     def predict(self, x, batch_size=32, distributed: bool = True):

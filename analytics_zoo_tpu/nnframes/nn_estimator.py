@@ -15,23 +15,10 @@ from typing import Any, Callable, List, Optional, Sequence, Union
 import numpy as np
 
 from ..estimator.estimator import Estimator
-from ..feature.featureset import FeatureSet, MemoryType
+from ..feature.featureset import FeatureSet, MemoryType, column_matrix
 from ..keras import objectives, optimizers as opt_mod
 
-
-def _column_matrix(df, cols: Union[str, Sequence[str]]) -> np.ndarray:
-    """DataFrame columns → [n, d] float array; array-valued cells stack."""
-    if isinstance(cols, str):
-        cols = [cols]
-    parts = []
-    for c in cols:
-        col = df[c].to_numpy()
-        if len(col) and isinstance(col[0], (list, tuple, np.ndarray)):
-            parts.append(np.stack([np.asarray(v, np.float32) for v in col]))
-        else:
-            parts.append(col.astype(np.float32)[:, None])
-    out = np.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
-    return np.ascontiguousarray(out, dtype=np.float32)
+_column_matrix = column_matrix  # local alias kept for readability below
 
 
 class NNEstimator:

@@ -62,10 +62,17 @@ class DataShards:
                           self.use_processes)
 
     def to_featureset(self, feature_cols: Sequence[str],
-                      label_cols: Optional[Sequence[str]] = None, **kwargs):
+                      label_cols: Optional[Sequence[str]] = None,
+                      stack: bool = True, **kwargs):
+        """Lower the shards into a FeatureSet. With ``stack`` (default) the
+        feature columns are assembled into one ``[B, K]`` float matrix (the
+        reference's VectorAssembler-style tabular contract, ``(B, 1)`` for a
+        single column); ``stack=False`` keeps them as separate model
+        inputs."""
         from ..feature.featureset import FeatureSet
         return FeatureSet.from_dataframe(self.concat_to_pandas(),
-                                         feature_cols, label_cols, **kwargs)
+                                         feature_cols, label_cols,
+                                         stack=stack, **kwargs)
 
 
 def _expand(path: str, exts: Sequence[str]) -> List[str]:

@@ -1,0 +1,71 @@
+"""GAN training with alternating generator/discriminator steps (reference
+``pyzoo/zoo/examples/tensorflow/tfpark/gan`` — GANEstimator on MNIST; here
+a 2D toy distribution so it runs anywhere in seconds).
+
+The generator learns to map N(0,1) noise onto a shifted Gaussian mode; both
+sub-networks are plain JAX functions, and the estimator fuses the d_steps +
+g_steps schedule into ONE jitted device step (lax.fori_loop) per batch.
+"""
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.capture import GANEstimator
+from analytics_zoo_tpu.keras import optimizers
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--steps", type=int, default=400)
+    args = ap.parse_args()
+
+    steps = 60 if args.smoke else args.steps
+    rs = np.random.RandomState(0)
+    real = (rs.randn(4096, 2) * 0.1 + np.array([2.0, -1.0])).astype(
+        np.float32)
+
+    def gen_init(rng, noise):
+        k1, k2 = jax.random.split(rng)
+        return {"w1": jax.random.normal(k1, (noise.shape[-1], 16)) * 0.1,
+                "b1": jnp.zeros(16),
+                "w2": jax.random.normal(k2, (16, 2)) * 0.1,
+                "b2": jnp.zeros(2)}
+
+    def gen_fn(p, z):
+        h = jax.nn.relu(z @ p["w1"] + p["b1"])
+        return h @ p["w2"] + p["b2"]
+
+    def disc_init(rng, x):
+        k1, k2 = jax.random.split(rng)
+        return {"w1": jax.random.normal(k1, (x.shape[-1], 16)) * 0.1,
+                "b1": jnp.zeros(16),
+                "w2": jax.random.normal(k2, (16, 1)) * 0.1,
+                "b2": jnp.zeros(1)}
+
+    def disc_fn(p, x):
+        h = jax.nn.relu(x @ p["w1"] + p["b1"])
+        return h @ p["w2"] + p["b2"]
+
+    def g_loss(fake_logits):
+        return -jnp.mean(fake_logits)
+
+    def d_loss(real_logits, fake_logits):
+        return jnp.mean(jax.nn.softplus(-real_logits)) + \
+            jnp.mean(jax.nn.softplus(fake_logits))
+
+    gan = GANEstimator(gen_fn, disc_fn, g_loss, d_loss, gen_init, disc_init,
+                       generator_optimizer=optimizers.Adam(1e-2),
+                       discriminator_optimizer=optimizers.Adam(1e-2),
+                       noise_dim=4, d_steps=1, g_steps=2)
+    hist = gan.train(real, batch_size=128, steps=steps)
+    samples = gan.generate(512)
+    print(f"after {hist['iterations']} steps generator mean = "
+          f"({samples.mean(0)[0]:+.2f}, {samples.mean(0)[1]:+.2f}); "
+          f"target (+2.00, -1.00)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""SSD object detection: train, detect, evaluate mAP (reference
+``pyzoo/zoo/examples/objectdetection/predict.py`` + the SSD training
+pipeline in ``models/image/objectdetection``).
+
+Builds a MobileNet-SSD300, fits it on a synthetic "bright square on dark
+background" detection task, decodes box predictions with NMS, and scores
+them with VOC-style MeanAveragePrecision. Swap ``--backbone vgg16`` for the
+classic VGG16-SSD300.
+"""
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu.models.image.evaluation import MeanAveragePrecision
+from analytics_zoo_tpu.models.image.objectdetection import (
+    ObjectDetector, Visualizer, multibox_loss)
+
+
+def synthetic_boxes(n, size, rs):
+    """Images with one bright square each; the box is the ground truth."""
+    imgs = rs.rand(n, size, size, 3).astype(np.float32) * 0.2
+    boxes, labels = [], []
+    for i in range(n):
+        w = rs.randint(size // 5, size // 2)
+        x0 = rs.randint(0, size - w)
+        y0 = rs.randint(0, size - w)
+        imgs[i, y0:y0 + w, x0:x0 + w] = 1.0
+        boxes.append(np.array([[x0 / size, y0 / size,
+                                (x0 + w) / size, (y0 + w) / size]],
+                              np.float32))
+        labels.append(np.array([1]))
+    return imgs, boxes, labels
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--backbone", default="mobilenet",
+                    choices=["mobilenet", "vgg16"])
+    ap.add_argument("--epochs", type=int, default=10)
+    args = ap.parse_args()
+
+    n, epochs = (16, 1) if args.smoke else (256, args.epochs)
+    rs = np.random.RandomState(0)
+    imgs, gt_boxes, gt_labels = synthetic_boxes(n, 300, rs)
+
+    det = ObjectDetector(class_num=2, backbone=args.backbone, resolution=300)
+    det.compile("adam", multibox_loss())
+    loc_t, cls_t = det.encode_batch(gt_boxes, gt_labels)
+    det.fit(imgs, (loc_t, cls_t), batch_size=8, nb_epoch=epochs)
+
+    boxes, scores, classes = det.detect(imgs[:8], batch_size=8,
+                                        max_detections=10)
+    metric = MeanAveragePrecision(num_classes=2)
+    for i in range(8):
+        metric.add(boxes[i], scores[i], classes[i], gt_boxes[i], gt_labels[i])
+    print(f"mAP over 8 images: {metric.compute()['mAP']:.3f}")
+
+    vis = Visualizer(labels=["bg", "square"])
+    drawn = vis.draw(imgs[0], boxes[0], scores[0], classes[0])
+    print(f"visualized detections onto image of shape {drawn.shape}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Seq2seq encoder-decoder training + autoregressive inference (reference
+``pyzoo/zoo/examples/chatbot`` — the scala chatbot example trains a
+Seq2seq on question/answer token sequences).
+
+Task: "echo shifted" — the target sequence is the input sequence shifted by
+one learned offset in embedding space. Demonstrates teacher-forced ``fit``
+on ``[encoder_in, decoder_in]`` and free-running generation via ``infer``.
+"""
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu.models import Seq2seq
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--epochs", type=int, default=20)
+    args = ap.parse_args()
+
+    n, in_seq, out_seq, dim = (32, 6, 5, 4) if args.smoke else \
+        (2048, 20, 18, 16)
+    epochs = 2 if args.smoke else args.epochs
+    rs = np.random.RandomState(0)
+    enc = rs.rand(n, in_seq, dim).astype(np.float32)
+    # target: previous decoder step plus a constant drift (learnable map)
+    dec = rs.rand(n, out_seq, dim).astype(np.float32)
+    target = np.roll(dec, -1, axis=1) * 0.5 + 0.25
+
+    m = Seq2seq(rnn_type="lstm", num_layers=2, hidden_size=32,
+                bridge="dense", generator_dim=dim)
+    m.default_compile()
+    m.fit([enc, dec], target.astype(np.float32), batch_size=16,
+          nb_epoch=epochs)
+
+    preds = m.predict([enc, dec], batch_size=16)
+    mse = float(np.mean((np.asarray(preds) - target) ** 2))
+    print(f"teacher-forced MSE: {mse:.4f}")
+
+    gen = m.infer(enc[:2], start_sign=np.zeros(dim, np.float32),
+                  max_seq_len=out_seq)
+    print(f"free-running generation shape: {gen.shape}")
+
+
+if __name__ == "__main__":
+    main()

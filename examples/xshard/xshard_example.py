@@ -1,0 +1,58 @@
+"""Sharded pandas ETL → training set (reference
+``pyzoo/zoo/examples/xshard`` — DataShards read_csv/apply/repartition).
+
+Writes a small partitioned CSV dataset, reads it back as parallel pandas
+shards, feature-engineers shard-wise (each shard transformed in a worker
+process), then lowers the shards into a FeatureSet and fits a classifier.
+"""
+import argparse
+import os
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu import xshard
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras.layers import Dense
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+
+    rows_per_file, files = (100, 3) if args.smoke else (20000, 8)
+    rs = np.random.RandomState(0)
+    with tempfile.TemporaryDirectory() as d:
+        for i in range(files):
+            x = rs.rand(rows_per_file, 3)
+            pd.DataFrame({
+                "a": x[:, 0], "b": x[:, 1], "c": x[:, 2],
+                "label": (x.sum(1) > 1.5).astype(np.float32),
+            }).to_csv(os.path.join(d, f"part-{i}.csv"), index=False)
+
+        shards = xshard.read_csv(d)
+        print(f"read {shards.num_partitions()} shards")
+
+        # shard-wise feature engineering, then rebalance
+        shards = shards.apply(
+            lambda df: df.assign(ab=df["a"] * df["b"])).repartition(2)
+        total = sum(len(s) for s in shards.collect())
+        print(f"{total} rows across {shards.num_partitions()} shards "
+              f"after repartition")
+
+        fs = shards.to_featureset(feature_cols=["a", "b", "c", "ab"],
+                                  label_cols=["label"])
+        model = Sequential([Dense(8, activation="relu"),
+                            Dense(2, activation="softmax")])
+        model.compile(optimizer="adam",
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"])
+        model.fit(fs, batch_size=64, nb_epoch=5 if args.smoke else 20)
+        metrics = model.evaluate(fs, batch_size=64)
+        print(f"train metrics: {metrics}")
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,14 @@ EXAMPLES = [
     "examples/zouwu/forecast_example.py",
     "examples/cluster/pod_train.py",
     "examples/parallel/moe_pipeline_example.py",
+    "examples/objectdetection/ssd_example.py",
+    "examples/anomalydetection/anomaly_example.py",
+    "examples/seq2seq/chatbot_example.py",
+    "examples/automl/autots_example.py",
+    "examples/nnframes/nn_classifier_example.py",
+    "examples/gan/gan_example.py",
+    "examples/inference/quantized_inference_example.py",
+    "examples/xshard/xshard_example.py",
 ]
 
 
