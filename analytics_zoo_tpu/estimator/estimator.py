@@ -345,6 +345,7 @@ class Estimator:
             try:
                 for x, y in feed:
                     step_rng = jax.random.fold_in(self.root_rng, self.global_step)
+                    step_start = time.time()
                     with time_it("train_step"):
                         (self.params, self.opt_state, self.model_state,
                          loss) = self._train_step(
@@ -367,6 +368,18 @@ class Estimator:
                                                           self.global_step)
                             self._train_writer.add_scalar("LearningRate", lr_val,
                                                           self.global_step)
+                            # per-iteration Throughput (reference
+                            # Topology.scala:218-224): timed over dispatch +
+                            # the loss sync just above, which bounds this
+                            # step's device work — validation/checkpoint time
+                            # between steps is deliberately NOT counted
+                            step_time = time.time() - step_start
+                            if step_time > 0:
+                                global_batch = (local_batch
+                                                * self.ctx.process_count)
+                                self._train_writer.add_scalar(
+                                    "Throughput", global_batch / step_time,
+                                    self.global_step)
 
                     state.epoch_finished = epoch_iter >= batches_per_epoch
                     in_slice_bound = epoch_iter in slice_bounds or state.epoch_finished
@@ -450,17 +463,25 @@ class Estimator:
         local_batch = min(self.ctx.local_batch(batch_size), val_set.size)
         ndev = self.mesh.devices.size
         local_batch = max(ndev, (local_batch // ndev) * ndev)
-        sample = next(val_set.eval_iterator(local_batch, pad_remainder=True))
-        self._ensure_initialized(sample[0])
-        if self._eval_step is None:
-            self._eval_step = self._build_eval_step()
-        metric_states = [jax.device_put(m.init_state(), replicated(self.mesh))
-                         for m in self.metrics]
-        for x, y, valid in val_set.eval_iterator(local_batch, pad_remainder=True):
+        # ONE iterator pass: streaming sets restart their generator per
+        # eval_iterator call, so peeking with a second iterator would decode
+        # the first batch twice on every evaluation
+        it = val_set.eval_iterator(local_batch, pad_remainder=True)
+        metric_states = None
+        for x, y, valid in it:
+            if metric_states is None:
+                self._ensure_initialized(x)
+                if self._eval_step is None:
+                    self._eval_step = self._build_eval_step()
+                metric_states = [
+                    jax.device_put(m.init_state(), replicated(self.mesh))
+                    for m in self.metrics]
             mask = (np.arange(local_batch) < valid).astype(np.float32)
             batch = shard_batch(self.mesh, (x, y, mask))
             metric_states = self._eval_step(self.params, self.model_state,
                                             metric_states, *batch)
+        if metric_states is None:
+            raise ValueError("validation set produced no batches")
         return {m.name: m.compute(s) for m, s in zip(self.metrics, metric_states)}
 
     def _evaluate_direct(self, val_set: FeatureSet, batch_size: int

@@ -6,3 +6,5 @@ from .transforms import (  # noqa: F401
     PixelBytesToMat, PixelNormalizer, RandomAspectScale, RandomCrop,
     RandomPreprocessing, RandomResize, RandomTransformer, Resize, Saturation,
     VFlip)
+from .detection import (  # noqa: F401
+    ExpandWithBoxes, RandomHFlipWithBoxes, RandomSampleCrop, ResizeWithBoxes)

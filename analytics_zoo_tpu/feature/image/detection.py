@@ -1,0 +1,150 @@
+"""Box-aware detection augmentation (reference: the SSD train pipeline
+``models/image/objectdetection/ssd/RoiImageToSSDBatch.scala`` with BigDL's
+roi-aware vision transforms — RandomSampler crop, expand, flip — plus
+``feature/image/roi/RoiRecordToFeature.scala``).
+
+Records are ``(image HWC, boxes [N, 4], labels [N])`` with boxes in
+normalized corner form ``[x0, y0, x1, y1]`` in ``[0, 1]`` — the same
+convention the anchor machinery in ``models/image/objectdetection`` uses,
+so these chain straight into ``ObjectDetector.encode_batch``. All ops are
+host-side numpy (cheap per-record bookkeeping); the heavy lifting stays in
+the device step.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..preprocessing import Preprocessing
+
+Record = Tuple[np.ndarray, np.ndarray, np.ndarray]
+
+
+def _unpack(record: Any) -> Record:
+    img, boxes, labels = record
+    return (np.asarray(img), np.asarray(boxes, np.float32),
+            np.asarray(labels))
+
+
+class RandomHFlipWithBoxes(Preprocessing):
+    """Horizontal flip of image + boxes with probability ``p``."""
+
+    def __init__(self, p: float = 0.5, seed: Optional[int] = None):
+        self.p = p
+        self.rs = np.random.RandomState(seed)
+
+    def apply(self, record: Any) -> Record:
+        img, boxes, labels = _unpack(record)
+        if self.rs.rand() >= self.p:
+            return img, boxes, labels
+        img = img[:, ::-1]
+        if len(boxes):
+            boxes = boxes.copy()
+            x0 = boxes[:, 0].copy()
+            boxes[:, 0] = 1.0 - boxes[:, 2]
+            boxes[:, 2] = 1.0 - x0
+        return np.ascontiguousarray(img), boxes, labels
+
+
+class ExpandWithBoxes(Preprocessing):
+    """Zoom-out: place the image on a larger filled canvas (reference/SSD
+    ``Expand``). Teaches the detector small objects."""
+
+    def __init__(self, max_ratio: float = 4.0, fill=0.0, p: float = 0.5,
+                 seed: Optional[int] = None):
+        self.max_ratio = max_ratio
+        self.fill = fill
+        self.p = p
+        self.rs = np.random.RandomState(seed)
+
+    def apply(self, record: Any) -> Record:
+        img, boxes, labels = _unpack(record)
+        if self.rs.rand() >= self.p:
+            return img, boxes, labels
+        h, w = img.shape[:2]
+        ratio = self.rs.uniform(1.0, self.max_ratio)
+        nh, nw = int(h * ratio), int(w * ratio)
+        top = self.rs.randint(0, nh - h + 1)
+        left = self.rs.randint(0, nw - w + 1)
+        canvas = np.full((nh, nw) + img.shape[2:], self.fill, img.dtype)
+        canvas[top:top + h, left:left + w] = img
+        if len(boxes):
+            boxes = boxes.copy()
+            boxes[:, [0, 2]] = (boxes[:, [0, 2]] * w + left) / nw
+            boxes[:, [1, 3]] = (boxes[:, [1, 3]] * h + top) / nh
+        return canvas, boxes, labels
+
+
+def _iou_with_crop(boxes: np.ndarray, crop: np.ndarray) -> np.ndarray:
+    ix0 = np.maximum(boxes[:, 0], crop[0])
+    iy0 = np.maximum(boxes[:, 1], crop[1])
+    ix1 = np.minimum(boxes[:, 2], crop[2])
+    iy1 = np.minimum(boxes[:, 3], crop[3])
+    inter = np.clip(ix1 - ix0, 0, None) * np.clip(iy1 - iy0, 0, None)
+    area_b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    area_c = (crop[2] - crop[0]) * (crop[3] - crop[1])
+    return inter / np.clip(area_b + area_c - inter, 1e-9, None)
+
+
+class RandomSampleCrop(Preprocessing):
+    """SSD RandomSampler: pick a crop whose IoU with at least one ground-
+    truth box satisfies a randomly chosen constraint, keep the boxes whose
+    centers fall inside, clip and renormalize them. ``None`` in
+    ``min_ious`` means "keep the whole image" for that draw."""
+
+    def __init__(self, min_ious: Sequence[Optional[float]] =
+                 (None, 0.1, 0.3, 0.5, 0.7, 0.9),
+                 max_trials: int = 50, min_scale: float = 0.3,
+                 seed: Optional[int] = None):
+        self.min_ious = tuple(min_ious)
+        self.max_trials = max_trials
+        self.min_scale = min_scale
+        self.rs = np.random.RandomState(seed)
+
+    def apply(self, record: Any) -> Record:
+        img, boxes, labels = _unpack(record)
+        min_iou = self.min_ious[self.rs.randint(len(self.min_ious))]
+        if min_iou is None or not len(boxes):
+            return img, boxes, labels
+        h, w = img.shape[:2]
+        for _ in range(self.max_trials):
+            cw = self.rs.uniform(self.min_scale, 1.0)
+            ch = self.rs.uniform(self.min_scale, 1.0)
+            if not 0.5 <= cw / ch <= 2.0:  # aspect-ratio guard (SSD paper)
+                continue
+            cx0 = self.rs.uniform(0, 1.0 - cw)
+            cy0 = self.rs.uniform(0, 1.0 - ch)
+            crop = np.array([cx0, cy0, cx0 + cw, cy0 + ch], np.float32)
+            if _iou_with_crop(boxes, crop).max() < min_iou:
+                continue
+            centers = (boxes[:, :2] + boxes[:, 2:]) / 2
+            keep = ((centers[:, 0] > crop[0]) & (centers[:, 0] < crop[2])
+                    & (centers[:, 1] > crop[1]) & (centers[:, 1] < crop[3]))
+            if not keep.any():
+                continue
+            px0, py0 = int(crop[0] * w), int(crop[1] * h)
+            px1, py1 = int(crop[2] * w), int(crop[3] * h)
+            out = np.ascontiguousarray(img[py0:py1, px0:px1])
+            kept = boxes[keep].copy()
+            kept[:, [0, 2]] = (np.clip(kept[:, [0, 2]], crop[0], crop[2])
+                               - crop[0]) / (crop[2] - crop[0])
+            kept[:, [1, 3]] = (np.clip(kept[:, [1, 3]], crop[1], crop[3])
+                               - crop[1]) / (crop[3] - crop[1])
+            return out, kept, labels[keep]
+        return img, boxes, labels
+
+
+class ResizeWithBoxes(Preprocessing):
+    """Resize the image; normalized boxes are scale-invariant so they pass
+    through unchanged. Terminal op before batching for the static-shape
+    device step."""
+
+    def __init__(self, height: int, width: int):
+        self.height = height
+        self.width = width
+
+    def apply(self, record: Any) -> Record:
+        img, boxes, labels = _unpack(record)
+        from .transforms import Resize  # shares Resize's no-cv2 fallback
+        return Resize(self.height, self.width).apply(img), boxes, labels

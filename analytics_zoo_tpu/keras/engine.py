@@ -315,6 +315,26 @@ class _TrainableMixin:
     def set_tensorboard(self, log_dir: str, app_name: str) -> None:
         self._tb = (log_dir, app_name)
 
+    def _read_summary(self, split: str, tag: str):
+        import os
+        if not hasattr(self, "_tb"):
+            raise RuntimeError("call set_tensorboard(log_dir, app_name) "
+                               "before reading summaries")
+        from ..utils.tensorboard import read_scalars
+        log_dir, app = self._tb
+        return read_scalars(os.path.join(log_dir, app, split), tag)
+
+    def get_train_summary(self, tag: str = "Loss"):
+        """Read back training scalars as ``[(step, value), ...]`` (reference
+        ``KerasNet.getTrainSummary``, Topology.scala:222-224; tags: Loss,
+        LearningRate, Throughput)."""
+        return self._read_summary("train", tag)
+
+    def get_validation_summary(self, tag: str):
+        """Validation scalars per metric name (reference
+        ``getValidationSummary``, Topology.scala:232-238)."""
+        return self._read_summary("validation", tag)
+
     def set_checkpoint(self, path: str, trigger=None) -> None:
         self._ckpt = (path, trigger)
 
@@ -339,12 +359,8 @@ class _TrainableMixin:
         if featureset is None:
             featureset = x if isinstance(x, (FeatureSet, StreamingFeatureSet)) \
                 else FeatureSet.from_ndarrays(x, y)
-        if validation_data is not None and not isinstance(validation_data, FeatureSet):
-            if isinstance(validation_data, StreamingFeatureSet):
-                raise ValueError(
-                    "streaming sets cannot be used for validation (they have "
-                    "no bounded eval iterator); materialize the validation "
-                    "split with FeatureSet.from_generator(streaming=False)")
+        if validation_data is not None and not isinstance(
+                validation_data, (FeatureSet, StreamingFeatureSet)):
             validation_data = FeatureSet.from_ndarrays(*validation_data)
         return est.train(featureset, batch_size=batch_size, epochs=nb_epoch,
                          validation_set=validation_data, **kwargs)
@@ -353,14 +369,8 @@ class _TrainableMixin:
         est = self.get_estimator()
         from ..feature import FeatureSet
         from ..feature.featureset import StreamingFeatureSet
-        if isinstance(x, StreamingFeatureSet) or \
-                isinstance(featureset, StreamingFeatureSet):
-            raise ValueError(
-                "streaming sets cannot be evaluated (no bounded eval "
-                "iterator); materialize the eval split with "
-                "FeatureSet.from_generator(streaming=False)")
         if featureset is None:
-            featureset = x if isinstance(x, FeatureSet) \
+            featureset = x if isinstance(x, (FeatureSet, StreamingFeatureSet)) \
                 else FeatureSet.from_ndarrays(x, y)
         return est.evaluate(featureset, batch_size=batch_size)
 

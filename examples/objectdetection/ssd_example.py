@@ -44,6 +44,18 @@ def main():
     rs = np.random.RandomState(0)
     imgs, gt_boxes, gt_labels = synthetic_boxes(n, 300, rs)
 
+    # SSD train-time augmentation: box-aware flip/expand/crop chain
+    from analytics_zoo_tpu.feature.image import (
+        ExpandWithBoxes, RandomHFlipWithBoxes, RandomSampleCrop,
+        ResizeWithBoxes)
+    aug = (RandomHFlipWithBoxes(seed=1) >> ExpandWithBoxes(seed=2)
+           >> RandomSampleCrop(seed=3) >> ResizeWithBoxes(300, 300))
+    augmented = [aug.apply((imgs[i], gt_boxes[i], gt_labels[i]))
+                 for i in range(n)]
+    imgs = np.stack([a[0] for a in augmented])
+    gt_boxes = [a[1] for a in augmented]
+    gt_labels = [a[2] for a in augmented]
+
     det = ObjectDetector(class_num=2, backbone=args.backbone, resolution=300)
     det.compile("adam", multibox_loss())
     loc_t, cls_t = det.encode_batch(gt_boxes, gt_labels)

@@ -85,6 +85,23 @@ class TestTraining:
         lrs = read_scalars(os.path.join(str(tmp_path), "app", "train"),
                            "LearningRate")
         assert lrs[0][1] == pytest.approx(1e-2)
+        # per-iteration Throughput (reference getTrainSummary("Throughput"))
+        tp = read_scalars(os.path.join(str(tmp_path), "app", "train"),
+                          "Throughput")
+        assert len(tp) == 8 and all(v > 0 for _, v in tp)
+
+    def test_model_get_train_summary(self, ctx, tmp_path):
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras.layers import Dense
+        x, y = make_regression()
+        m = Sequential([Dense(8, activation="relu"), Dense(1)])
+        m.compile(optimizer="adam", loss="mse")
+        m.set_tensorboard(str(tmp_path), "app")
+        m.fit(x, y, batch_size=64, nb_epoch=2)
+        losses = m.get_train_summary("Loss")
+        assert len(losses) == 8
+        tp = m.get_train_summary("Throughput")
+        assert len(tp) == 8 and all(v > 0 for _, v in tp)
 
 
 class TestCheckpoint:

@@ -223,3 +223,43 @@ class TestNativeWriter:
         w = TFRecordWriter(str(tmp_path / "n.tfrecord"))
         assert w._handle is not None  # really on the C++ path
         w.close()
+
+
+class TestStreamingKerasSurface:
+    """Streaming sets flow through the Keras fit/evaluate surface directly
+    (the reference's PythonLoader sets train endlessly and evaluate in one
+    bounded pass)."""
+
+    def _gen(self):
+        rs = np.random.RandomState(0)
+        for _ in range(64):
+            x = rs.rand(4).astype(np.float32)
+            yield x, np.float32(x.sum() > 2)
+
+    def test_fit_and_evaluate_streaming_positional(self):
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras.layers import Dense
+        fs = FeatureSet.from_generator(self._gen, 64, streaming=True)
+        m = Sequential([Dense(8, activation="relu"),
+                        Dense(2, activation="softmax")])
+        m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        m.fit(fs, batch_size=16, nb_epoch=1)
+        res = m.evaluate(
+            FeatureSet.from_generator(self._gen, 64, streaming=True),
+            batch_size=16)
+        assert "accuracy" in res and 0.0 <= res["accuracy"] <= 1.0
+
+    def test_fit_with_streaming_validation(self):
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras.layers import Dense
+        from analytics_zoo_tpu.common.triggers import EveryEpoch
+        m = Sequential([Dense(8, activation="relu"),
+                        Dense(2, activation="softmax")])
+        m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        m.fit(FeatureSet.from_generator(self._gen, 64, streaming=True),
+              batch_size=16, nb_epoch=2,
+              validation_data=FeatureSet.from_generator(
+                  self._gen, 64, streaming=True),
+              validation_trigger=EveryEpoch())

@@ -164,3 +164,71 @@ class TestSSDEndToEnd:
         (loc, conf), _ = model.call(params, state, x)
         assert loc.shape == (1, 8732, 4)
         assert conf.shape == (1, 8732, 21)
+
+
+class TestDetectionAugmentation:
+    """Box-aware augmentation ops (reference SSD RandomSampler/expand/flip
+    roi transforms)."""
+
+    def _record(self, seed=0):
+        rs = np.random.RandomState(seed)
+        img = rs.rand(60, 80, 3).astype(np.float32)
+        boxes = np.array([[0.25, 0.25, 0.5, 0.5],
+                          [0.6, 0.1, 0.9, 0.4]], np.float32)
+        labels = np.array([1, 2])
+        return img, boxes, labels
+
+    def test_hflip_boxes(self):
+        from analytics_zoo_tpu.feature.image import RandomHFlipWithBoxes
+        img, boxes, labels = self._record()
+        out_img, out_boxes, _ = RandomHFlipWithBoxes(p=1.0).apply(
+            (img, boxes, labels))
+        np.testing.assert_allclose(out_img, img[:, ::-1])
+        np.testing.assert_allclose(out_boxes[0], [0.5, 0.25, 0.75, 0.5],
+                                   atol=1e-6)
+        # widths preserved, order x0 < x1 kept
+        assert (out_boxes[:, 2] > out_boxes[:, 0]).all()
+
+    def test_expand_keeps_boxes_on_content(self):
+        from analytics_zoo_tpu.feature.image import ExpandWithBoxes
+        img, boxes, labels = self._record()
+        out_img, out_boxes, _ = ExpandWithBoxes(max_ratio=3.0, p=1.0,
+                                                seed=0).apply(
+            (img, boxes, labels))
+        assert out_img.shape[0] >= img.shape[0]
+        assert (out_boxes >= 0).all() and (out_boxes <= 1).all()
+        # box area shrinks by the expand ratio squared
+        a0 = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        a1 = (out_boxes[:, 2] - out_boxes[:, 0]) * \
+            (out_boxes[:, 3] - out_boxes[:, 1])
+        assert (a1 < a0).all()
+
+    def test_random_sample_crop_keeps_centered_boxes(self):
+        from analytics_zoo_tpu.feature.image import RandomSampleCrop
+        img, boxes, labels = self._record()
+        op = RandomSampleCrop(min_ious=(0.1,), seed=3)
+        out_img, out_boxes, out_labels = op.apply((img, boxes, labels))
+        assert len(out_boxes) >= 1 and len(out_boxes) == len(out_labels)
+        assert (out_boxes >= -1e-6).all() and (out_boxes <= 1 + 1e-6).all()
+        assert out_img.ndim == 3 and out_img.shape[2] == 3
+
+    def test_chain_into_encode(self, ctx):
+        from analytics_zoo_tpu.feature.image import (
+            ExpandWithBoxes, RandomHFlipWithBoxes, RandomSampleCrop,
+            ResizeWithBoxes)
+        chain = (RandomHFlipWithBoxes(p=0.5, seed=0)
+                 >> ExpandWithBoxes(p=0.5, seed=1)
+                 >> RandomSampleCrop(seed=2)
+                 >> ResizeWithBoxes(120, 120))
+        imgs, all_boxes, all_labels = [], [], []
+        for i in range(4):
+            img, boxes, labels = chain.apply(self._record(seed=i))
+            assert img.shape == (120, 120, 3)
+            imgs.append(img)
+            all_boxes.append(boxes)
+            all_labels.append(labels)
+        det = ObjectDetector(class_num=3, backbone="mobilenet",
+                             resolution=300)
+        # encode the augmented ground truth against SSD anchors
+        loc_t, cls_t = det.encode_batch(all_boxes, all_labels)
+        assert loc_t.shape[0] == 4 and cls_t.shape[0] == 4
