@@ -7,3 +7,5 @@ from .anomalydetection import (  # noqa: F401
 from .textclassification import TextClassifier  # noqa: F401
 from .textmatching import KNRM  # noqa: F401
 from .seq2seq import Seq2seq  # noqa: F401
+from .textmodels import (  # noqa: F401
+    IntentEntity, NER, POSTagger, SequenceTagger)

@@ -1,0 +1,2 @@
+from .sequence_tagger import (  # noqa: F401
+    IntentEntity, NER, POSTagger, SequenceTagger)

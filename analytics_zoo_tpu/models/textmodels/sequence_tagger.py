@@ -1,0 +1,218 @@
+"""Sequence-labeling text models (reference
+``pyzoo/zoo/tfpark/text/keras/{ner.py,pos_tagging.py,intent_extraction.py}``
+which wrap nlp-architect's word+char Bi-LSTM taggers).
+
+Re-designed natively: a shared word+character encoder — word embeddings
+concatenated with a per-word character Bi-LSTM summary (a nested Model
+folded over the sequence axis via ``TimeDistributed``, so the whole char
+pass is ONE fused batch matmul stream on the MXU, no Python loop) — feeding
+a tagger Bi-LSTM. Heads:
+
+- :class:`SequenceTagger` / :class:`POSTagger` / :class:`NER` — per-token
+  softmax tag distribution ``[B, S, num_tags]``. (The reference's CRF head
+  is replaced by a per-token softmax — the decode contract, tag-per-token,
+  is the same.)
+- :class:`IntentEntity` — joint multi-task head: intent ``[B, num_intents]``
+  from pad-masked mean-pooled tagger states plus slot tags
+  ``[B, S, num_entities]``, trained with a weighted joint loss.
+
+Inputs follow the reference contract: word indices ``[B, S]`` and char
+indices ``[B, S, W]``, with index 0 reserved for padding. For padded
+batches pass ``pad_tag`` (the label value used at pad positions, e.g. 0 or
+-1): the tag loss then excludes pad positions (the reference's CRF 'pad'
+mode role); with ``pad_tag=None`` every position counts.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+from ..common import ZooModel, register_zoo_model
+from ...keras import Input, Model
+from ...keras.layers import (
+    Bidirectional, Dense, Dropout, Embedding, Lambda, LSTM, merge,
+    TimeDistributed)
+from ...keras import objectives
+
+
+def _char_word_encoder(seq_len: int, word_len: int, word_vocab: int,
+                       char_vocab: int, word_emb: int, char_emb: int,
+                       char_lstm_dim: int, tagger_lstm_dim: int,
+                       dropout: float):
+    """Shared encoder: returns (inputs, per-token states [B, S, 2*tagger])."""
+    word_in = Input((seq_len,), name="words")
+    char_in = Input((seq_len, word_len), name="chars")
+
+    w = Embedding(word_vocab, word_emb, name="word_embedding")(word_in)
+
+    per_word = Input((word_len,), name="word_chars")
+    ce = Embedding(char_vocab, char_emb, name="char_embedding")(per_word)
+    csum = Bidirectional(LSTM(char_lstm_dim), name="char_bilstm")(ce)
+    char_model = Model(per_word, csum, name="char_encoder")
+    c = TimeDistributed(char_model, name="char_per_token")(char_in)
+
+    x = merge([w, c], mode="concat", name="word_char_concat")
+    x = Dropout(dropout, name="encoder_dropout")(x)
+    x = Bidirectional(LSTM(tagger_lstm_dim, return_sequences=True),
+                      name="tagger_bilstm")(x)
+    return [word_in, char_in], x
+
+
+@register_zoo_model
+class SequenceTagger(ZooModel):
+    """Word+char Bi-LSTM sequence tagger (reference ``pos_tagging.py``
+    SequenceTagger role): softmax tag distribution per token."""
+
+    def __init__(self, num_tags: int, word_vocab_size: int,
+                 char_vocab_size: int, sequence_length: int = 64,
+                 word_length: int = 12, word_emb_dim: int = 100,
+                 char_emb_dim: int = 30, char_lstm_dim: int = 30,
+                 tagger_lstm_dim: int = 100, dropout: float = 0.5,
+                 pad_tag: Any = None):
+        super().__init__()
+        self.num_tags = num_tags
+        self.word_vocab_size = word_vocab_size
+        self.char_vocab_size = char_vocab_size
+        self.sequence_length = sequence_length
+        self.word_length = word_length
+        self.word_emb_dim = word_emb_dim
+        self.char_emb_dim = char_emb_dim
+        self.char_lstm_dim = char_lstm_dim
+        self.tagger_lstm_dim = tagger_lstm_dim
+        self.dropout = dropout
+        self.pad_tag = pad_tag
+
+    def get_config(self) -> Dict[str, Any]:
+        return {"num_tags": self.num_tags,
+                "word_vocab_size": self.word_vocab_size,
+                "char_vocab_size": self.char_vocab_size,
+                "sequence_length": self.sequence_length,
+                "word_length": self.word_length,
+                "word_emb_dim": self.word_emb_dim,
+                "char_emb_dim": self.char_emb_dim,
+                "char_lstm_dim": self.char_lstm_dim,
+                "tagger_lstm_dim": self.tagger_lstm_dim,
+                "dropout": self.dropout,
+                "pad_tag": self.pad_tag}
+
+    def tag_loss(self):
+        """Sparse CE over tokens; with ``pad_tag`` set, pad positions are
+        excluded from the mean (reference CRF 'pad' mode role)."""
+        if self.pad_tag is None:
+            return objectives.get("sparse_categorical_crossentropy")
+        pad = self.pad_tag
+
+        def loss_fn(y_true, y_pred):
+            idx = y_true.astype(jnp.int32)
+            logp = jnp.log(jnp.clip(y_pred, 1e-7, 1.0))
+            tok = -jnp.take_along_axis(
+                logp, jnp.clip(idx, 0, None)[..., None], axis=-1)[..., 0]
+            mask = (idx != pad).astype(tok.dtype)
+            return jnp.sum(tok * mask) / jnp.clip(jnp.sum(mask), 1.0, None)
+        return loss_fn
+
+    def build_model(self) -> Model:
+        inputs, states = _char_word_encoder(
+            self.sequence_length, self.word_length, self.word_vocab_size,
+            self.char_vocab_size, self.word_emb_dim, self.char_emb_dim,
+            self.char_lstm_dim, self.tagger_lstm_dim, self.dropout)
+        tags = Dense(self.num_tags, activation="softmax", name="tags")(states)
+        return Model(inputs, tags, name=type(self).__name__.lower())
+
+    def default_compile(self):
+        self.compile(optimizer="adam", loss=self.tag_loss(),
+                     metrics=[] if self.pad_tag is not None else ["accuracy"])
+
+
+@register_zoo_model
+class POSTagger(SequenceTagger):
+    """Part-of-speech tagger (reference ``pos_tagging.py``)."""
+
+
+@register_zoo_model
+class NER(SequenceTagger):
+    """Named-entity tagger (reference ``ner.py`` NERCRF role; softmax head
+    in place of the CRF — same per-token tag contract)."""
+
+
+@register_zoo_model
+class IntentEntity(ZooModel):
+    """Joint intent classification + slot filling (reference
+    ``intent_extraction.py`` MultiTaskIntentModel): one shared encoder, two
+    heads, trained with ``joint_loss``."""
+
+    def __init__(self, num_intents: int, num_entities: int,
+                 word_vocab_size: int, char_vocab_size: int,
+                 sequence_length: int = 64, word_length: int = 12,
+                 word_emb_dim: int = 100, char_emb_dim: int = 30,
+                 char_lstm_dim: int = 30, tagger_lstm_dim: int = 100,
+                 dropout: float = 0.2, intent_loss_weight: float = 1.0,
+                 pad_tag: Any = None):
+        super().__init__()
+        self.num_intents = num_intents
+        self.num_entities = num_entities
+        self.word_vocab_size = word_vocab_size
+        self.char_vocab_size = char_vocab_size
+        self.sequence_length = sequence_length
+        self.word_length = word_length
+        self.word_emb_dim = word_emb_dim
+        self.char_emb_dim = char_emb_dim
+        self.char_lstm_dim = char_lstm_dim
+        self.tagger_lstm_dim = tagger_lstm_dim
+        self.dropout = dropout
+        self.intent_loss_weight = intent_loss_weight
+        self.pad_tag = pad_tag
+
+    def get_config(self) -> Dict[str, Any]:
+        return {"num_intents": self.num_intents,
+                "num_entities": self.num_entities,
+                "word_vocab_size": self.word_vocab_size,
+                "char_vocab_size": self.char_vocab_size,
+                "sequence_length": self.sequence_length,
+                "word_length": self.word_length,
+                "word_emb_dim": self.word_emb_dim,
+                "char_emb_dim": self.char_emb_dim,
+                "char_lstm_dim": self.char_lstm_dim,
+                "tagger_lstm_dim": self.tagger_lstm_dim,
+                "dropout": self.dropout,
+                "intent_loss_weight": self.intent_loss_weight,
+                "pad_tag": self.pad_tag}
+
+    def build_model(self) -> Model:
+        inputs, states = _char_word_encoder(
+            self.sequence_length, self.word_length, self.word_vocab_size,
+            self.char_vocab_size, self.word_emb_dim, self.char_emb_dim,
+            self.char_lstm_dim, self.tagger_lstm_dim, self.dropout)
+        # intent vector = mean over REAL tokens only (word index 0 = pad),
+        # so short sentences aren't diluted by pad-position LSTM states
+        def masked_mean(ts):
+            states_t, words_t = ts
+            mask = (words_t != 0).astype(states_t.dtype)[..., None]
+            return (jnp.sum(states_t * mask, axis=1)
+                    / jnp.clip(jnp.sum(mask, axis=1), 1.0, None))
+
+        pooled = Lambda(masked_mean, name="masked_mean_pool")(
+            [states, inputs[0]])
+        intent = Dense(self.num_intents, activation="softmax",
+                       name="intent")(pooled)
+        slots = Dense(self.num_entities, activation="softmax",
+                      name="slots")(states)
+        return Model(inputs, [intent, slots], name="intent_entity")
+
+    def joint_loss(self):
+        """``y = (intent_labels [B], slot_labels [B, S])``; weighted sum of
+        the intent CE and the (pad-masked, when ``pad_tag`` is set) slot
+        CE."""
+        sce = objectives.get("sparse_categorical_crossentropy")
+        slot_loss = SequenceTagger.tag_loss(self)  # shares pad_tag handling
+        w = self.intent_loss_weight
+
+        def loss_fn(y_true, y_pred):
+            intent_t, slots_t = y_true
+            intent_p, slots_p = y_pred
+            return w * sce(intent_t, intent_p) + slot_loss(slots_t, slots_p)
+        return loss_fn
+
+    def default_compile(self):
+        self.compile(optimizer="adam", loss=self.joint_loss())

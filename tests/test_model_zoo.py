@@ -248,3 +248,98 @@ class TestImageClassifierBackbones:
         for r in out:
             assert all(lbl in ("cat", "dog", "fish") for lbl, _ in r)
             assert r[0][1] >= r[1][1]
+
+
+class TestSequenceTaggers:
+    """Word+char taggers (reference tfpark/text/keras NER/POS/IntentEntity)."""
+
+    def _data(self, B=8, S=10, W=6, seed=9):
+        rs = np.random.RandomState(seed)
+        words = rs.randint(1, 40, (B, S)).astype(np.float32)
+        chars = rs.randint(1, 20, (B, S, W)).astype(np.float32)
+        tags = rs.randint(0, 5, (B, S)).astype(np.float32)
+        return words, chars, tags
+
+    def test_ner_fit_predict(self, ctx):
+        from analytics_zoo_tpu.models import NER
+        words, chars, tags = self._data()
+        ner = NER(num_tags=5, word_vocab_size=40, char_vocab_size=20,
+                  sequence_length=10, word_length=6, word_emb_dim=16,
+                  char_emb_dim=8, char_lstm_dim=8, tagger_lstm_dim=16)
+        ner.default_compile()
+        ner.fit([words, chars], tags, batch_size=8, nb_epoch=1)
+        p = np.asarray(ner.predict([words, chars], batch_size=8))
+        assert p.shape == (8, 10, 5)
+        np.testing.assert_allclose(p.sum(-1), 1, atol=1e-4)
+
+    def test_intent_entity_joint(self, ctx):
+        from analytics_zoo_tpu.models import IntentEntity
+        words, chars, tags = self._data()
+        rs = np.random.RandomState(1)
+        intents = rs.randint(0, 3, 8).astype(np.float32)
+        ie = IntentEntity(num_intents=3, num_entities=5, word_vocab_size=40,
+                          char_vocab_size=20, sequence_length=10,
+                          word_length=6, word_emb_dim=16, char_emb_dim=8,
+                          char_lstm_dim=8, tagger_lstm_dim=16)
+        ie.default_compile()
+        ie.fit([words, chars], (intents, tags), batch_size=8, nb_epoch=1)
+        ip, sp = ie.predict([words, chars], batch_size=8)
+        assert np.asarray(ip).shape == (8, 3)
+        assert np.asarray(sp).shape == (8, 10, 5)
+
+    def test_save_load_roundtrip(self, ctx, tmp_path):
+        from analytics_zoo_tpu.models import SequenceTagger, ZooModel
+        words, chars, tags = self._data()
+        st = SequenceTagger(num_tags=5, word_vocab_size=40,
+                            char_vocab_size=20, sequence_length=10,
+                            word_length=6, word_emb_dim=16, char_emb_dim=8,
+                            char_lstm_dim=8, tagger_lstm_dim=16)
+        st.default_compile()
+        st.fit([words, chars], tags, batch_size=8, nb_epoch=1)
+        p1 = np.asarray(st.predict([words, chars], batch_size=8))
+        path = str(tmp_path / "tagger")
+        st.save_model(path)
+        st2 = ZooModel.load_model(path)
+        p2 = np.asarray(st2.predict([words, chars], batch_size=8))
+        np.testing.assert_allclose(p1, p2, atol=1e-5)
+
+    def test_pad_masked_tag_loss(self, ctx):
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.models import NER
+        ner = NER(num_tags=4, word_vocab_size=40, char_vocab_size=20,
+                  sequence_length=6, word_length=4, pad_tag=-1)
+        loss_fn = ner.tag_loss()
+        # two tokens real, one pad (-1): pad position must not contribute
+        y_true = jnp.asarray([[0.0, 1.0, -1.0]])
+        good = jnp.asarray([[[0.97, 0.01, 0.01, 0.01],
+                             [0.01, 0.97, 0.01, 0.01],
+                             [0.25, 0.25, 0.25, 0.25]]])
+        bad_pad = jnp.asarray([[[0.97, 0.01, 0.01, 0.01],
+                                [0.01, 0.97, 0.01, 0.01],
+                                [0.97, 0.01, 0.01, 0.01]]])
+        assert float(loss_fn(y_true, good)) == pytest.approx(
+            float(loss_fn(y_true, bad_pad)))  # pad prob irrelevant
+        # and real positions still matter
+        wrong = jnp.asarray([[[0.01, 0.97, 0.01, 0.01],
+                              [0.97, 0.01, 0.01, 0.01],
+                              [0.25, 0.25, 0.25, 0.25]]])
+        assert float(loss_fn(y_true, wrong)) > float(loss_fn(y_true, good))
+
+    def test_padded_fit(self, ctx):
+        from analytics_zoo_tpu.models import IntentEntity
+        rs = np.random.RandomState(2)
+        B, S, W = 8, 10, 6
+        words = rs.randint(1, 40, (B, S)).astype(np.float32)
+        words[:, 6:] = 0  # pad tail positions
+        chars = rs.randint(1, 20, (B, S, W)).astype(np.float32)
+        chars[:, 6:] = 0
+        tags = rs.randint(0, 5, (B, S)).astype(np.float32)
+        tags[:, 6:] = -1  # pad label
+        intents = rs.randint(0, 3, B).astype(np.float32)
+        ie = IntentEntity(num_intents=3, num_entities=5, word_vocab_size=40,
+                          char_vocab_size=20, sequence_length=S,
+                          word_length=W, word_emb_dim=16, char_emb_dim=8,
+                          char_lstm_dim=8, tagger_lstm_dim=16, pad_tag=-1)
+        ie.default_compile()
+        h = ie.fit([words, chars], (intents, tags), batch_size=8, nb_epoch=1)
+        assert np.isfinite(h["loss_history"]).all()
