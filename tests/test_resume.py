@@ -11,6 +11,7 @@ from analytics_zoo_tpu.estimator import Estimator
 from analytics_zoo_tpu.feature import FeatureSet
 from analytics_zoo_tpu.keras import Sequential, objectives, optimizers
 from analytics_zoo_tpu.keras.layers import Activation, Dense
+from analytics_zoo_tpu.common.config import global_config
 
 
 def _data(n=32):
@@ -104,3 +105,66 @@ class TestResumeReproducibility:
         est_b.train(FeatureSet.from_ndarrays(x, y), batch_size=8, epochs=1)
         with pytest.raises(ValueError, match="structure does not match"):
             est_b.load_checkpoint(est_a._latest_snapshot())
+
+
+class TestElasticRetry:
+    """Fault injection for the retry-from-checkpoint loop (reference
+    InternalDistriOptimizer retry semantics, Topology.scala:1180-1262)."""
+
+    def test_recovers_from_transient_step_failure(self, ctx, tmp_path):
+        rs = np.random.RandomState(0)
+        x = rs.rand(256, 4).astype(np.float32)
+        y = (x.sum(1) > 2).astype(np.float32)
+        est = Estimator(
+            model=Sequential([Dense(8, activation="relu"), Dense(2)]),
+            loss_fn=objectives.get(
+                "sparse_categorical_crossentropy_from_logits"),
+            optimizer=optimizers.Adam(1e-2))
+        est.set_checkpoint(str(tmp_path), SeveralIteration(2))
+        fs = FeatureSet.from_ndarrays(x, y)
+        est.train(fs, batch_size=64, epochs=1)  # 4 its; snapshots at 2 and 4
+
+        # inject: the next dispatched step blows up ONCE (transient chip /
+        # tunnel failure), later steps succeed
+        real_step = est._train_step
+        state = {"failed": False}
+
+        def flaky_step(*args):
+            if not state["failed"] and est.global_step == 5:
+                state["failed"] = True
+                raise RuntimeError("injected transient step failure")
+            return real_step(*args)
+
+        est._train_step = flaky_step
+        out = est.train(fs, batch_size=64, epochs=2)
+        assert state["failed"], "fault was never injected"
+        # training completed both epochs after recovering from the snapshot
+        # (est.epoch is the 1-based NEXT epoch: 3 == two epochs done)
+        assert est.epoch == 3
+        assert est.global_step == 8  # no steps lost or duplicated
+        assert np.isfinite(out["loss_history"]).all()
+
+    def test_retry_budget_exhausts(self, ctx, tmp_path):
+        rs = np.random.RandomState(0)
+        x = rs.rand(128, 4).astype(np.float32)
+        y = rs.rand(128, 1).astype(np.float32)
+        est = Estimator(model=Sequential([Dense(4), Dense(1)]),
+                        loss_fn=objectives.get("mse"),
+                        optimizer=optimizers.SGD(0.01))
+        est.set_checkpoint(str(tmp_path), SeveralIteration(1))
+        fs = FeatureSet.from_ndarrays(x, y)
+        est.train(fs, batch_size=64, epochs=1)
+
+        calls = {"n": 0}
+
+        def always_fails(*args):
+            calls["n"] += 1
+            raise RuntimeError("permanent failure")
+
+        est._train_step = always_fails
+        budget = int(global_config().get("failure.retry_times"))
+        with pytest.raises(RuntimeError, match="permanent failure"):
+            est.train(fs, batch_size=64, epochs=2)
+        # the loop consumed its whole retry budget before surfacing: one
+        # initial attempt + `budget` retries from the snapshot
+        assert calls["n"] == budget + 1
