@@ -43,6 +43,10 @@ class _TimerRegistry:
 
 timers = _TimerRegistry()
 
+# span observers (utils/trace.py chrome-trace recorder registers here);
+# called as fn(name, start_perf_counter, elapsed_seconds)
+span_hooks: list = []
+
 
 @contextlib.contextmanager
 def time_it(name: str, log: bool = False) -> Iterator[None]:
@@ -52,6 +56,8 @@ def time_it(name: str, log: bool = False) -> Iterator[None]:
     finally:
         elapsed = time.perf_counter() - start
         timers.add(name, elapsed)
+        for hook in span_hooks:
+            hook(name, start, elapsed)
         if log:
             logger.info("%s: %.3fms", name, elapsed * 1e3)
 

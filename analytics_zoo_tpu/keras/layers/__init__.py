@@ -28,3 +28,4 @@ from .advanced import (  # noqa: F401
     TimeDistributed)
 from .attention import (  # noqa: F401
     BERT, MultiHeadAttention, TransformerLayer)
+from .crf import CRF, crf_decode, crf_nll  # noqa: F401

@@ -1,0 +1,148 @@
+"""Linear-chain CRF head (reference ``ner.py``'s nlp-architect NERCRF —
+the Bi-LSTM+CRF sequence classifier — natively re-designed).
+
+TPU design: the layer lowers emissions ``[B, S, T]`` into per-step
+transition log-potentials ``[B, S, T, T]``:
+
+    potentials[b, s, i, j] = emissions[b, s, j] + transitions[i, j]   (s > 0)
+    potentials[b, 0, i, j] = emissions[b, 0, j] + start[j]            (all i)
+
+Everything downstream — the negative-log-likelihood (:func:`crf_nll`, the
+forward algorithm) and Viterbi decode (:func:`crf_decode`) — is a pure
+function of the potentials tensor, so the training loss fits the engine's
+``loss(y_true, y_pred)`` contract without reaching into layer parameters,
+and both run as single ``lax.scan`` loops over the sequence axis (compiler-
+friendly: no data-dependent Python control flow, static shapes). ``T`` is a
+tag set (tens), so the T× blow-up over raw emissions is noise next to the
+LSTM states feeding it.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .core import Layer, initializers
+
+
+class CRF(Layer):
+    """Turns emission scores ``[B, S, T]`` into linear-chain log-potentials
+    ``[B, S, T, T]`` with learned transition/start scores. Feed a LINEAR
+    (no softmax) Dense of width ``num_tags`` into this layer; train with
+    :func:`crf_nll`, decode with :func:`crf_decode`."""
+
+    def __init__(self, num_tags: int, init="glorot_uniform",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.num_tags = num_tags
+        self.init = initializers.get(init)
+
+    def build(self, rng, input_shape):
+        if input_shape[-1] != self.num_tags:
+            raise ValueError(
+                f"CRF expects emissions with last dim {self.num_tags}, "
+                f"got {input_shape[-1]}")
+        k1, k2 = jax.random.split(rng)
+        t = self.num_tags
+        return {"transitions": self.init(k1, (t, t)),
+                "start": self.init(k2, (t,))}, {}
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        emis = inputs  # [B, S, T]
+        pot = emis[:, :, None, :] + params["transitions"][None, None]
+        first = emis[:, 0, None, :] + params["start"][None, None, :]
+        pot = pot.at[:, 0].set(jnp.broadcast_to(
+            first, (emis.shape[0], self.num_tags, self.num_tags)))
+        return pot, state
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.num_tags, self.num_tags)
+
+
+def _seq_mask(y_true: jnp.ndarray, pad_tag: Any) -> jnp.ndarray:
+    if pad_tag is None:
+        return jnp.ones(y_true.shape, jnp.float32)
+    return (y_true.astype(jnp.int32) != pad_tag).astype(jnp.float32)
+
+
+def crf_nll(pad_tag: Any = None):
+    """Negative log-likelihood loss over CRF potentials.
+
+    ``y_true``: tags ``[B, S]`` (``pad_tag`` at suffix pad positions);
+    ``y_pred``: potentials ``[B, S, T, T]`` from the :class:`CRF` layer.
+    Masked positions contribute neither emission nor transition score and
+    are frozen out of the forward recursion.
+    """
+
+    def loss_fn(y_true, y_pred):
+        pot = y_pred
+        idx = jnp.clip(y_true.astype(jnp.int32), 0, None)
+        mask = _seq_mask(y_true, pad_tag)  # [B, S]
+
+        # log-partition: forward algorithm over the sequence axis
+        alpha = pot[:, 0, 0, :]  # [B, T] (row i is constant at s=0)
+
+        def fwd(alpha, inp):
+            pot_s, m = inp  # [B, T, T], [B]
+            new = jax.nn.logsumexp(alpha[:, :, None] + pot_s, axis=1)
+            alpha = jnp.where(m[:, None] > 0, new, alpha)
+            return alpha, None
+
+        alpha, _ = jax.lax.scan(
+            fwd, alpha,
+            (jnp.swapaxes(pot[:, 1:], 0, 1), jnp.swapaxes(mask[:, 1:], 0, 1)))
+        log_z = jax.nn.logsumexp(alpha, axis=-1)  # [B]
+
+        # gold-path score
+        first = jnp.take_along_axis(pot[:, 0, 0, :], idx[:, :1],
+                                    axis=1)[:, 0]  # [B]
+        prev, nxt = idx[:, :-1], idx[:, 1:]
+        from_prev = jnp.take_along_axis(
+            pot[:, 1:], prev[:, :, None, None], axis=2)[:, :, 0]  # [B,S-1,T]
+        steps = jnp.take_along_axis(
+            from_prev, nxt[:, :, None], axis=2)[:, :, 0]  # [B, S-1]
+        score = first + jnp.sum(steps * mask[:, 1:], axis=1)
+        return jnp.mean(log_z - score)
+
+    return loss_fn
+
+
+def crf_decode(potentials, pad_tag: Any = None,
+               y_like: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Viterbi decode: best tag path ``[B, S]`` from potentials
+    ``[B, S, T, T]``. With ``pad_tag`` + ``y_like`` (the padded tag array),
+    masked positions are emitted as ``pad_tag``."""
+    pot = jnp.asarray(potentials)
+    B, S, T, _ = pot.shape
+    mask = (jnp.ones((B, S), jnp.float32) if y_like is None or pad_tag is None
+            else _seq_mask(y_like, pad_tag))
+
+    delta = pot[:, 0, 0, :]  # [B, T]
+
+    def fwd(delta, inp):
+        pot_s, m = inp
+        scores = delta[:, :, None] + pot_s  # [B, T, T]
+        best_prev = jnp.argmax(scores, axis=1)  # [B, T]
+        new = jnp.max(scores, axis=1)
+        keep = m[:, None] > 0
+        return (jnp.where(keep, new, delta),
+                jnp.where(keep, best_prev,
+                          jnp.broadcast_to(jnp.arange(T)[None], (B, T))))
+
+    delta, backptrs = jax.lax.scan(
+        fwd, delta,
+        (jnp.swapaxes(pot[:, 1:], 0, 1), jnp.swapaxes(mask[:, 1:], 0, 1)))
+    last = jnp.argmax(delta, axis=-1)  # [B]
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    first, path = jax.lax.scan(back, last, backptrs, reverse=True)
+    # path[k] = tag at position k+1 (scan stacks in original order even when
+    # reversed); the final carry is the tag at position 0
+    tags = jnp.concatenate([first[:, None], jnp.swapaxes(path, 0, 1)], axis=1)
+    if pad_tag is not None and y_like is not None:
+        tags = jnp.where(mask > 0, tags, pad_tag)
+    return tags

@@ -9,9 +9,9 @@ pass is ONE fused batch matmul stream on the MXU, no Python loop) — feeding
 a tagger Bi-LSTM. Heads:
 
 - :class:`SequenceTagger` / :class:`POSTagger` / :class:`NER` — per-token
-  softmax tag distribution ``[B, S, num_tags]``. (The reference's CRF head
-  is replaced by a per-token softmax — the decode contract, tag-per-token,
-  is the same.)
+  softmax tag distribution ``[B, S, num_tags]``, or with ``crf=True`` a
+  linear-chain CRF head (the reference's NERCRF): ``predict`` then returns
+  transition log-potentials and :meth:`SequenceTagger.decode` runs Viterbi.
 - :class:`IntentEntity` — joint multi-task head: intent ``[B, num_intents]``
   from pad-masked mean-pooled tagger states plus slot tags
   ``[B, S, num_entities]``, trained with a weighted joint loss.
@@ -69,8 +69,9 @@ class SequenceTagger(ZooModel):
                  word_length: int = 12, word_emb_dim: int = 100,
                  char_emb_dim: int = 30, char_lstm_dim: int = 30,
                  tagger_lstm_dim: int = 100, dropout: float = 0.5,
-                 pad_tag: Any = None):
+                 pad_tag: Any = None, crf: bool = False):
         super().__init__()
+        self.crf = crf
         self.num_tags = num_tags
         self.word_vocab_size = word_vocab_size
         self.char_vocab_size = char_vocab_size
@@ -94,7 +95,8 @@ class SequenceTagger(ZooModel):
                 "char_lstm_dim": self.char_lstm_dim,
                 "tagger_lstm_dim": self.tagger_lstm_dim,
                 "dropout": self.dropout,
-                "pad_tag": self.pad_tag}
+                "pad_tag": self.pad_tag,
+                "crf": self.crf}
 
     def tag_loss(self):
         """Sparse CE over tokens; with ``pad_tag`` set, pad positions are
@@ -117,10 +119,40 @@ class SequenceTagger(ZooModel):
             self.sequence_length, self.word_length, self.word_vocab_size,
             self.char_vocab_size, self.word_emb_dim, self.char_emb_dim,
             self.char_lstm_dim, self.tagger_lstm_dim, self.dropout)
+        if self.crf:
+            from ...keras.layers.crf import CRF
+            emis = Dense(self.num_tags, name="emissions")(states)
+            pot = CRF(self.num_tags, name="crf")(emis)
+            return Model(inputs, pot, name=type(self).__name__.lower())
         tags = Dense(self.num_tags, activation="softmax", name="tags")(states)
         return Model(inputs, tags, name=type(self).__name__.lower())
 
+    def decode(self, x, batch_size: int = 32):
+        """Hard tag path per sequence ``[B, S]``: Viterbi for the CRF head,
+        per-token argmax for the softmax head. With ``pad_tag`` set, pad
+        positions (word index 0) are masked out of the Viterbi recursion and
+        emitted as ``pad_tag``."""
+        import numpy as np
+        pred = self.predict(x, batch_size=batch_size)
+        if self.crf:
+            from ...keras.layers.crf import crf_decode
+            if self.pad_tag is not None:
+                words = np.asarray(x[0] if isinstance(x, (list, tuple))
+                                   else x)
+                # synthesize a tags-shaped array whose pad positions carry
+                # pad_tag so crf_decode's mask derivation applies
+                y_like = jnp.where(jnp.asarray(words) != 0,
+                                   self.pad_tag + 1, self.pad_tag)
+                return np.asarray(crf_decode(pred, pad_tag=self.pad_tag,
+                                             y_like=y_like))
+            return np.asarray(crf_decode(pred))
+        return np.asarray(jnp.argmax(jnp.asarray(pred), axis=-1))
+
     def default_compile(self):
+        if self.crf:
+            from ...keras.layers.crf import crf_nll
+            self.compile(optimizer="adam", loss=crf_nll(self.pad_tag))
+            return
         self.compile(optimizer="adam", loss=self.tag_loss(),
                      metrics=[] if self.pad_tag is not None else ["accuracy"])
 
@@ -132,8 +164,9 @@ class POSTagger(SequenceTagger):
 
 @register_zoo_model
 class NER(SequenceTagger):
-    """Named-entity tagger (reference ``ner.py`` NERCRF role; softmax head
-    in place of the CRF — same per-token tag contract)."""
+    """Named-entity tagger (reference ``ner.py`` NERCRF): softmax head by
+    default, or the full linear-chain CRF head with ``crf=True`` (train
+    with ``crf_nll`` via ``default_compile``, decode with Viterbi)."""
 
 
 @register_zoo_model

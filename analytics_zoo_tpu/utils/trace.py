@@ -1,0 +1,90 @@
+"""Chrome-trace span recorder over the ``time_it`` micro-profiler.
+
+The reference stops at aggregate wall-time logs (``Utils.timeIt``,
+``zoo/.../common/Utils.scala``; BigDL ``Metrics`` phase totals) — SURVEY §5
+notes it has "no sampling profiler / chrome-trace". This goes one step
+further: while a :func:`trace` session is active, every ``time_it`` span
+(train_step, device feed waits, serving phases — anything already
+instrumented) is recorded as a complete event and written out in the
+Chrome ``chrome://tracing`` / Perfetto JSON array format, so a training or
+serving run can be inspected on a timeline per thread.
+
+Usage::
+
+    from analytics_zoo_tpu.utils.trace import trace
+    with trace("/tmp/train_trace.json"):
+        estimator.train(fs, batch_size=..., epochs=1)
+    # open chrome://tracing or https://ui.perfetto.dev and load the file
+
+Spans from any thread are captured (producer threads show as separate
+rows). Recording costs one list-append per span; when no session is
+active the hook is a no-op.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Iterator, List, Optional
+
+from ..common import utils as _utils
+
+
+class _TraceSession:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self.t0 = time.perf_counter()
+
+    def add(self, name: str, start: float, elapsed: float) -> None:
+        with self._lock:
+            self._events.append({
+                "name": name,
+                "ph": "X",  # complete event
+                "ts": (start - self.t0) * 1e6,  # microseconds
+                "dur": elapsed * 1e6,
+                "pid": 0,
+                "tid": threading.get_ident(),
+                "cat": "analytics_zoo_tpu",
+            })
+
+    def dump(self, path: str) -> int:
+        with self._lock:
+            events = list(self._events)
+        names = {}
+        for ev in events:  # readable row names per thread
+            names.setdefault(ev["tid"], None)
+        meta = [{"name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+                 "args": {"name": f"thread-{i}"}}
+                for i, tid in enumerate(sorted(names))]
+        with open(path, "w") as f:
+            json.dump(meta + events, f)
+        return len(events)
+
+
+_active: Optional[_TraceSession] = None
+
+
+def _record(name: str, start: float, elapsed: float) -> None:
+    session = _active
+    if session is not None:
+        session.add(name, start, elapsed)
+
+
+_utils.span_hooks.append(_record)  # no-op while no session is active
+
+
+@contextlib.contextmanager
+def trace(path: str) -> Iterator[_TraceSession]:
+    """Record every ``time_it`` span until exit, then write Chrome-trace
+    JSON to ``path``. Sessions don't nest (the inner one wins)."""
+    global _active
+    session = _TraceSession()
+    prev, _active = _active, session
+    try:
+        yield session
+    finally:
+        _active = prev
+        count = session.dump(path)
+        _utils.logger.info("trace: wrote %d spans to %s", count, path)

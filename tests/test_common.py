@@ -125,3 +125,42 @@ class TestSummary:
         text = model.summary(print_fn=None)
         assert "(frozen)" in text
         assert "trainable: 18" in text  # head: 8*2+2
+
+
+class TestChromeTrace:
+    def test_trace_records_time_it_spans(self, tmp_path):
+        import json
+        from analytics_zoo_tpu.common.utils import time_it
+        from analytics_zoo_tpu.utils.trace import trace
+
+        path = str(tmp_path / "trace.json")
+        with trace(path):
+            with time_it("phase_a"):
+                pass
+            with time_it("phase_b"):
+                pass
+        with time_it("after_session"):  # must NOT be recorded
+            pass
+        events = json.load(open(path))
+        spans = [e for e in events if e.get("ph") == "X"]
+        assert {s["name"] for s in spans} == {"phase_a", "phase_b"}
+        for s in spans:
+            assert s["dur"] >= 0 and "ts" in s and "tid" in s
+
+    def test_trace_captures_training_steps(self, ctx, tmp_path):
+        import json
+        import numpy as np
+        from analytics_zoo_tpu.feature import FeatureSet
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras.layers import Dense
+        from analytics_zoo_tpu.utils.trace import trace
+
+        x = np.random.rand(64, 4).astype(np.float32)
+        y = np.random.rand(64, 1).astype(np.float32)
+        m = Sequential([Dense(4), Dense(1)])
+        m.compile(optimizer="sgd", loss="mse")
+        path = str(tmp_path / "train.json")
+        with trace(path):
+            m.fit(FeatureSet.from_ndarrays(x, y), batch_size=32, nb_epoch=1)
+        spans = [e for e in json.load(open(path)) if e.get("ph") == "X"]
+        assert sum(s["name"] == "train_step" for s in spans) == 2
