@@ -1,1 +1,2 @@
-from .shard import DataShards, read_csv, read_json  # noqa: F401
+from .shard import (  # noqa: F401
+    DataShards, read_csv, read_json, read_parquet)

@@ -84,25 +84,42 @@ def _expand(path: str, exts: Sequence[str]) -> List[str]:
     return sorted(glob.glob(path)) or [path]
 
 
+def _read(path: str, exts: Sequence[str], reader: Callable,
+          num_shards: Optional[int], **pandas_kwargs) -> DataShards:
+    """One shard per matched file; falls back to ``reader(path)`` when the
+    dir glob matches nothing (e.g. a hive-partitioned parquet dataset dir,
+    which pandas reads natively)."""
+    files = _expand(path, exts)
+    if not files:
+        files = [path]
+    dfs = [reader(f, **pandas_kwargs) for f in files]
+    shards = DataShards(dfs)
+    if num_shards and num_shards != len(dfs):
+        shards = shards.repartition(num_shards)
+    return shards
+
+
 def read_csv(path: str, num_shards: Optional[int] = None,
              **pandas_kwargs) -> DataShards:
     """Read csv file(s)/dir/glob into shards (reference ``read_csv``:
     one shard per file, or row-split when a single file)."""
     import pandas as pd
-    files = _expand(path, [".csv"])
-    dfs = [pd.read_csv(f, **pandas_kwargs) for f in files]
-    shards = DataShards(dfs)
-    if num_shards and num_shards != len(dfs):
-        shards = shards.repartition(num_shards)
-    return shards
+    return _read(path, [".csv"], pd.read_csv, num_shards, **pandas_kwargs)
 
 
 def read_json(path: str, num_shards: Optional[int] = None,
               **pandas_kwargs) -> DataShards:
     import pandas as pd
-    files = _expand(path, [".json", ".jsonl"])
-    dfs = [pd.read_json(f, **pandas_kwargs) for f in files]
-    shards = DataShards(dfs)
-    if num_shards and num_shards != len(dfs):
-        shards = shards.repartition(num_shards)
-    return shards
+    return _read(path, [".json", ".jsonl"], pd.read_json, num_shards,
+                 **pandas_kwargs)
+
+
+def read_parquet(path: str, num_shards: Optional[int] = None,
+                 **pandas_kwargs) -> DataShards:
+    """Read parquet file(s)/dir/glob into shards (reference XShards
+    ``read_parquet``; columnar files are the Criteo-scale interchange
+    format). A partitioned dataset directory (no top-level ``*.parquet``)
+    is read whole via pandas' native dataset support."""
+    import pandas as pd
+    return _read(path, [".parquet", ".pq"], pd.read_parquet, num_shards,
+                 **pandas_kwargs)

@@ -224,3 +224,45 @@ class TestXShard:
         assert shards.num_partitions() == 4
         fs = shards.to_featureset(["x"], ["y"], shuffle=False)
         assert fs.size == 10
+
+    def test_read_partitioned_dataset_dir(self, ctx, tmp_path):
+        import pandas as pd
+        from analytics_zoo_tpu.xshard import read_parquet
+        # hive layout: no top-level *.parquet, pandas reads the dir natively
+        for day in range(2):
+            sub = tmp_path / f"day={day}"
+            sub.mkdir()
+            pd.DataFrame({"x": np.arange(3, dtype=float)}).to_parquet(
+                sub / "part.parquet")
+        shards = read_parquet(str(tmp_path))
+        assert shards.num_partitions() == 1
+        assert len(shards.concat_to_pandas()) == 6
+
+
+class TestXShardParquet:
+    def test_read_parquet_roundtrip(self, ctx, tmp_path):
+        import pandas as pd
+        from analytics_zoo_tpu.xshard import read_parquet
+        for i in range(2):
+            pd.DataFrame({"x": np.arange(5, dtype=float) + 5 * i,
+                          "y": np.arange(5, dtype=float)}).to_parquet(
+                tmp_path / f"part-{i}.parquet")
+        shards = read_parquet(str(tmp_path))
+        assert shards.num_partitions() == 2
+        whole = shards.concat_to_pandas()
+        assert len(whole) == 10 and whole["x"].sum() == sum(range(10))
+        fs = shards.to_featureset(["x"], ["y"], shuffle=False)
+        assert fs.size == 10
+
+    def test_read_partitioned_dataset_dir(self, ctx, tmp_path):
+        import pandas as pd
+        from analytics_zoo_tpu.xshard import read_parquet
+        # hive layout: no top-level *.parquet, pandas reads the dir natively
+        for day in range(2):
+            sub = tmp_path / f"day={day}"
+            sub.mkdir()
+            pd.DataFrame({"x": np.arange(3, dtype=float)}).to_parquet(
+                sub / "part.parquet")
+        shards = read_parquet(str(tmp_path))
+        assert shards.num_partitions() == 1
+        assert len(shards.concat_to_pandas()) == 6

@@ -43,6 +43,31 @@ class TestWideAndDeep:
         assert preds.shape == (32, 2)
         np.testing.assert_allclose(np.asarray(preds).sum(1), 1, atol=1e-4)
 
+    def test_criteo_scale_vocab(self, ctx):
+        """The sparse wide/embed path must survive Criteo-scale vocabularies
+        (SURVEY §7 hard part (b)): 2M-entry wide table + 1M-entry embedding.
+        A one-hot densification would materialize [B, 2e6] activations and
+        grads; the gather + scatter-add design keeps this cheap."""
+        wide_dim, embed_dim = 2_000_000, 1_000_000
+        rs = np.random.RandomState(1)
+        n = 64
+        wide = rs.randint(0, wide_dim, (n, 2)).astype(np.float32)
+        emb = rs.randint(0, embed_dim, (n, 1)).astype(np.float32)
+        cont = rs.rand(n, 2).astype(np.float32)
+        y = rs.randint(0, 2, n).astype(np.float32)
+        info = ColumnFeatureInfo(
+            wide_base_cols=["a", "b"], wide_base_dims=[wide_dim // 2] * 2,
+            embed_cols=["d"], embed_in_dims=[embed_dim],
+            embed_out_dims=[16], continuous_cols=["x1", "x2"])
+        wnd = WideAndDeep("wide_n_deep", num_classes=2, column_info=info,
+                          hidden_layers=[16, 8])
+        wnd.default_compile()
+        ind = np.zeros((n, 0), np.float32)  # no indicator columns
+        hist = wnd.fit([wide, ind, emb, cont], y, batch_size=32, nb_epoch=1)
+        assert np.isfinite(hist["loss_history"]).all()
+        preds = wnd.predict([wide, ind, emb, cont], batch_size=32)
+        assert preds.shape == (n, 2)
+
     def test_wide_only_and_deep_only(self, ctx):
         x, y = self.make_data(16)
         for mt in ("wide", "deep"):
