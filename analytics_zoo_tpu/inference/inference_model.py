@@ -191,24 +191,96 @@ class InferenceModel:
         self._params = self._device(qparams)
         return self
 
+    # -- AOT artifact export/import (OpenVINO model-optimizer IR role) --------
+
+    def export_compiled(self, path: str, example,
+                        batch_sizes: Sequence[int] = (1, 8, 32, 128),
+                        platforms: Sequence[str] = ("cpu", "tpu")
+                        ) -> "InferenceModel":
+        """Ahead-of-time compile the loaded forward at fixed batch buckets
+        and serialize the artifacts to ``path`` (≙ OpenVINO model-optimizer
+        IR emission, ``OpenVinoInferenceSupportive.scala:64-123``). Params
+        are frozen into the artifact as constants — the exported file IS the
+        model, no separate weights. ``example``: one input batch (any batch
+        size) fixing dtypes/feature shapes. Artifacts lower for every
+        platform in ``platforms`` so an export made on a CPU host serves on
+        TPU."""
+        import json
+        import os
+
+        import jax.export as jex
+
+        if self._forward is None:
+            raise RuntimeError("load a model first")
+        os.makedirs(path, exist_ok=True)
+        multi = isinstance(example, (list, tuple))
+        xs = [np.asarray(a) for a in (example if multi else [example])]
+        params = self._params
+        fwd = self._forward
+        # mirror predict()'s calling convention exactly: a list input stays
+        # a list even with one element
+        if multi:
+            frozen = jax.jit(lambda *args: fwd(params, list(args)))
+        else:
+            frozen = jax.jit(lambda x: fwd(params, x))
+        for b in sorted(batch_sizes):
+            shaped = [np.repeat(a[:1], b, axis=0) for a in xs]
+            exp = jex.export(frozen, platforms=tuple(platforms))(*shaped)
+            with open(os.path.join(path, f"batch-{b}.stablehlo"), "wb") as f:
+                f.write(exp.serialize())
+        with open(os.path.join(path, "aot_meta.json"), "w") as f:
+            json.dump({"batch_sizes": sorted(batch_sizes), "multi": multi,
+                       "platforms": list(platforms)}, f)
+        return self
+
+    def load_compiled(self, path: str) -> "InferenceModel":
+        """Load an :meth:`export_compiled` artifact directory; ``predict``
+        then runs the pre-compiled programs (pad to the bucket, trim) with
+        zero JIT compiles at serve time."""
+        import json
+        import os
+
+        import jax.export as jex
+
+        with open(os.path.join(path, "aot_meta.json")) as f:
+            meta = json.load(f)
+        arts = {}
+        for b in meta["batch_sizes"]:
+            with open(os.path.join(path, f"batch-{b}.stablehlo"), "rb") as f:
+                arts[b] = jex.deserialize(f.read())
+        self._aot = arts
+        self._aot_multi = bool(meta["multi"])
+        return self
+
     # -- predict (doPredict) --------------------------------------------------
 
     def predict(self, x, batch_size: Optional[int] = None):
         """Borrow a pool slot, pad to the shape bucket, run, trim.
-        ``batch_size`` splits oversized inputs into chunks (each bucketed)."""
+        ``batch_size`` splits oversized inputs into chunks (each bucketed).
+        With a :meth:`load_compiled` artifact, the pre-compiled program for
+        the bucket runs instead of the JIT path — same pad/chunk/trim
+        contract."""
         if self._host_predict is not None:
             with self._slots:
                 return self._host_predict(x)
-        if self._forward is None:
+        aot = getattr(self, "_aot", None)
+        if self._forward is None and aot is None:
             raise RuntimeError("no model loaded")
-        xs = x if isinstance(x, (list, tuple)) else [x]
-        xs = [np.asarray(a) for a in xs]
+        is_multi = isinstance(x, (list, tuple))
+        xs = [np.asarray(a) for a in (x if is_multi else [x])]
         n = xs[0].shape[0]
-        if batch_size is not None and n > batch_size:
+
+        # effective chunk limit: caller's batch_size, and for AOT also the
+        # largest exported bucket
+        limit = batch_size
+        if aot is not None:
+            biggest = max(aot)
+            limit = biggest if limit is None else min(limit, biggest)
+        if limit is not None and n > limit:
             chunks = [self.predict(
-                [a[i:i + batch_size] for a in xs] if isinstance(
-                    x, (list, tuple)) else xs[0][i:i + batch_size])
-                for i in range(0, n, batch_size)]
+                [a[i:i + limit] for a in xs] if is_multi
+                else xs[0][i:i + limit], batch_size=limit)
+                for i in range(0, n, limit)]
             if isinstance(chunks[0], (list, tuple)):
                 return type(chunks[0])(
                     np.concatenate([c[i] for c in chunks])
@@ -217,14 +289,24 @@ class InferenceModel:
                 return {k: np.concatenate([c[k] for c in chunks])
                         for k in chunks[0]}
             return np.concatenate(chunks)
-        bucket = _bucket(n)
+
+        if aot is not None:
+            # smallest exported bucket that fits; empty batches still run
+            # the bucket-1 program and trim to zero rows
+            bucket = next(b for b in sorted(aot) if max(n, 1) <= b)
+        else:
+            bucket = _bucket(n)
         if bucket != n:
+            pad_row = (lambda a: a[-1:] if n else
+                       np.zeros((1,) + a.shape[1:], a.dtype))
             xs = [np.concatenate(
-                [a, np.repeat(a[-1:], bucket - n, axis=0)]) for a in xs]
-        arg = xs if isinstance(x, (list, tuple)) else xs[0]
-        arg = jax.device_put(arg)  # explicit transfer (see _device)
+                [a, np.repeat(pad_row(a), bucket - n, axis=0)]) for a in xs]
+        args = jax.device_put(xs)  # explicit transfer (see _device)
         with self._slots:
-            y = self._jit(self._params, arg)
+            if aot is not None:
+                y = aot[bucket].call(*args)
+            else:
+                y = self._jit(self._params, args if is_multi else args[0])
         trim = lambda t: np.asarray(t)[:n]
         if isinstance(y, dict):
             return {k: trim(v) for k, v in y.items()}

@@ -322,3 +322,75 @@ layer { name: "p1" type: "Pooling" bottom: "data" top: "p1"
         out = np.asarray(im.predict(x))
         assert out.shape == (1, 2, 2, 1)
         assert out[0, 0, 0, 0] == x[0, :2, :2, 0].mean()
+
+
+class TestAOTExport:
+    """Serialized ahead-of-time compiled artifacts (the OpenVINO IR role):
+    export on one process, serve from the artifact with zero JIT compiles."""
+
+    def _make_pool(self, ctx):
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.inference import InferenceModel
+        rs = np.random.RandomState(0)
+        w = rs.randn(6, 3).astype(np.float32)
+
+        def fwd(params, x):
+            return jnp.tanh(x @ params["w"])
+
+        return InferenceModel(concurrent_num=2).load_jax(
+            fwd, {"w": jnp.asarray(w)}), w
+
+    def test_export_load_roundtrip(self, ctx, tmp_path):
+        from analytics_zoo_tpu.inference import InferenceModel
+        pool, w = self._make_pool(ctx)
+        x = np.random.RandomState(1).rand(20, 6).astype(np.float32)
+        ref = np.asarray(pool.predict(x))
+        path = str(tmp_path / "aot")
+        pool.export_compiled(path, x[:1], batch_sizes=(4, 16, 32))
+        served = InferenceModel(concurrent_num=2).load_compiled(path)
+        out = np.asarray(served.predict(x))  # pads 20 -> bucket 32
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+        # larger than the biggest bucket: chunked through bucket 32
+        x_big = np.random.RandomState(2).rand(70, 6).astype(np.float32)
+        out_big = np.asarray(served.predict(x_big))
+        np.testing.assert_allclose(out_big, np.tanh(x_big @ w), atol=1e-5)
+
+    def test_artifact_is_self_contained(self, ctx, tmp_path):
+        import os
+        pool, _ = self._make_pool(ctx)
+        path = str(tmp_path / "aot")
+        pool.export_compiled(path, np.zeros((1, 6), np.float32),
+                             batch_sizes=(8,))
+        files = sorted(os.listdir(path))
+        assert files == ["aot_meta.json", "batch-8.stablehlo"]
+        # params are frozen inside the artifact: nothing else needed
+        assert os.path.getsize(os.path.join(path, "batch-8.stablehlo")) > 0
+
+    def test_multi_input_and_empty_batch(self, ctx, tmp_path):
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.inference import InferenceModel
+        w = np.random.RandomState(3).randn(4, 2).astype(np.float32)
+
+        def fwd(params, xs):  # list-of-inputs calling convention
+            a, b = xs
+            return (a + b) @ params["w"]
+
+        pool = InferenceModel().load_jax(fwd, {"w": jnp.asarray(w)})
+        ex = [np.zeros((1, 4), np.float32), np.zeros((1, 4), np.float32)]
+        path = str(tmp_path / "aot_multi")
+        pool.export_compiled(path, ex, batch_sizes=(4,))
+        served = InferenceModel().load_compiled(path)
+        a = np.random.RandomState(4).rand(3, 4).astype(np.float32)
+        b = np.random.RandomState(5).rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(served.predict([a, b])),
+                                   (a + b) @ w, atol=1e-5)
+        # empty batch trims to zero rows through the bucket-1..4 program
+        empty = np.zeros((0, 4), np.float32)
+        out = np.asarray(served.predict([empty, empty]))
+        assert out.shape == (0, 2)
+        # batch_size chunking still honored on the AOT path
+        big_a = np.random.RandomState(6).rand(10, 4).astype(np.float32)
+        big_b = np.random.RandomState(7).rand(10, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(served.predict([big_a, big_b], batch_size=3)),
+            (big_a + big_b) @ w, atol=1e-5)
