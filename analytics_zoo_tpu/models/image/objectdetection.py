@@ -295,6 +295,15 @@ _SSD300 = dict(
     aspect_ratios=[[2], [2, 3], [2, 3], [2, 3], [2], [2]],
 )
 
+# canonical SSD512 scales (reference ssd/SSD.scala 512 variant): one more
+# pyramid level than SSD300, anchors at 64..1 cell grids (24,564 total)
+_SSD512 = dict(
+    fmap_sizes=[64, 32, 16, 8, 4, 2, 1],
+    min_sizes=[36, 77, 154, 230, 307, 384, 461],
+    max_sizes=[77, 154, 230, 307, 384, 461, 538],
+    aspect_ratios=[[2], [2, 3], [2, 3], [2, 3], [2, 3], [2], [2]],
+)
+
 
 def _anchors_per_cell(ratios: Sequence[float], has_max: bool) -> int:
     return 1 + (1 if has_max else 0) + 2 * len([r for r in ratios if r != 1.0])
@@ -311,10 +320,16 @@ def _vgg_block(x, n, filters, name, pool=True, pool_stride=2):
 
 
 def ssd_vgg16(num_classes: int, resolution: int = 300) -> Tuple[Model, np.ndarray]:
-    """SSD300-VGG16: returns (model, anchors). Model outputs
-    [loc [B, A, 4], logits [B, A, C]] (reference ``SSD.vgg16`` +
-    ``SSDGraph``)."""
-    cfg = _SSD300
+    """SSD-VGG16 at 300 or 512 resolution: returns (model, anchors). Model
+    outputs [loc [B, A, 4], logits [B, A, C]] (reference ``SSD.vgg16`` +
+    ``SSDGraph``; 300 and 512 variants as in ssd/SSD.scala)."""
+    if resolution == 300:
+        cfg = _SSD300
+    elif resolution == 512:
+        cfg = _SSD512
+    else:
+        raise ValueError(f"SSD-VGG16 supports resolution 300 or 512, "
+                         f"got {resolution}")
     inp = Input((resolution, resolution, 3), name="image")
     # VGG16 trunk
     x = _vgg_block(inp, 2, 64, "block1")
@@ -344,13 +359,21 @@ def ssd_vgg16(num_classes: int, resolution: int = 300) -> Tuple[Model, np.ndarra
                               name=f"{name}_2")(x)
         return x
 
-    conv6_2 = extra(fc7, 256, 512, 2, True, "conv6")      # 10x10
-    conv7_2 = extra(conv6_2, 128, 256, 2, True, "conv7")  # 5x5
-    conv8_2 = extra(conv7_2, 128, 256, 1, False, "conv8")  # 3x3
-    conv9_2 = extra(conv8_2, 128, 256, 1, False, "conv9")  # 1x1
-
-    fmaps = [_L2Normalize(name="conv4_3_norm")(conv4_3), fc7, conv6_2,
-             conv7_2, conv8_2, conv9_2]
+    if resolution == 300:
+        conv6_2 = extra(fc7, 256, 512, 2, True, "conv6")      # 10x10
+        conv7_2 = extra(conv6_2, 128, 256, 2, True, "conv7")  # 5x5
+        conv8_2 = extra(conv7_2, 128, 256, 1, False, "conv8")  # 3x3
+        conv9_2 = extra(conv8_2, 128, 256, 1, False, "conv9")  # 1x1
+        fmaps = [_L2Normalize(name="conv4_3_norm")(conv4_3), fc7, conv6_2,
+                 conv7_2, conv8_2, conv9_2]
+    else:  # 512: five stride-2 extras, one more pyramid level than 300
+        conv6_2 = extra(fc7, 256, 512, 2, True, "conv6")       # 16x16
+        conv7_2 = extra(conv6_2, 128, 256, 2, True, "conv7")   # 8x8
+        conv8_2 = extra(conv7_2, 128, 256, 2, True, "conv8")   # 4x4
+        conv9_2 = extra(conv8_2, 128, 256, 2, True, "conv9")   # 2x2
+        conv10_2 = extra(conv9_2, 128, 256, 2, True, "conv10")  # 1x1
+        fmaps = [_L2Normalize(name="conv4_3_norm")(conv4_3), fc7, conv6_2,
+                 conv7_2, conv8_2, conv9_2, conv10_2]
     locs, confs = [], []
     for i, (fmap, fsize, ratios, mx) in enumerate(zip(
             fmaps, cfg["fmap_sizes"], cfg["aspect_ratios"], cfg["max_sizes"])):
@@ -365,7 +388,7 @@ def ssd_vgg16(num_classes: int, resolution: int = 300) -> Tuple[Model, np.ndarra
                              name=f"head{i}_conf_flat")(conf))
     all_loc = merge(locs, mode="concat", concat_axis=1, name="loc_concat")
     all_conf = merge(confs, mode="concat", concat_axis=1, name="conf_concat")
-    model = Model(inp, [all_loc, all_conf], name="ssd300_vgg16")
+    model = Model(inp, [all_loc, all_conf], name=f"ssd{resolution}_vgg16")
     anchors = generate_anchors(cfg["fmap_sizes"], resolution,
                                cfg["min_sizes"], cfg["max_sizes"],
                                cfg["aspect_ratios"])
@@ -439,12 +462,18 @@ class SSD:
     """SSD builder facade (reference ``SSD.apply``, ssd/SSD.scala:79)."""
 
     BACKBONES = {"vgg16": ssd_vgg16, "mobilenet": ssd_mobilenet}
+    RESOLUTIONS = {"vgg16": (300, 512), "mobilenet": (300,)}
 
     def __new__(cls, class_num: int, resolution: int = 300,
                 backbone: str = "vgg16"):
         if backbone not in cls.BACKBONES:
             raise ValueError(f"unknown backbone {backbone}; "
                              f"have {sorted(cls.BACKBONES)}")
+        if resolution not in cls.RESOLUTIONS[backbone]:
+            raise ValueError(
+                f"SSD-{backbone} supports resolution "
+                f"{' or '.join(map(str, cls.RESOLUTIONS[backbone]))}, "
+                f"got {resolution}")
         return cls.BACKBONES[backbone](class_num, resolution)
 
 

@@ -122,6 +122,12 @@ def _run_steps_scanned(est, bx, by, steps, warmup):
     return elapsed, flops
 
 
+def _timed(fn):
+    start = time.perf_counter()
+    fn()
+    return time.perf_counter() - start
+
+
 def _mfu(flops_per_step, steps, elapsed):
     peak = _peak_flops()
     if flops_per_step is None or peak is None:
@@ -401,11 +407,79 @@ def bench_serving(requests: int = 512, batch_size: int = 64):
                         "attached chip the same loop is compute-bound"})
 
 
+def bench_longseq(batch_size: int = 4, heads: int = 8, seq: int = 4096,
+                  head_dim: int = 64, steps: int = 20, warmup: int = 3):
+    """Long-context attention train step (the new long-context capability;
+    no reference counterpart — SURVEY §5 notes the reference has none).
+    Runs fwd+bwd through the pallas flash kernel (recompute-based backward)
+    at a sequence length where a materialized [S, S] probability matrix
+    would dominate HBM, and reports tokens/s + MFU."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.ops.attention import flash_attention
+
+    init_tpu_context()
+    rs = np.random.RandomState(0)
+    shape = (batch_size, heads, seq, head_dim)
+    q, k, v = (jnp.asarray(rs.randn(*shape).astype(np.float32),
+                           jnp.bfloat16) for _ in range(3))
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True)
+                       .astype(jnp.float32))
+
+    grad_fn = jax.grad(loss, argnums=(0, 1, 2))
+
+    def chained(q, k, v, eps):
+        # every step's inputs depend on the previous step's grads so the
+        # scan measures SERIAL step latency, and the result is reduced to a
+        # scalar whose host readback is the only reliable completion fence
+        # on remote-attached chips (block_until_ready returns at enqueue
+        # there). eps is a RUNTIME zero: XLA cannot fold eps*grad away.
+        def body(carry, _):
+            cq, ck, cv = carry
+            dq, dk, dv = grad_fn(cq, ck, cv)
+            return (cq + eps * dq, ck + eps * dk, cv + eps * dv), ()
+
+        (q, k, v), _ = jax.lax.scan(body, (q, k, v), None, length=steps)
+        return jnp.sum(q.astype(jnp.float32))
+
+    eps = jnp.bfloat16(0.0)
+    compiled = jax.jit(chained).lower(q, k, v, eps).compile()
+    # analytic FLOPs: XLA's cost analysis can't see inside the pallas custom
+    # calls. One causal [S, S, D] matmul = B*H*S^2*D FLOPs (2x for MAC, /2
+    # for the causal half). The kernels run 9 such matmuls per step: fwd
+    # (s, p@v), dq pass (s, dp, dq), dkv pass (s, dv, dp, dk).
+    flops = 9 * batch_size * heads * seq * seq * head_dim
+    for _ in range(max(1, warmup // 2)):
+        float(compiled(q, k, v, eps))
+    # subtract the tunnel's scalar-readback floor (measured, not assumed)
+    tiny = jax.jit(lambda e: jnp.float32(1) + e).lower(eps).compile()
+    float(tiny(eps))
+    rpc = min(_timed(lambda: float(tiny(eps))) for _ in range(3))
+    total = min(_timed(lambda: float(compiled(q, k, v, eps)))
+                for _ in range(2))
+    elapsed = max(total - rpc, 1e-9)
+    tokens = batch_size * seq
+    return _BenchResult(
+        metric="longseq_attention_tokens_per_sec",
+        value=round(tokens * steps / elapsed, 1),
+        unit="tokens/s",
+        mfu=_mfu(flops, steps, elapsed),
+        detail={"batch_size": batch_size, "heads": heads, "seq_len": seq,
+                "head_dim": head_dim, "causal": True,
+                "kernel": "pallas flash fwd + pallas flash bwd (dq; dkv)",
+                "flops_per_step": flops})
+
+
 _WORKLOADS = {
     "resnet50": bench_resnet50,
     "ncf": bench_ncf,
     "widedeep": bench_widedeep,
     "bert": bench_bert,
+    "longseq": bench_longseq,
     "pipeline": bench_input_pipeline,
     "serving": bench_serving,
 }

@@ -232,3 +232,30 @@ class TestDetectionAugmentation:
         # encode the augmented ground truth against SSD anchors
         loc_t, cls_t = det.encode_batch(all_boxes, all_labels)
         assert loc_t.shape[0] == 4 and cls_t.shape[0] == 4
+
+
+class TestSSD512:
+    def test_build_and_anchor_consistency(self, ctx):
+        model, anchors = SSD(21, 512, "vgg16")
+        assert anchors.shape == (24564, 4)  # canonical SSD512 anchor count
+        assert model.name == "ssd512_vgg16"
+        loc_shape, conf_shape = [o.shape for o in model.outputs]
+        assert loc_shape[1] == conf_shape[1] == 24564
+        assert loc_shape[2] == 4 and conf_shape[2] == 21
+        assert np.all(anchors >= 0) and np.all(anchors <= 1)
+
+    def test_unsupported_resolution_raises(self, ctx):
+        with pytest.raises(ValueError, match="300 or 512"):
+            SSD(21, 400, "vgg16")
+
+    def test_encode_against_512_anchors(self, ctx):
+        from analytics_zoo_tpu.models.image.objectdetection import (
+            generate_anchors, _SSD512)
+        a = generate_anchors(image_size=512, **_SSD512)
+        gt = np.array([[0.1, 0.1, 0.4, 0.5]], np.float32)
+        loc_t, cls_t = encode_targets(gt, np.array([3]), a)
+        assert loc_t.shape == (24564, 4) and (cls_t > 0).sum() >= 1
+        pos = cls_t > 0
+        decoded = np.asarray(decode_boxes(jnp.asarray(loc_t), jnp.asarray(a)))
+        np.testing.assert_allclose(decoded[pos],
+                                   np.tile(gt, (pos.sum(), 1)), atol=1e-5)
