@@ -232,6 +232,19 @@ def _keybias_block(kv_len: int, kv_block: int) -> Optional[int]:
     return None
 
 
+def _vma_struct(shape, dtype, like):
+    """ShapeDtypeStruct carrying the input's varying-manual-axes so
+    pallas_call outputs satisfy shard_map's vma check (ulysses/ring run the
+    kernel inside shard_map)."""
+    try:
+        vma = jax.typeof(like).vma
+    except Exception:
+        vma = None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _flash_fwd_pallas(q, k, v, scale: float, causal: bool,
                       q_block: int, kv_block: int,
                       key_bias: Optional[jax.Array] = None,
@@ -276,14 +289,14 @@ def _flash_fwd_pallas(q, k, v, scale: float, causal: bool,
             pl.BlockSpec((1, 1, bk), lambda a, i, j, h=h: (a // h, 0, j),
                          memory_space=pltpu.VMEM))
         operands.append(key_bias)
-    out_shape = jax.ShapeDtypeStruct((bh, q_len, d), q.dtype)
+    out_shape = _vma_struct((bh, q_len, d), q.dtype, q)
     out_specs = pl.BlockSpec((1, bq, d), lambda a, i, j: (a, i, 0),
                              memory_space=pltpu.VMEM)
     if return_lse:
         # ride as [bh, 1, q_len]: the (1, bq) trailing block dims satisfy
         # the TPU (8, 128) tiling rules via a unit sublane
         out_shape = (out_shape,
-                     jax.ShapeDtypeStruct((bh, 1, q_len), jnp.float32))
+                     _vma_struct((bh, 1, q_len), jnp.float32, q))
         out_specs = (out_specs,
                      pl.BlockSpec((1, 1, bq), lambda a, i, j: (a, 0, i),
                                   memory_space=pltpu.VMEM))
@@ -432,7 +445,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk),
-        out_shape=jax.ShapeDtypeStruct((bh, q_len, d), q.dtype),
+        out_shape=_vma_struct((bh, q_len, d), q.dtype, q),
         grid=(bh, q_len // bq, kv_len // bk),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
@@ -449,8 +462,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk),
-        out_shape=(jax.ShapeDtypeStruct((bh, kv_len, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, kv_len, d), v.dtype)),
+        out_shape=(_vma_struct((bh, kv_len, d), k.dtype, k),
+                   _vma_struct((bh, kv_len, d), v.dtype, v)),
         grid=(bh, kv_len // bk, q_len // bq),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
         out_specs=(kv_spec2, kv_spec2),

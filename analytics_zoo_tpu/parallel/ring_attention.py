@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.attention import blockwise_attention, _largest_divisor_leq, _NEG_INF
+from ..ops.attention import flash_attention, _NEG_INF
 
 SEQ_AXIS = "seq"
 
@@ -59,8 +59,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        # p in storage dtype: bf16 MXU multiplies with f32 accumulation
         acc_new = acc * corr + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32),
+            "bhqk,bhkd->bhqd", p.astype(vc.dtype), vc,
             preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
 
@@ -128,5 +129,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                               tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = blockwise_attention(qh, kh, vh, causal=causal, scale=scale)
+    # each device now holds the FULL sequence for its heads, so the pallas
+    # flash kernel applies directly (blockwise fallback off-TPU)
+    out = flash_attention(qh, kh, vh, causal=causal, scale=scale)
     return heads_to_seq(out)
