@@ -76,7 +76,8 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         q_block: int = DEFAULT_Q_BLOCK,
                         kv_block: int = DEFAULT_KV_BLOCK,
                         dropout_rate: float = 0.0,
-                        dropout_rng: Optional[jax.Array] = None) -> jax.Array:
+                        dropout_rng: Optional[jax.Array] = None,
+                        return_lse: bool = False):
     """Streaming-softmax attention over KV chunks; O(seq) memory.
 
     ``bias`` broadcasts against ``[batch, heads, q_len, kv_len]``.
@@ -84,7 +85,8 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     from ``fold_in(rng, block_index)``, so the full [q, kv] probability
     matrix never materializes); the streaming denominator accumulates the
     UNDROPPED weights, making the result exactly standard post-softmax
-    dropout.
+    dropout. ``return_lse`` also returns the per-row logsumexp
+    ``[b, h, q_len]`` (partial-attention merging, ring hops).
     """
     b, h, q_len, d = q.shape
     kv_len = k.shape[-2]
@@ -142,10 +144,18 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         init = (zero_q, zero_q[..., :1] + _NEG_INF, zero_q[..., :1])
         (acc, m, l), _ = lax.scan(
             kv_step, init, (k_chunks, v_chunks, jnp.arange(n_kv)))
-        return (acc / jnp.maximum(l, 1e-30)).astype(v.dtype)
+        o = (acc / jnp.maximum(l, 1e-30)).astype(v.dtype)
+        if return_lse:
+            return o, (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]
+        return o
 
-    out = lax.map(one_q_chunk, (q.transpose(2, 0, 1, 3, 4), jnp.arange(n_q)))
-    return out.transpose(1, 2, 0, 3, 4).reshape(b, h, q_len, d)
+    mapped = lax.map(one_q_chunk,
+                     (q.transpose(2, 0, 1, 3, 4), jnp.arange(n_q)))
+    if return_lse:
+        out, lse = mapped
+        return (out.transpose(1, 2, 0, 3, 4).reshape(b, h, q_len, d),
+                lse.transpose(1, 2, 0, 3).reshape(b, h, q_len))
+    return mapped.transpose(1, 2, 0, 3, 4).reshape(b, h, q_len, d)
 
 
 # ---------------------------------------------------------------------------
@@ -319,10 +329,12 @@ def _flash_fwd_pallas(q, k, v, scale: float, causal: bool,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
-                         dq_ref, dq_acc, *, scale: float, causal: bool,
-                         bq: int, bk: int):
-    """dq = Σ_k ds @ K with ds = p * (dO V^T − D), p = exp(qk·scale − lse).
-    Grid (bh, n_q, n_kv); accumulates over the innermost kv axis."""
+                         gl_ref, dq_ref, dq_acc, *, scale: float,
+                         causal: bool, bq: int, bk: int):
+    """dq = Σ_k ds @ K with ds = p * (dO V^T − D + glse), where glse is the
+    cotangent of the lse output (zero when only the attention output is
+    used). p = exp(qk·scale − lse). Grid (bh, n_q, n_kv); accumulates over
+    the innermost kv axis."""
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -352,7 +364,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bq, bk]
-        ds = p * (dp - dd_ref[0, 0][:, None]) * scale
+        ds = p * (dp - dd_ref[0, 0][:, None]
+                  + gl_ref[0, 0][:, None]) * scale
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -363,8 +376,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
-                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
-                          causal: bool, bq: int, bk: int):
+                          gl_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                          scale: float, causal: bool, bq: int, bk: int):
     """dv = Σ_q p^T dO; dk = Σ_q ds^T q. Grid (bh, n_kv, n_q); accumulates
     over the innermost query axis."""
     from jax.experimental import pallas as pl
@@ -402,7 +415,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         dp = jax.lax.dot_general(
             do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bq, bk]
-        ds = (p * (dp - dd_ref[0, 0][:, None]) * scale).astype(q.dtype)
+        ds = (p * (dp - dd_ref[0, 0][:, None]
+                   + gl_ref[0, 0][:, None]) * scale).astype(q.dtype)
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bk, d]
@@ -414,7 +428,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
-                      q_block: int, kv_block: int):
+                      q_block: int, kv_block: int, glse=None):
     """Full flash backward on TPU: recomputes p from the saved logsumexp in
     two gridded passes (dq; dk+dv), all matmuls in the storage dtype with
     f32 accumulation."""
@@ -435,6 +449,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
                  * o.reshape(bh, q_len, d).astype(jnp.float32),
                  axis=-1).reshape(bh, 1, q_len)
     lse = lse.reshape(bh, 1, q_len)
+    gl = (jnp.zeros((bh, 1, q_len), jnp.float32) if glse is None
+          else glse.astype(jnp.float32).reshape(bh, 1, q_len))
 
     q_spec = pl.BlockSpec((1, bq, d), lambda a, i, j: (a, i, 0),
                           memory_space=pltpu.VMEM)
@@ -447,10 +463,11 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
                           bq=bq, bk=bk),
         out_shape=_vma_struct((bh, q_len, d), q.dtype, q),
         grid=(bh, q_len // bq, kv_len // bk),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
+                  row_spec],
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-    )(qf, kf, vf, dof, lse, dd)
+    )(qf, kf, vf, dof, lse, dd, gl)
 
     # second pass swaps the roles of the two block axes
     q_spec2 = pl.BlockSpec((1, bq, d), lambda a, i, j: (a, j, 0),
@@ -465,11 +482,12 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
         out_shape=(_vma_struct((bh, kv_len, d), k.dtype, k),
                    _vma_struct((bh, kv_len, d), v.dtype, v)),
         grid=(bh, kv_len // bk, q_len // bq),
-        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
+                  row_spec2, row_spec2],
         out_specs=(kv_spec2, kv_spec2),
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-    )(qf, kf, vf, dof, lse, dd)
+    )(qf, kf, vf, dof, lse, dd, gl)
     return (dq.reshape(b, h, q_len, d), dk.reshape(b, h, kv_len, d),
             dv.reshape(b, h, kv_len, d))
 
@@ -521,6 +539,61 @@ def _flash_bwd(scale, causal, q_block, kv_block, residuals, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, scale, causal, q_block, kv_block):
+    return _flash_lse_fwd(q, k, v, scale, causal, q_block, kv_block)[0]
+
+
+def _flash_lse_fwd(q, k, v, scale, causal, q_block, kv_block):
+    b, h, q_len, _ = q.shape
+    if _on_tpu() and _lse_tile_ok(q_len, q_block):
+        out, lse = _flash_fwd_pallas(q, k, v, scale, causal, q_block,
+                                     kv_block, return_lse=True)
+        return ((out, lse.reshape(b, h, q_len)),
+                (q, k, v, out, lse, True))
+    out, lse = blockwise_attention(q, k, v, None, causal, scale, q_block,
+                                   kv_block, return_lse=True)
+    # the fallback backward recomputes via vjp: only q/k/v are needed, so
+    # don't pin the forward activations in the residuals
+    return (out, lse), (q, k, v, None, None, False)
+
+
+def _flash_lse_bwd(scale, causal, q_block, kv_block, residuals, gs):
+    q, k, v, o, lse, used_pallas = residuals
+    go, glse = gs
+    if used_pallas:
+        return _flash_bwd_pallas(q, k, v, o, lse, go, scale, causal,
+                                 q_block, kv_block, glse=glse)
+    # off-TPU: autodiff through the blockwise lse path
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, None, causal, scale, q_block, kv_block,
+            return_lse=True), q, k, v)
+    return vjp((go, glse))
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = False,
+                        scale: Optional[float] = None,
+                        q_block: int = DEFAULT_Q_BLOCK,
+                        kv_block: int = DEFAULT_KV_BLOCK):
+    """Fused attention that ALSO returns the per-row logsumexp
+    ``[batch, heads, q_len]`` — the sufficient statistic for merging partial
+    attentions over disjoint KV shards (ring hops):
+
+        lse_c = logaddexp(lse_a, lse_b)
+        out_c = out_a * exp(lse_a - lse_c) + out_b * exp(lse_b - lse_c)
+
+    Jointly differentiable in both outputs: on TPU the lse cotangent folds
+    into the backward kernels' ``ds`` term, off-TPU autodiff flows through
+    the blockwise scan."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    return _flash_lse(q, k, v, scale, causal, q_block, kv_block)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.attention import flash_attention, _NEG_INF
+from ..ops.attention import flash_attention, flash_attention_lse, _NEG_INF
 
 SEQ_AXIS = "seq"
 
@@ -46,47 +46,60 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     b, h, sq, d = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
 
-    def fold(acc, m, l, kc, vc, i):
-        """Fold one visiting K/V shard's partial softmax stats into (acc, m, l)."""
+    def attend(kc, vc, i):
+        """Attention of the local Q against one visiting K/V shard,
+        returned as (normalized partial out, per-row lse) — each hop runs
+        the flash kernel (pallas on TPU), and partials merge by lse."""
+        if not causal:
+            return flash_attention_lse(q, kc, vc, causal=False, scale=scale,
+                                       q_block=q_block, kv_block=kv_block)
         src_rank = (my + i) % n  # which shard's K/V we currently hold
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
-                       preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = my * sq + lax.broadcasted_iota(jnp.int32, (sq, sq), 0)
-            cols = src_rank * sq + lax.broadcasted_iota(jnp.int32, (sq, sq), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        # p in storage dtype: bf16 MXU multiplies with f32 accumulation
-        acc_new = acc * corr + jnp.einsum(
-            "bhqk,bhkd->bhqd", p.astype(vc.dtype), vc,
-            preferred_element_type=jnp.float32)
-        return acc_new, m_new, l_new
+
+        def full(_):  # visiting shard is entirely in the past
+            return flash_attention_lse(q, kc, vc, causal=False, scale=scale,
+                                       q_block=q_block, kv_block=kv_block)
+
+        def diag(_):  # own shard: standard causal mask
+            return flash_attention_lse(q, kc, vc, causal=True, scale=scale,
+                                       q_block=q_block, kv_block=kv_block)
+
+        def skip(_):  # entirely in the future: contributes nothing
+            # neutral element derives from q so it stays device-varying
+            # under shard_map's vma check
+            return ((q * 0).astype(v.dtype),
+                    q[..., 0].astype(jnp.float32) * 0 + _NEG_INF)
+
+        idx = jnp.where(src_rank < my, 0, jnp.where(src_rank == my, 1, 2))
+        return lax.switch(idx, [full, diag, skip], None)
+
+    def merge(out, lse, out_h, lse_h):
+        lse_new = jnp.logaddexp(lse, lse_h)
+        w_old = jnp.exp(lse - lse_new)[..., None]
+        w_hop = jnp.exp(lse_h - lse_new)[..., None]
+        return (out * w_old + out_h.astype(jnp.float32) * w_hop), lse_new
 
     def hop(carry, i):
-        acc, m, l, kc, vc = carry
-        acc, m, l = fold(acc, m, l, kc, vc, i)
+        out, lse, kc, vc = carry
+        out_h, lse_h = attend(kc, vc, i)
+        out, lse = merge(out, lse, out_h, lse_h)
         # rotate k/v to the next device on the ring (overlaps with the next
         # hop's compute under XLA's async collective scheduling)
         perm = [(j, (j - 1) % n) for j in range(n)]
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
-        return (acc, m, l, kc, vc), None
+        return (out, lse, kc, vc), None
 
     # accumulators derive from q*0 so they inherit q's varying-axis type —
     # shard_map's vma check requires the scan carry to be device-varying
-    zero_q = q.astype(jnp.float32) * 0.0
-    init = (zero_q,
-            zero_q[..., :1] + _NEG_INF,
-            zero_q[..., :1],
+    init = (q.astype(jnp.float32) * 0.0,
+            q[..., 0].astype(jnp.float32) * 0 + _NEG_INF,
             k, v)
     # n-1 rotating hops, then the last visiting shard is folded without the
     # (wasted) final rotation
-    (acc, m, l, kc, vc), _ = lax.scan(hop, init, jnp.arange(n - 1))
-    acc, m, l = fold(acc, m, l, kc, vc, n - 1)
-    return (acc / jnp.maximum(l, 1e-30)).astype(v.dtype)
+    (out, lse, kc, vc), _ = lax.scan(hop, init, jnp.arange(n - 1))
+    out_h, lse_h = attend(kc, vc, n - 1)
+    out, _ = merge(out, lse, out_h, lse_h)
+    return out.astype(v.dtype)
 
 
 def ring_self_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array,
