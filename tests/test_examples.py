@@ -27,6 +27,7 @@ EXAMPLES = [
     "examples/gan/gan_example.py",
     "examples/inference/quantized_inference_example.py",
     "examples/xshard/xshard_example.py",
+    "examples/longcontext/long_context_example.py",
 ]
 
 
