@@ -134,6 +134,68 @@ class AUC(Metric):
         return float(jnp.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) / 2.0))
 
 
+class _PRF(Metric):
+    """Shared tp/fp/fn accumulator for precision/recall/F1.
+
+    Binary contract mirrors :class:`Accuracy`: categorical predictions
+    compare ``argmax == positive_class``; single-column probabilities
+    threshold at 0.5. Token-level tasks ([B, S] labels) count every
+    position of the valid rows."""
+
+    def __init__(self, positive_class: int = 1):
+        self.positive_class = positive_class
+
+    def init_state(self):
+        # three DISTINCT buffers: the eval step donates metric states, and
+        # aliasing one zeros array would donate the same buffer thrice
+        return {"tp": jnp.zeros(()), "fp": jnp.zeros(()),
+                "fn": jnp.zeros(())}
+
+    def update(self, state, y_true, y_pred, mask):
+        if y_pred.ndim > 1 and y_pred.shape[-1] > 1:
+            pred = jnp.argmax(y_pred, axis=-1) == self.positive_class
+            if y_true.ndim == y_pred.ndim:
+                true = jnp.argmax(y_true, axis=-1) == self.positive_class
+            else:
+                true = y_true.astype(jnp.int32) == self.positive_class
+        else:
+            pred = y_pred.reshape(y_pred.shape[0], -1) > 0.5
+            true = y_true.reshape(y_true.shape[0], -1) > 0.5
+            if self.positive_class == 0:  # stats for the negative label
+                pred, true = ~pred, ~true
+        pred = pred.reshape(mask.shape[0], -1)
+        true = true.reshape(mask.shape[0], -1)
+        m = mask[:, None].astype(jnp.float32)
+        return {
+            "tp": state["tp"] + jnp.sum((pred & true) * m),
+            "fp": state["fp"] + jnp.sum((pred & ~true) * m),
+            "fn": state["fn"] + jnp.sum((~pred & true) * m),
+        }
+
+
+class Precision(_PRF):
+    name = "precision"
+
+    def compute(self, state):
+        return float(state["tp"] / jnp.maximum(state["tp"] + state["fp"], 1))
+
+
+class Recall(_PRF):
+    name = "recall"
+
+    def compute(self, state):
+        return float(state["tp"] / jnp.maximum(state["tp"] + state["fn"], 1))
+
+
+class F1(_PRF):
+    name = "f1"
+
+    def compute(self, state):
+        p = state["tp"] / jnp.maximum(state["tp"] + state["fp"], 1)
+        r = state["tp"] / jnp.maximum(state["tp"] + state["fn"], 1)
+        return float(2 * p * r / jnp.maximum(p + r, 1e-12))
+
+
 _REGISTRY: Dict[str, Callable[[], Metric]] = {
     "accuracy": Accuracy,
     "acc": Accuracy,
@@ -142,6 +204,9 @@ _REGISTRY: Dict[str, Callable[[], Metric]] = {
     "mae": MAE,
     "mse": MSE,
     "auc": AUC,
+    "precision": Precision,
+    "recall": Recall,
+    "f1": F1,
 }
 
 

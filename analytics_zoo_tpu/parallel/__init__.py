@@ -6,3 +6,5 @@ from .ring_attention import (  # noqa: F401
 from .moe import MoE, moe_sharding_rule  # noqa: F401
 from .pipeline import (  # noqa: F401
     PIPE_AXIS, gpipe, pipeline_apply, stack_stage_params)
+from .tensor import (  # noqa: F401
+    column_parallel, megatron_mlp_rules, row_parallel, vocab_parallel)

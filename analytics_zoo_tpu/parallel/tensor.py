@@ -1,0 +1,108 @@
+"""Tensor parallelism — Megatron-style layer sharding over ``mesh['model']``.
+
+The reference's only parallelism is data-parallel allreduce (SURVEY §2.4:
+TP "absent"); this is a new TPU-native capability. Design: GSPMD-style
+declared shardings rather than hand-written collectives — the rules below
+plug into ``Estimator(param_sharding_rules=...)`` / ``param_sharding`` and
+annotate weight layouts, then XLA partitions every matmul and inserts the
+single reduce over the model axis where the row-parallel projection brings
+activations back (the Megatron f/g pattern, compiler-derived).
+
+The canonical transformer block layout:
+
+- **column-parallel** up-projection (``Dense`` into the hidden/FFN dim):
+  kernel ``[in, out]`` sharded ``P(None, "model")`` — each device holds a
+  slice of the output features, activations stay sharded, no comm.
+- **row-parallel** down-projection (back to the residual width): kernel
+  sharded ``P("model", None)`` — each device contracts its activation
+  slice, XLA inserts one psum over ``model``.
+
+Usage::
+
+    rules = megatron_mlp_rules(up=("fc1", "up_proj"), down=("fc2",))
+    est = Estimator(model, loss, opt, mesh=mesh,
+                    param_sharding_rules=rules)
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, Sequence
+
+from jax.sharding import PartitionSpec as P
+
+from .mesh import MODEL_AXIS
+
+Rule = Callable  # (path, leaf) -> Optional[PartitionSpec]
+
+
+def _segments(path):
+    return [str(getattr(p, "key", p)) for p in path]
+
+
+def _matches(path, names) -> bool:
+    # EXACT segment equality — substring matching over the joined path
+    # would capture unrelated params (e.g. "proj" capturing "out_proj";
+    # same convention as moe_sharding_rule)
+    segs = _segments(path)
+    return any(seg == n for seg in segs for n in names)
+
+
+def column_parallel(layer_names: Iterable[str],
+                    axis: str = MODEL_AXIS) -> Rule:
+    """Shard the OUTPUT features of the named Dense/conv-style layers:
+    kernel ``[..., in, out] -> P(..., axis)``, bias ``[out] -> P(axis)``."""
+    names = tuple(layer_names)
+
+    def rule(path, leaf):
+        if not _matches(path, names):
+            return None
+        if leaf.ndim >= 2:
+            return P(*([None] * (leaf.ndim - 1) + [axis]))
+        if leaf.ndim == 1:
+            return P(axis)
+        return None
+
+    return rule
+
+
+def row_parallel(layer_names: Iterable[str],
+                 axis: str = MODEL_AXIS) -> Rule:
+    """Shard the INPUT features of the named layers: kernel
+    ``[in, out] -> P(axis, None)``; bias replicated (it adds AFTER the
+    psum XLA inserts for the contraction)."""
+    names = tuple(layer_names)
+
+    def rule(path, leaf):
+        if not _matches(path, names):
+            return None
+        if leaf.ndim >= 2:
+            return P(*([axis] + [None] * (leaf.ndim - 1)))
+        return P()  # bias: replicated
+
+    return rule
+
+
+def vocab_parallel(layer_names: Iterable[str],
+                   axis: str = MODEL_AXIS) -> Rule:
+    """Shard embedding tables over the vocab axis: ``[vocab, dim] ->
+    P(axis, None)`` (the dryrun's NCF-table layout, generalized)."""
+    names = tuple(layer_names)
+
+    def rule(path, leaf):
+        if _matches(path, names) and leaf.ndim == 2:
+            return P(axis, None)
+        return None
+
+    return rule
+
+
+def megatron_mlp_rules(up: Sequence[str], down: Sequence[str],
+                       embeddings: Sequence[str] = (),
+                       axis: str = MODEL_AXIS) -> list:
+    """The standard transformer-block tensor-parallel layout as a rule list
+    for ``param_sharding_rules``: column-parallel ``up`` projections,
+    row-parallel ``down`` projections, optional vocab-parallel embeddings.
+    Unmatched parameters stay replicated (pure DP)."""
+    rules = [column_parallel(up, axis), row_parallel(down, axis)]
+    if embeddings:
+        rules.append(vocab_parallel(embeddings, axis))
+    return rules

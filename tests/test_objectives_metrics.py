@@ -168,3 +168,58 @@ class TestRankingMetrics:
         hr = ncf.evaluate_hit_ratio(x, y, k=3)
         for v in (ndcg, m, hr):
             assert 0.0 <= v <= 1.0
+
+
+class TestPrecisionRecallF1:
+    def _run(self, metric, y_true, y_pred, mask=None):
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.keras import metrics as M
+        m = M.get(metric)
+        state = m.init_state()
+        y_true, y_pred = jnp.asarray(y_true), jnp.asarray(y_pred)
+        if mask is None:
+            mask = jnp.ones(y_true.shape[0])
+        state = m.update(state, y_true, y_pred, jnp.asarray(mask))
+        return m.compute(state)
+
+    def test_categorical_counts(self):
+        # preds (argmax): [1, 1, 0, 1]; true: [1, 0, 1, 1]
+        y_pred = np.array([[0.1, 0.9], [0.2, 0.8], [0.7, 0.3], [0.4, 0.6]])
+        y_true = np.array([1.0, 0.0, 1.0, 1.0])
+        # tp=2, fp=1, fn=1
+        assert self._run("precision", y_true, y_pred) == pytest.approx(2 / 3)
+        assert self._run("recall", y_true, y_pred) == pytest.approx(2 / 3)
+        assert self._run("f1", y_true, y_pred) == pytest.approx(2 / 3)
+
+    def test_binary_threshold_and_mask(self):
+        y_pred = np.array([[0.9], [0.8], [0.2], [0.7]])
+        y_true = np.array([[1.0], [0.0], [1.0], [1.0]])
+        mask = np.array([1.0, 1.0, 1.0, 0.0])  # last row is tail padding
+        # rows 0-2: pred [1,1,0] true [1,0,1] -> tp=1 fp=1 fn=1
+        assert self._run("precision", y_true, y_pred, mask) == \
+            pytest.approx(0.5)
+        assert self._run("recall", y_true, y_pred, mask) == pytest.approx(0.5)
+
+    def test_evaluate_through_estimator(self, ctx):
+        from analytics_zoo_tpu.estimator import Estimator
+        from analytics_zoo_tpu.feature import FeatureSet
+        from analytics_zoo_tpu.keras import Sequential, objectives, optimizers
+        from analytics_zoo_tpu.keras.layers import Dense
+        rs = np.random.RandomState(0)
+        x = rs.rand(96, 4).astype(np.float32)
+        y = (x.sum(1) > 2).astype(np.float32)
+        est = Estimator(
+            model=Sequential([Dense(8, activation="relu"),
+                              Dense(2, activation="softmax")]),
+            loss_fn=objectives.get("sparse_categorical_crossentropy"),
+            optimizer=optimizers.Adam(5e-2),
+            metrics=["precision", "recall", "f1", "accuracy"])
+        fs = FeatureSet.from_ndarrays(x, y)
+        est.train(fs, batch_size=32, epochs=30)
+        res = est.evaluate(FeatureSet.from_ndarrays(x, y, shuffle=False),
+                           batch_size=32)
+        assert set(res) == {"precision", "recall", "f1", "accuracy"}
+        assert res["f1"] > 0.8
+        # F1 is the harmonic mean of the reported precision/recall
+        p, r = res["precision"], res["recall"]
+        assert res["f1"] == pytest.approx(2 * p * r / (p + r), abs=1e-5)
