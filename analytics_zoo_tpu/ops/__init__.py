@@ -9,4 +9,10 @@ from .attention import (  # noqa: F401
     dot_product_attention,
     blockwise_attention,
     flash_attention,
+    flash_attention_lse,
+)
+from .decode import (  # noqa: F401
+    cached_attention,
+    greedy_generate,
+    init_kv_cache,
 )

@@ -1,0 +1,110 @@
+"""Incremental (KV-cached) attention decoding.
+
+New TPU-native capability rounding out the long-context stack: training
+runs the flash kernels (``ops/attention.py``), generation runs this cache.
+The reference's only generation path is the host-side RNN loop in Seq2seq
+(``models/seq2seq``); transformer decoding needs the KV cache to avoid
+re-attending the whole prefix per step.
+
+Design for XLA: the cache is a STATIC ``max_len`` buffer pair updated with
+``lax.dynamic_update_slice`` — shapes never change, so the per-step program
+compiles once; validity is a position mask derived from ``length``. The
+whole generate loop is one ``lax.scan`` (single dispatch per sequence, the
+only pattern that amortizes dispatch latency on remote-attached chips).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .attention import _NEG_INF
+
+KVCache = Dict[str, Any]
+
+
+def init_kv_cache(batch: int, heads: int, max_len: int, head_dim: int,
+                  dtype=jnp.bfloat16) -> KVCache:
+    """Empty cache: K/V buffers ``[B, H, max_len, D]`` + write position."""
+    return {
+        "k": jnp.zeros((batch, heads, max_len, head_dim), dtype),
+        "v": jnp.zeros((batch, heads, max_len, head_dim), dtype),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def cached_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
+                     cache: KVCache, scale: Optional[float] = None
+                     ) -> Tuple[jax.Array, KVCache]:
+    """Append ``k_new``/``v_new`` (``[B, H, T, D]``, T = 1 for decode or the
+    prompt length for prefill) at the cache's write position, then attend
+    ``q`` against everything cached so far, causally within the new block.
+
+    Returns ``(context [B, H, T, D], updated cache)``. jit-safe: static
+    shapes, the step count lives in ``cache["length"]``.
+    """
+    b, h, t, d = q.shape
+    max_len = cache["k"].shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    start = cache["length"]
+    # capacity guard: under eager execution (concrete length) overflowing
+    # the static buffer raises here; under jit the caller owns the budget
+    # (max_len - length tokens remain) — overflow would silently corrupt
+    import jax.core as _core
+    if not isinstance(start, _core.Tracer) and int(start) + t > max_len:
+        raise ValueError(
+            f"KV cache overflow: writing {t} tokens at position "
+            f"{int(start)} exceeds max_len={max_len}")
+    k_buf = lax.dynamic_update_slice(cache["k"], k_new.astype(cache["k"].dtype),
+                                     (0, 0, start, 0))
+    v_buf = lax.dynamic_update_slice(cache["v"], v_new.astype(cache["v"].dtype),
+                                     (0, 0, start, 0))
+    s = jnp.einsum("bhtd,bhkd->bhtk", q, k_buf,
+                   preferred_element_type=jnp.float32) * scale
+    # visibility: cached prefix [0, start) plus the causal part of the new
+    # block [start, start+t)
+    key_pos = lax.broadcasted_iota(jnp.int32, (t, max_len), 1)
+    row_pos = start + lax.broadcasted_iota(jnp.int32, (t, max_len), 0)
+    visible = key_pos <= row_pos
+    s = jnp.where(visible[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum("bhtk,bhkd->bhtd", p.astype(v_buf.dtype), v_buf,
+                     preferred_element_type=jnp.float32)
+    new_cache = {"k": k_buf, "v": v_buf, "length": start + t}
+    return ctx.astype(q.dtype), new_cache
+
+
+def greedy_generate(step_fn: Callable, params: Any, cache: Any,
+                    prompt_last_token: jax.Array, max_new_tokens: int,
+                    eos_id: Optional[int] = None) -> jax.Array:
+    """Single-dispatch greedy decoding loop.
+
+    ``step_fn(params, token [B], cache) -> (logits [B, V], cache)`` is the
+    user's per-token forward (typically built on :func:`cached_attention`).
+    Each scan step FEEDS a token — i.e. appends its K/V and predicts the
+    next — so prefill the prompt EXCLUDING its last token and pass that
+    last token here; prefilling the whole prompt would insert the final
+    token's K/V twice. The loop runs as ONE ``lax.scan`` of
+    ``max_new_tokens`` steps; with ``eos_id``, finished rows keep emitting
+    ``eos_id`` (output length stays static — XLA-friendly).
+
+    Returns generated tokens ``[B, max_new_tokens]``.
+    """
+
+    def body(carry, _):
+        token, cache, done = carry
+        logits, cache = step_fn(params, token, cache)
+        nxt = jnp.argmax(logits, axis=-1).astype(token.dtype)
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.asarray(eos_id, token.dtype), nxt)
+            done = done | (nxt == eos_id)
+        return (nxt, cache, done), nxt
+
+    done0 = jnp.zeros(prompt_last_token.shape, bool)
+    (_, _, _), tokens = lax.scan(
+        body, (prompt_last_token, cache, done0), None,
+        length=max_new_tokens)
+    return jnp.swapaxes(tokens, 0, 1)  # [B, max_new]
