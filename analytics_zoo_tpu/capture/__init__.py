@@ -21,3 +21,4 @@ from .graph_model import GraphModel  # noqa: F401
 from .fn_estimator import FnEstimator, ModeKeys  # noqa: F401
 from .gan import GANEstimator  # noqa: F401
 from .text import BERTClassifier, BERTNER, BERTSQuAD  # noqa: F401
+from .lm import TransformerLM  # noqa: F401

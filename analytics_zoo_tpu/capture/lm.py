@@ -1,0 +1,180 @@
+"""TransformerLM — decoder-only language model with cached generation.
+
+Beyond-reference capability (the reference's only generator is the RNN
+Seq2seq chatbot path): a pure-functional transformer decoder whose
+TRAINING step runs causal flash attention (pallas on TPU) and whose
+GENERATION runs the static-shape KV cache (``ops/decode.py``) with the
+whole decode in one ``lax.scan`` dispatch. Training plugs into the
+capture-style ``GraphModel.from_loss`` contract, so fit/evaluate ride the
+same Estimator loop as every other captured model.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..keras.layers.attention import _layer_norm, _layer_norm_params
+from ..ops.attention import flash_attention
+from ..ops.decode import cached_attention, greedy_generate, init_kv_cache
+
+
+class TransformerLM:
+    """Decoder-only LM: tied-embedding logits, pre-LN blocks, causal
+    attention. ``fit(tokens)`` trains next-token prediction;
+    ``generate(prompt, max_new_tokens)`` decodes greedily off the KV
+    cache."""
+
+    def __init__(self, vocab_size: int, hidden: int = 256, n_block: int = 4,
+                 n_head: int = 4, max_len: int = 512,
+                 intermediate: Optional[int] = None, optimizer="adam",
+                 seed: int = 0):
+        if hidden % n_head:
+            raise ValueError(f"hidden {hidden} not divisible by "
+                             f"heads {n_head}")
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.n_block = n_block
+        self.n_head = n_head
+        self.max_len = max_len
+        self.intermediate = intermediate or 4 * hidden
+        self._head_dim = hidden // n_head
+        from .graph_model import GraphModel
+        self._graph = GraphModel.from_loss(
+            self._loss, self._init_params, optimizer=optimizer,
+            forward_fn=self._forward)
+        # thread the seed into the Estimator's init rng
+        self._graph.estimator.root_rng = jax.random.PRNGKey(seed)
+
+    # -- parameters -----------------------------------------------------------
+
+    def _init_params(self, rng, sample_x) -> Dict[str, Any]:
+        del sample_x
+        d, inter, v = self.hidden, self.intermediate, self.vocab_size
+        keys = jax.random.split(rng, 2 + 4 * self.n_block)
+        init = jax.nn.initializers.normal(0.02)
+
+        def dense(key, fan_in, fan_out):
+            return {"kernel": init(key, (fan_in, fan_out), jnp.float32),
+                    "bias": jnp.zeros((fan_out,))}
+
+        def ln():
+            return _layer_norm_params(d)
+
+        blocks = []
+        for i in range(self.n_block):
+            k = jax.random.split(keys[2 + i], 4)
+            blocks.append({
+                "ln1": ln(), "qkv": dense(k[0], d, 3 * d),
+                "attn_out": dense(k[1], d, d),
+                "ln2": ln(), "fc1": dense(k[2], d, inter),
+                "fc2": dense(k[3], inter, d),
+            })
+        return {"embed": init(keys[0], (v, d), jnp.float32),
+                "pos": init(keys[1], (self.max_len, d), jnp.float32),
+                "blocks": blocks, "ln_f": ln()}
+
+    # -- training-time forward (full sequence, flash attention) --------------
+
+    def _split_heads(self, x):
+        b, s, _ = x.shape
+        return x.reshape(b, s, self.n_head, self._head_dim).transpose(
+            0, 2, 1, 3)
+
+    def _block(self, p, x, kv_fn):
+        h = _layer_norm(p["ln1"], x)
+        qkv = h @ p["qkv"]["kernel"] + p["qkv"]["bias"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        ctx = kv_fn(self._split_heads(q), self._split_heads(k),
+                    self._split_heads(v))
+        b, _, s, _ = ctx.shape
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, self.hidden)
+        x = x + ctx @ p["attn_out"]["kernel"] + p["attn_out"]["bias"]
+        h = _layer_norm(p["ln2"], x)
+        h = jax.nn.gelu(h @ p["fc1"]["kernel"] + p["fc1"]["bias"])
+        return x + h @ p["fc2"]["kernel"] + p["fc2"]["bias"]
+
+    def _forward(self, params, tokens) -> jax.Array:
+        tokens = tokens.astype(jnp.int32)
+        s = tokens.shape[1]
+        x = params["embed"][tokens] + params["pos"][None, :s]
+        for p in params["blocks"]:
+            x = self._block(
+                p, x, lambda q, k, v: flash_attention(q, k, v, causal=True))
+        x = _layer_norm(params["ln_f"], x)
+        return x @ params["embed"].T  # tied logits [B, S, V]
+
+    def _loss(self, params, x, y=None):
+        tokens = x.astype(jnp.int32)
+        logits = self._forward(params, tokens[:, :-1])
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return jnp.mean(nll)
+
+    # -- public surface -------------------------------------------------------
+
+    def fit(self, tokens, batch_size: int = 32, epochs: int = 1, **kw):
+        """``tokens``: [N, S] int sequences; trains next-token NLL."""
+        return self._graph.fit(np.asarray(tokens, np.float32),
+                               batch_size=batch_size, epochs=epochs, **kw)
+
+    def logits(self, tokens, batch_size: int = 32):
+        return self._graph.predict(np.asarray(tokens, np.float32),
+                                   batch_size=batch_size)
+
+    @property
+    def params(self):
+        params = self._graph.estimator.params
+        if params is None:
+            raise RuntimeError(
+                "TransformerLM has no parameters yet: call fit() (or "
+                "restore a checkpoint through the estimator) first")
+        return params
+
+    def generate(self, prompt, max_new_tokens: int,
+                 eos_id: Optional[int] = None) -> np.ndarray:
+        """Greedy continuation of ``prompt`` [B, S]: prefill the prompt
+        minus its last token through the per-block KV caches, then decode
+        ``max_new_tokens`` in one scan dispatch."""
+        prompt = jnp.asarray(np.asarray(prompt), jnp.int32)
+        b, s = prompt.shape
+        if s + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_len={self.max_len}")
+        params = self.params
+        caches = [init_kv_cache(b, self.n_head, self.max_len,
+                                self._head_dim, jnp.float32)
+                  for _ in range(self.n_block)]
+
+        def run(params, tokens, caches):
+            """Feed ``tokens`` [B, T] through all blocks with caches;
+            returns (next-token logits [B, V], caches)."""
+            start = caches[0]["length"]
+            x = params["embed"][tokens] + jax.lax.dynamic_slice(
+                params["pos"], (start, 0),
+                (tokens.shape[1], self.hidden))[None]
+            new_caches = []
+            for p, cache in zip(params["blocks"], caches):
+                holder = {}
+
+                def kv_fn(q, k, v, cache=cache, holder=holder):
+                    ctx, holder["cache"] = cached_attention(q, k, v, cache)
+                    return ctx
+                x = self._block(p, x, kv_fn)
+                new_caches.append(holder["cache"])
+            x = _layer_norm(params["ln_f"], x)
+            return (x[:, -1] @ params["embed"].T), new_caches
+
+        if s > 1:  # prefill everything except the last prompt token
+            _, caches = run(params, prompt[:, :-1], caches)
+
+        def step_fn(params, token, caches):
+            return run(params, token[:, None], caches)
+
+        return np.asarray(greedy_generate(
+            step_fn, params, caches, prompt[:, -1], max_new_tokens,
+            eos_id=eos_id))
