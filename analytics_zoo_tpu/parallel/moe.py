@@ -3,9 +3,11 @@
 The reference has no MoE (SURVEY §5: no expert parallelism anywhere); this
 is new TPU-native capability completing the mesh-axis set (dp/tp/sp/ep).
 
-Design: top-1 ("switch") routing with DENSE dispatch — per-token gate
-probabilities become a one-hot combine matrix and expert computation is one
-batched einsum over [experts, capacity, d]. No gather/scatter with dynamic
+Design: top-k routing (k=1 "switch", k=2 GShard-style) with DENSE
+dispatch — per-token gate probabilities become a one-hot combine matrix
+and expert computation is ONE batched einsum over [experts, capacity, d]
+regardless of k (per-choice dispatch tensors occupy disjoint capacity
+slots and sum into a single dispatch). No gather/scatter with dynamic
 shapes, so XLA tiles everything onto the MXU and the `expert` mesh axis
 shards the expert dimension of both the parameters and the dispatched
 tokens; the all-to-all that moves tokens to their experts is the einsum's
@@ -35,8 +37,10 @@ class MoE(Layer):
     objective with a fixed weight).
 
     Input ``[batch, seq, d]`` (or ``[batch, d]``); each token routes to its
-    top-1 expert, subject to ``capacity_factor`` (tokens over capacity are
-    passed through the residual path untouched).
+    top-``k`` experts (k=1 switch, k=2 GShard with renormalized gates),
+    subject to ``capacity_factor`` per choice — total slots scale with k
+    (the GShard ``k * tokens * C / e`` convention); tokens whose every
+    choice overflows ride the residual path untouched.
     """
 
     def __init__(self, num_experts: int, hidden_dim: int,
@@ -45,8 +49,11 @@ class MoE(Layer):
                  group_size: int = 4096,
                  activation: str = "relu",
                  init: str = "glorot_uniform",
+                 k: int = 1,
                  name: Optional[str] = None):
         super().__init__(name)
+        if not 1 <= k <= num_experts:
+            raise ValueError(f"k={k} must be in [1, num_experts]")
         self.num_experts = num_experts
         self.hidden_dim = hidden_dim
         self.capacity_factor = capacity_factor
@@ -57,6 +64,10 @@ class MoE(Layer):
         self.group_size = group_size
         self.activation = activation
         self.init = initializers.get(init)
+        # k=1 is the Switch transformer; k=2 the GShard top-2 router (gates
+        # renormalized over the chosen experts, first choices claim
+        # capacity before second choices)
+        self.k = k
 
     def build(self, rng, input_shape):
         d = input_shape[-1]
@@ -90,7 +101,9 @@ class MoE(Layer):
                 [flat, jnp.zeros((pad, d), flat.dtype)])
         g = flat.shape[0] // gsz
         grouped = flat.reshape(g, gsz, d)
-        cap = max(1, int(self.capacity_factor * gsz / e))
+        # GShard capacity convention: slots scale with k so second
+        # choices aren't starved at the default capacity_factor
+        cap = max(1, int(self.k * self.capacity_factor * gsz / e))
 
         # alignment pad rows must neither consume expert capacity nor
         # count in the balance statistics
@@ -100,36 +113,62 @@ class MoE(Layer):
                             params["gate"].astype(flat.dtype)
                             ).astype(jnp.float32)
         probs = jax.nn.softmax(logits, axis=-1)            # [g, t, e]
-        expert_idx = jnp.argmax(probs, axis=-1)            # [g, t]
-        gate = jnp.max(probs, axis=-1)                     # [g, t]
 
-        onehot = (jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
-                  * valid.astype(jnp.float32)[..., None])
-        # position of each token within its expert's per-group queue
-        pos = (jnp.cumsum(onehot, axis=1) - 1.0) * onehot  # [g, t, e]
-        pos_in_expert = jnp.sum(pos, axis=-1).astype(jnp.int32)
-        keep = pos_in_expert < cap                         # capacity mask
+        # top-k choices per token (argmax of the remaining probs each round)
+        remaining = probs
+        onehots, gates = [], []
+        for _ in range(self.k):
+            idx_c = jnp.argmax(remaining, axis=-1)         # [g, t]
+            oh_c = jax.nn.one_hot(idx_c, e, dtype=jnp.float32)
+            gates.append(jnp.sum(probs * oh_c, axis=-1))
+            onehots.append(oh_c * valid.astype(jnp.float32)[..., None])
+            remaining = remaining * (1.0 - oh_c)
+        if self.k > 1:  # GShard: gates renormalize over the chosen experts
+            gate_sum = sum(gates)
+            gates = [gc / jnp.maximum(gate_sum, 1e-9) for gc in gates]
+        # k=1 keeps the RAW router probability (Switch transformer: the
+        # gate scale is the router's gradient path)
 
-        # dispatch tensor [g, t, e, cap]: one-hot over (expert, slot)
-        slot_onehot = jax.nn.one_hot(pos_in_expert, cap, dtype=flat.dtype)
-        dispatch = (onehot.astype(flat.dtype)[..., None]
-                    * slot_onehot[..., None, :]
-                    * keep.astype(flat.dtype)[..., None, None])
+        # capacity accounting: first choices claim slots before second
+        # choices (the per-(group, expert) running count carries across
+        # rounds), but the slots are DISJOINT, so all rounds merge into one
+        # dispatch/combine pair and the expert FFN + all-to-all run ONCE
+        claimed = jnp.zeros((g, 1, e), jnp.float32)
+        dispatch_total = jnp.zeros((g, gsz, e, cap), flat.dtype)
+        combine_total = jnp.zeros((g, gsz, e, cap), flat.dtype)
+        any_kept = jnp.zeros(valid.shape, bool)
+        onehot0 = onehots[0]  # choice-0 stats feed the balance loss
+        for oh_c, gate_c in zip(onehots, gates):
+            pos = ((jnp.cumsum(oh_c, axis=1) - 1.0) + claimed) * oh_c
+            pos_in_expert = jnp.sum(pos, axis=-1).astype(jnp.int32)
+            routed = jnp.sum(oh_c, axis=-1) > 0            # valid tokens
+            keep = (pos_in_expert < cap) & routed          # capacity mask
+            slot_onehot = jax.nn.one_hot(pos_in_expert, cap,
+                                         dtype=flat.dtype)
+            dispatch = (oh_c.astype(flat.dtype)[..., None]
+                        * slot_onehot[..., None, :]
+                        * keep.astype(flat.dtype)[..., None, None])
+            dispatch_total = dispatch_total + dispatch
+            combine_total = combine_total + dispatch * gate_c.astype(
+                flat.dtype)[..., None, None]
+            any_kept = any_kept | keep
+            claimed = claimed + jnp.sum(oh_c * keep[..., None].astype(
+                jnp.float32), axis=1, keepdims=True)
+
         # expert inputs [g, e, cap, d] — the contraction over tokens is
         # where XLA inserts the all-to-all under expert sharding
-        xin = jnp.einsum("gtec,gtd->gecd", dispatch, grouped)
+        xin = jnp.einsum("gtec,gtd->gecd", dispatch_total, grouped)
         h = act(jnp.einsum("gecd,edh->gech", xin,
                            params["w_in"].astype(flat.dtype))
                 + params["b_in"].astype(flat.dtype)[None, :, None, :])
         out = (jnp.einsum("gech,ehd->gecd", h,
                           params["w_out"].astype(flat.dtype))
                + params["b_out"].astype(flat.dtype)[None, :, None, :])
-        # combine back to tokens, weighted by the gate probability
-        combined = jnp.einsum("gtec,gecd->gtd", dispatch, out)
-        combined = combined * gate.astype(flat.dtype)[..., None]
-        # dropped tokens (over capacity) ride the residual path
-        y = jnp.where(keep[..., None], combined, grouped)
+        combined = jnp.einsum("gtec,gecd->gtd", combine_total, out)
+        # tokens whose every choice was dropped ride the residual path
+        y = jnp.where(any_kept[..., None], combined, grouped)
         y = y.reshape(-1, d)[:n_tok].reshape(b, s, d)
+        onehot = onehot0  # balance statistics below use the first choice
 
         # switch-transformer load-balance loss: e * Σ_e (frac_tokens_e *
         # frac_probs_e), averaged over groups; the Estimator consumes it

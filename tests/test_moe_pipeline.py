@@ -226,3 +226,49 @@ class TestTensorParallel:
         p_tp = np.asarray(est_tp.predict(x[:16]))
         p_dp = np.asarray(est_dp.predict(x[:16]))
         np.testing.assert_allclose(p_tp, p_dp, atol=1e-5)
+
+
+class TestMoETopK:
+    def _x(self, n=16, d=8, seed=0):
+        rs = np.random.RandomState(seed)
+        return rs.randn(n, d).astype(np.float32)
+
+    def test_top2_is_weighted_expert_mix(self, ctx):
+        """With ample capacity, top-2 output must equal the gate-weighted
+        sum of the two chosen experts' FFN outputs, gates renormalized."""
+        import jax
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.parallel.moe import MoE
+        d, e = 8, 4
+        moe = MoE(num_experts=e, hidden_dim=16, k=2, capacity_factor=8.0)
+        rng = jax.random.PRNGKey(0)
+        params, state = moe.build(rng, (None, d))
+        x = jnp.asarray(self._x())
+        y, _ = moe.call(params, state, x)
+
+        # manual reference
+        logits = x @ params["gate"]
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        top2 = jnp.argsort(-probs, axis=-1)[:, :2]
+        ref = []
+        for i in range(x.shape[0]):
+            total = 0.0
+            g2 = probs[i, top2[i]]
+            g2 = g2 / g2.sum()
+            for j, ei in enumerate(np.asarray(top2[i])):
+                h = jax.nn.relu(x[i] @ params["w_in"][ei]
+                                + params["b_in"][ei])
+                total = total + g2[j] * (h @ params["w_out"][ei]
+                                         + params["b_out"][ei])
+            ref.append(total)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(jnp.stack(ref)),
+                                   atol=1e-4)
+
+    def test_top1_unchanged_default(self, ctx):
+        from analytics_zoo_tpu.parallel.moe import MoE
+        assert MoE(num_experts=4, hidden_dim=8).k == 1
+
+    def test_invalid_k_raises(self, ctx):
+        from analytics_zoo_tpu.parallel.moe import MoE
+        with pytest.raises(ValueError, match="k=5"):
+            MoE(num_experts=4, hidden_dim=8, k=5)
