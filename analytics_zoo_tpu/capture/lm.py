@@ -18,7 +18,8 @@ import numpy as np
 
 from ..keras.layers.attention import _layer_norm, _layer_norm_params
 from ..ops.attention import flash_attention
-from ..ops.decode import cached_attention, greedy_generate, init_kv_cache
+from ..ops.decode import (beam_generate, cached_attention,
+                          greedy_generate, init_kv_cache)
 
 
 class TransformerLM:
@@ -135,10 +136,12 @@ class TransformerLM:
         return params
 
     def generate(self, prompt, max_new_tokens: int,
-                 eos_id: Optional[int] = None) -> np.ndarray:
-        """Greedy continuation of ``prompt`` [B, S]: prefill the prompt
-        minus its last token through the per-block KV caches, then decode
-        ``max_new_tokens`` in one scan dispatch."""
+                 eos_id: Optional[int] = None,
+                 beam_size: int = 1) -> np.ndarray:
+        """Continuation of ``prompt`` [B, S]: prefill the prompt minus its
+        last token through the per-block KV caches, then decode
+        ``max_new_tokens`` in one scan dispatch — greedy by default, beam
+        search (best sequence returned) with ``beam_size > 1``."""
         prompt = jnp.asarray(np.asarray(prompt), jnp.int32)
         b, s = prompt.shape
         if s + max_new_tokens > self.max_len:
@@ -175,6 +178,11 @@ class TransformerLM:
         def step_fn(params, token, caches):
             return run(params, token[:, None], caches)
 
+        if beam_size > 1:
+            seqs, _ = beam_generate(step_fn, params, caches, prompt[:, -1],
+                                    max_new_tokens, beam_size,
+                                    eos_id=eos_id)
+            return np.asarray(seqs[:, 0])  # best beam
         return np.asarray(greedy_generate(
             step_fn, params, caches, prompt[:, -1], max_new_tokens,
             eos_id=eos_id))

@@ -12,6 +12,7 @@ from .attention import (  # noqa: F401
     flash_attention_lse,
 )
 from .decode import (  # noqa: F401
+    beam_generate,
     cached_attention,
     greedy_generate,
     init_kv_cache,

@@ -108,3 +108,72 @@ def greedy_generate(step_fn: Callable, params: Any, cache: Any,
         body, (prompt_last_token, cache, done0), None,
         length=max_new_tokens)
     return jnp.swapaxes(tokens, 0, 1)  # [B, max_new]
+
+
+def beam_generate(step_fn: Callable, params: Any, cache: Any,
+                  prompt_last_token: jax.Array, max_new_tokens: int,
+                  beam_size: int, eos_id: Optional[int] = None
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Beam-search decoding, one ``lax.scan`` dispatch.
+
+    Same ``step_fn(params, token [N], cache) -> (logits [N, V], cache)``
+    contract as :func:`greedy_generate`, where N is ``batch * beam_size``
+    after tiling. Cache leaves whose leading axis equals the batch size are
+    tiled ``beam_size``-fold and reordered by backpointer every step; a
+    finished beam (emitted ``eos_id``) keeps its score and pads with eos.
+
+    Returns ``(sequences [B, beam, max_new], scores [B, beam])`` sorted
+    best-first by accumulated log-probability.
+    """
+    b = prompt_last_token.shape[0]
+    k = beam_size
+
+    def tile(a):
+        if hasattr(a, "ndim") and a.ndim > 0 and a.shape[0] == b:
+            return jnp.repeat(a, k, axis=0)
+        return a
+
+    caches = jax.tree_util.tree_map(tile, cache)
+    tokens = jnp.repeat(prompt_last_token[:, None], k, axis=1)  # [B, K]
+    # only beam 0 is live initially so the first expansion picks the top-k
+    # distinct continuations instead of k copies of the argmax
+    scores = jnp.tile(jnp.asarray([0.0] + [_NEG_INF] * (k - 1)), (b, 1))
+    done = jnp.zeros((b, k), bool)
+    seqbuf = jnp.zeros((b, k, max_new_tokens), prompt_last_token.dtype)
+
+    def body(carry, i):
+        tokens, scores, done, seqbuf, caches = carry
+        logits, caches = step_fn(params, tokens.reshape(b * k), caches)
+        v = logits.shape[-1]
+        logp = jax.nn.log_softmax(
+            logits.astype(jnp.float32), axis=-1).reshape(b, k, v)
+        if eos_id is not None:
+            # a finished beam may only "continue" with eos at zero cost
+            eos_row = jnp.full((v,), _NEG_INF).at[eos_id].set(0.0)
+            logp = jnp.where(done[..., None], eos_row[None, None], logp)
+        cand = (scores[..., None] + logp).reshape(b, k * v)
+        scores, idx = lax.top_k(cand, k)                   # [B, K]
+        parent = idx // v
+        token = (idx % v).astype(tokens.dtype)
+
+        def reorder(a):
+            if hasattr(a, "ndim") and a.ndim > 0 and a.shape[0] == b * k:
+                ak = a.reshape((b, k) + a.shape[1:])
+                sel = jnp.take_along_axis(
+                    ak, parent.reshape((b, k) + (1,) * (a.ndim - 1)), axis=1)
+                return sel.reshape((b * k,) + a.shape[1:])
+            return a
+
+        caches = jax.tree_util.tree_map(reorder, caches)
+        seqbuf = jnp.take_along_axis(seqbuf, parent[..., None], axis=1)
+        seqbuf = lax.dynamic_update_slice(
+            seqbuf, token[..., None], (0, 0, i))
+        done = jnp.take_along_axis(done, parent, axis=1)
+        if eos_id is not None:
+            done = done | (token == eos_id)
+        return (token, scores, done, seqbuf, caches), None
+
+    (tokens, scores, done, seqbuf, caches), _ = lax.scan(
+        body, (tokens, scores, done, seqbuf, caches),
+        jnp.arange(max_new_tokens))
+    return seqbuf, scores

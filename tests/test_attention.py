@@ -420,3 +420,67 @@ class TestKVCacheDecode:
         _, cache = cached_attention(t, t, t, cache)  # 3 of 4 used
         with pytest.raises(ValueError, match="KV cache overflow"):
             cached_attention(t, t, t, cache)
+
+
+class TestBeamSearch:
+    def test_beam_beats_greedy_on_garden_path(self):
+        """Classic garden-path distribution: the greedy first token leads
+        to a flat continuation, the runner-up to a peaked one. Beam search
+        must find the higher-probability sequence greedy misses."""
+        from analytics_zoo_tpu.ops.decode import (
+            beam_generate, greedy_generate)
+        V = 6
+        la, lb = np.log(0.55), np.log(0.45)
+        flat = np.log(np.full(V, 1.0 / V))
+        peaked = np.log(np.asarray([0.01, 0.01, 0.95, 0.01, 0.01, 0.01]))
+
+        def step_fn(params, token, cache):
+            # token 0 -> {1: 0.55, 3: 0.45}; after 1 -> flat; after 3 ->
+            # peaked at 2; anything else -> flat
+            first = jnp.full((V,), -1e9).at[1].set(la).at[3].set(lb)
+            t = token.astype(jnp.int32)
+            logits = jnp.where(
+                (t == 0)[:, None], first[None],
+                jnp.where((t == 3)[:, None], jnp.asarray(peaked)[None],
+                          jnp.asarray(flat)[None]))
+            return logits, cache
+
+        start = jnp.asarray([0], jnp.int32)
+        greedy = np.asarray(greedy_generate(step_fn, {}, {}, start, 2))
+        assert greedy[0, 0] == 1  # greedy takes the locally best token
+        seqs, scores = beam_generate(step_fn, {}, {}, start, 2, beam_size=3)
+        best = np.asarray(seqs)[0, 0]
+        # beam finds 0->3->2: log(.45*.95) > log(.55*1/6)
+        np.testing.assert_array_equal(best, [3, 2])
+        assert np.asarray(scores)[0, 0] == pytest.approx(
+            np.log(0.45) + np.log(0.95), abs=1e-4)
+        assert (np.asarray(scores)[0, :-1] >= np.asarray(scores)[0, 1:]).all()
+
+    def test_beam_with_cache_model_and_eos(self):
+        """Beam over a real cached-attention step_fn: caches reorder by
+        backpointer; eos-finished beams pad and keep their score."""
+        from analytics_zoo_tpu.ops.decode import (
+            beam_generate, cached_attention, init_kv_cache)
+        rs = np.random.RandomState(0)
+        V, D, H = 8, 8, 2
+        params = {
+            "embed": jnp.asarray(rs.randn(V, D).astype(np.float32) * 0.5),
+            "w": jnp.asarray(rs.randn(D, V).astype(np.float32) * 0.5),
+        }
+
+        def step_fn(p, token, cache):
+            x = p["embed"][token.astype(jnp.int32)]
+            q = x.reshape(x.shape[0], H, 1, D // H)
+            ctx, cache = cached_attention(q, q, q, cache)
+            return ctx.reshape(x.shape[0], D) @ p["w"], cache
+
+        B = 2
+        cache = init_kv_cache(B, H, max_len=8, head_dim=D // H,
+                              dtype=jnp.float32)
+        start = jnp.asarray([1, 5], jnp.int32)
+        seqs, scores = jax.jit(
+            lambda p, c, s: beam_generate(step_fn, p, c, s, 4, beam_size=2,
+                                          eos_id=0))(params, cache, start)
+        assert np.asarray(seqs).shape == (B, 2, 4)
+        assert np.asarray(scores).shape == (B, 2)
+        assert ((0 <= np.asarray(seqs)) & (np.asarray(seqs) < V)).all()

@@ -426,6 +426,9 @@ class TestTransformerLM:
         logits = np.asarray(lm.logits(prompt))  # [B, S, V]
         full_next = logits[:, -1].argmax(-1)
         np.testing.assert_array_equal(gen1, full_next)
+        # beam_size=1-equivalent best beam matches greedy on a peaked model
+        beam = lm.generate(prompt, max_new_tokens=1, beam_size=3)[:, 0]
+        np.testing.assert_array_equal(beam, full_next)
 
     def test_prompt_budget_enforced(self, ctx):
         from analytics_zoo_tpu.capture import TransformerLM
