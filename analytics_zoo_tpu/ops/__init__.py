@@ -16,4 +16,5 @@ from .decode import (  # noqa: F401
     cached_attention,
     greedy_generate,
     init_kv_cache,
+    sample_generate,
 )

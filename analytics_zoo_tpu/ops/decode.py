@@ -77,6 +77,28 @@ def cached_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     return ctx.astype(q.dtype), new_cache
 
 
+def _decode_loop(step_fn, params, cache, prompt_last_token,
+                 max_new_tokens, eos_id, select_fn, xs) -> jax.Array:
+    """Shared scan scaffolding for greedy/sampled decoding: feed a token,
+    select the next via ``select_fn(logits, x)``, force eos on finished
+    rows. One dispatch for the whole sequence."""
+
+    def body(carry, x):
+        token, cache, done = carry
+        logits, cache = step_fn(params, token, cache)
+        nxt = select_fn(logits, x).astype(token.dtype)
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.asarray(eos_id, token.dtype), nxt)
+            done = done | (nxt == eos_id)
+        return (nxt, cache, done), nxt
+
+    done0 = jnp.zeros(prompt_last_token.shape, bool)
+    (_, _, _), tokens = lax.scan(
+        body, (prompt_last_token, cache, done0), xs,
+        length=None if xs is not None else max_new_tokens)
+    return jnp.swapaxes(tokens, 0, 1)  # [B, max_new]
+
+
 def greedy_generate(step_fn: Callable, params: Any, cache: Any,
                     prompt_last_token: jax.Array, max_new_tokens: int,
                     eos_id: Optional[int] = None) -> jax.Array:
@@ -93,21 +115,9 @@ def greedy_generate(step_fn: Callable, params: Any, cache: Any,
 
     Returns generated tokens ``[B, max_new_tokens]``.
     """
-
-    def body(carry, _):
-        token, cache, done = carry
-        logits, cache = step_fn(params, token, cache)
-        nxt = jnp.argmax(logits, axis=-1).astype(token.dtype)
-        if eos_id is not None:
-            nxt = jnp.where(done, jnp.asarray(eos_id, token.dtype), nxt)
-            done = done | (nxt == eos_id)
-        return (nxt, cache, done), nxt
-
-    done0 = jnp.zeros(prompt_last_token.shape, bool)
-    (_, _, _), tokens = lax.scan(
-        body, (prompt_last_token, cache, done0), None,
-        length=max_new_tokens)
-    return jnp.swapaxes(tokens, 0, 1)  # [B, max_new]
+    return _decode_loop(step_fn, params, cache, prompt_last_token,
+                        max_new_tokens, eos_id,
+                        lambda logits, _: jnp.argmax(logits, axis=-1), None)
 
 
 def beam_generate(step_fn: Callable, params: Any, cache: Any,
@@ -177,3 +187,53 @@ def beam_generate(step_fn: Callable, params: Any, cache: Any,
         body, (tokens, scores, done, seqbuf, caches),
         jnp.arange(max_new_tokens))
     return seqbuf, scores
+
+
+def sample_generate(step_fn: Callable, params: Any, cache: Any,
+                    prompt_last_token: jax.Array, max_new_tokens: int,
+                    rng: jax.Array, temperature: float = 1.0,
+                    top_k: Optional[int] = None,
+                    top_p: Optional[float] = None,
+                    eos_id: Optional[int] = None) -> jax.Array:
+    """Stochastic decoding (temperature / top-k / nucleus), one scan
+    dispatch — same ``step_fn`` contract as :func:`greedy_generate`.
+
+    Filters compose in the standard order: temperature scales logits,
+    ``top_k`` keeps the k highest, ``top_p`` keeps the smallest prefix of
+    the sorted distribution with cumulative probability >= top_p; sampling
+    renormalizes over what survives. Finished rows keep emitting
+    ``eos_id``.
+    """
+    if temperature <= 0:
+        raise ValueError("temperature must be > 0 (use greedy_generate "
+                         "for deterministic argmax decoding)")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k} "
+                         "(pass top_k=None to disable)")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p} "
+                         "(pass top_p=None to disable)")
+
+    def filter_logits(logits):
+        logits = logits / temperature
+        if top_k is not None:
+            kth = lax.top_k(logits, top_k)[0][..., -1:]
+            logits = jnp.where(logits < kth, _NEG_INF, logits)
+        if top_p is not None:
+            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep the smallest prefix reaching top_p (always >= 1 token)
+            cutoff_idx = jnp.sum((cum - probs) < top_p, axis=-1,
+                                 keepdims=True) - 1
+            cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+            logits = jnp.where(logits < cutoff, _NEG_INF, logits)
+        return logits
+
+    def select(logits, step_rng):
+        return jax.random.categorical(
+            step_rng, filter_logits(logits.astype(jnp.float32)), axis=-1)
+
+    return _decode_loop(step_fn, params, cache, prompt_last_token,
+                        max_new_tokens, eos_id, select,
+                        jax.random.split(rng, max_new_tokens))

@@ -484,3 +484,51 @@ class TestBeamSearch:
         assert np.asarray(seqs).shape == (B, 2, 4)
         assert np.asarray(scores).shape == (B, 2)
         assert ((0 <= np.asarray(seqs)) & (np.asarray(seqs) < V)).all()
+
+
+class TestSampledDecode:
+    def _biased_step(self):
+        # stationary distribution strongly favoring token 2
+        logits_row = jnp.log(jnp.asarray([0.02, 0.02, 0.9, 0.02, 0.02, 0.02]))
+
+        def step_fn(params, token, cache):
+            return jnp.tile(logits_row[None], (token.shape[0], 1)), cache
+        return step_fn
+
+    def test_temperature_sampling_follows_distribution(self):
+        from analytics_zoo_tpu.ops.decode import sample_generate
+        step = self._biased_step()
+        toks = np.asarray(sample_generate(
+            step, {}, {}, jnp.zeros(4, jnp.int32), 64,
+            jax.random.PRNGKey(0)))
+        assert toks.shape == (4, 64)
+        assert (toks == 2).mean() > 0.75  # ~0.9 expected
+
+    def test_top_k_and_top_p_restrict_support(self):
+        from analytics_zoo_tpu.ops.decode import sample_generate
+        step = self._biased_step()
+        t1 = np.asarray(sample_generate(
+            step, {}, {}, jnp.zeros(2, jnp.int32), 128,
+            jax.random.PRNGKey(1), top_k=1))
+        assert (t1 == 2).all()  # only the argmax survives
+        tp = np.asarray(sample_generate(
+            step, {}, {}, jnp.zeros(2, jnp.int32), 128,
+            jax.random.PRNGKey(2), top_p=0.5))
+        assert (tp == 2).all()  # nucleus of 0.5 is just token 2 (p=0.9)
+
+    def test_invalid_temperature_raises(self):
+        from analytics_zoo_tpu.ops.decode import sample_generate
+        with pytest.raises(ValueError, match="temperature"):
+            sample_generate(self._biased_step(), {}, {},
+                            jnp.zeros(1, jnp.int32), 4,
+                            jax.random.PRNGKey(0), temperature=0.0)
+
+    def test_invalid_top_k_top_p_raise(self):
+        from analytics_zoo_tpu.ops.decode import sample_generate
+        step = self._biased_step()
+        with pytest.raises(ValueError, match="top_k"):
+            sample_generate(step, {}, {}, jnp.zeros(1, jnp.int32), 4,
+                            jax.random.PRNGKey(0), top_k=0)
+        with pytest.raises(ValueError, match="top_p"):
+            sample_generate(step, {}, {}, jnp.zeros(1, jnp.int32), 4,
+                            jax.random.PRNGKey(0), top_p=0.0)
