@@ -19,7 +19,7 @@ import numpy as np
 from ..keras.layers.attention import _layer_norm, _layer_norm_params
 from ..ops.attention import flash_attention
 from ..ops.decode import (beam_generate, cached_attention,
-                          greedy_generate, init_kv_cache)
+                          greedy_generate, init_kv_cache, sample_generate)
 
 
 class TransformerLM:
@@ -137,11 +137,21 @@ class TransformerLM:
 
     def generate(self, prompt, max_new_tokens: int,
                  eos_id: Optional[int] = None,
-                 beam_size: int = 1) -> np.ndarray:
+                 beam_size: int = 1,
+                 temperature: Optional[float] = None,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 seed: int = 0) -> np.ndarray:
         """Continuation of ``prompt`` [B, S]: prefill the prompt minus its
         last token through the per-block KV caches, then decode
         ``max_new_tokens`` in one scan dispatch — greedy by default, beam
-        search (best sequence returned) with ``beam_size > 1``."""
+        search (best sequence returned) with ``beam_size > 1``, or sampled
+        when ``temperature``/``top_k``/``top_p`` is given."""
+        sampling = (temperature is not None or top_k is not None
+                    or top_p is not None)
+        if sampling and beam_size > 1:
+            raise ValueError("choose either beam_size > 1 or sampling "
+                             "(temperature/top_k/top_p), not both")
         prompt = jnp.asarray(np.asarray(prompt), jnp.int32)
         b, s = prompt.shape
         if s + max_new_tokens > self.max_len:
@@ -183,6 +193,12 @@ class TransformerLM:
                                     max_new_tokens, beam_size,
                                     eos_id=eos_id)
             return np.asarray(seqs[:, 0])  # best beam
+        if sampling:
+            return np.asarray(sample_generate(
+                step_fn, params, caches, prompt[:, -1], max_new_tokens,
+                jax.random.PRNGKey(seed),
+                temperature=temperature if temperature is not None else 1.0,
+                top_k=top_k, top_p=top_p, eos_id=eos_id))
         return np.asarray(greedy_generate(
             step_fn, params, caches, prompt[:, -1], max_new_tokens,
             eos_id=eos_id))

@@ -1,0 +1,55 @@
+"""Train a tiny character language model and generate from it.
+
+Beyond-reference capability (the reference's generator is the RNN seq2seq
+chatbot): ``TransformerLM`` trains with causal flash attention and decodes
+off a static-shape KV cache — greedy, beam search, or sampled — with the
+whole decode in one scan dispatch.
+
+The toy corpus is arithmetic-progression "sentences" over a small
+alphabet; after a few epochs the model continues any prompt correctly.
+"""
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--epochs", type=int, default=60)
+    args = ap.parse_args()
+
+    from analytics_zoo_tpu.capture import TransformerLM
+
+    V, S = 16, 20
+    n, epochs = (256, 40) if args.smoke else (2048, args.epochs)
+    rs = np.random.RandomState(0)
+    starts = rs.randint(0, V, n)
+    strides = rs.choice([1, 2], n)
+    data = (starts[:, None] + strides[:, None] * np.arange(S)[None]) % V
+
+    lm = TransformerLM(vocab_size=V, hidden=48, n_block=2, n_head=4,
+                       max_len=64)
+    r = lm.fit(data, batch_size=64, epochs=epochs)
+    print(f"next-token NLL: {r['loss_history'][0]:.3f} -> "
+          f"{r['loss_history'][-1]:.3f}")
+
+    prompt = data[:3, :6]
+    greedy = lm.generate(prompt, max_new_tokens=8)
+    beam = lm.generate(prompt, max_new_tokens=8, beam_size=4)
+    sampled = lm.generate(prompt, max_new_tokens=8, temperature=0.7,
+                          top_p=0.9)
+    expected = np.stack([(prompt[i, -1] + strides[i] * np.arange(1, 9)) % V
+                         for i in range(3)])
+    for i in range(3):
+        print(f"prompt {prompt[i].tolist()} stride {strides[i]}")
+        print(f"  greedy : {greedy[i].tolist()}")
+        print(f"  beam-4 : {beam[i].tolist()}")
+        print(f"  sampled: {sampled[i].tolist()}")
+        print(f"  expect : {expected[i].tolist()}")
+    acc = (greedy == expected).mean()
+    print(f"greedy continuation accuracy: {acc:.2f}")
+
+
+if __name__ == "__main__":
+    main()

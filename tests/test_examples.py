@@ -28,6 +28,7 @@ EXAMPLES = [
     "examples/inference/quantized_inference_example.py",
     "examples/xshard/xshard_example.py",
     "examples/longcontext/long_context_example.py",
+    "examples/textgeneration/lm_generate_example.py",
 ]
 
 
