@@ -141,12 +141,13 @@ class TransformerLM:
                  temperature: Optional[float] = None,
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
-                 seed: int = 0) -> np.ndarray:
+                 seed: Optional[int] = None) -> np.ndarray:
         """Continuation of ``prompt`` [B, S]: prefill the prompt minus its
         last token through the per-block KV caches, then decode
         ``max_new_tokens`` in one scan dispatch — greedy by default, beam
         search (best sequence returned) with ``beam_size > 1``, or sampled
-        when ``temperature``/``top_k``/``top_p`` is given."""
+        when ``temperature``/``top_k``/``top_p`` is given. Sampling draws
+        fresh entropy per call; pass ``seed`` for reproducible draws."""
         sampling = (temperature is not None or top_k is not None
                     or top_p is not None)
         if sampling and beam_size > 1:
@@ -194,6 +195,8 @@ class TransformerLM:
                                     eos_id=eos_id)
             return np.asarray(seqs[:, 0])  # best beam
         if sampling:
+            if seed is None:  # fresh entropy: repeated calls differ
+                seed = int(np.random.SeedSequence().entropy % (2 ** 31))
             return np.asarray(sample_generate(
                 step_fn, params, caches, prompt[:, -1], max_new_tokens,
                 jax.random.PRNGKey(seed),

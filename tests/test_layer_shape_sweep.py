@@ -1,0 +1,99 @@
+"""Systematic layer sweep: declared vs actual output shapes.
+
+The reference runs a reflective serializer sweep over its whole layer
+library (``SerializerSpecHelper.scala`` — SURVEY §4); the analogue for this
+functional engine is the SHAPE CONTRACT: ``compute_output_shape`` drives
+symbolic graph construction, so a layer whose declaration disagrees with
+its ``call`` corrupts every model built with it. This sweep builds one
+representative instance per layer family, runs a concrete forward, and
+asserts the declared shape (with a None batch dim) matches reality.
+"""
+import jax
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.keras import layers as L
+
+# (constructor thunk, input shape without batch). Batch size is fixed at 4.
+CASES = [
+    # core
+    (lambda: L.Dense(7), (5,)),
+    (lambda: L.Dropout(0.5), (5,)),
+    (lambda: L.Activation("relu"), (5,)),
+    (lambda: L.Flatten(), (3, 4)),
+    (lambda: L.Reshape((4, 3)), (12,)),
+    (lambda: L.Permute((2, 1)), (3, 4)),
+    (lambda: L.RepeatVector(6), (5,)),
+    # conv / pooling
+    (lambda: L.Convolution1D(6, 3), (10, 4)),
+    (lambda: L.Convolution2D(6, 3, 3, border_mode="same"), (8, 8, 3)),
+    (lambda: L.Convolution3D(4, 2, 2, 2), (6, 6, 6, 2)),
+    (lambda: L.SeparableConvolution2D(6, 3, 3, border_mode="same"),
+     (8, 8, 4)),
+    (lambda: L.AtrousConvolution2D(5, 3, 3, atrous_rate=(2, 2),
+                                   border_mode="same"), (8, 8, 3)),
+    (lambda: L.Deconvolution2D(5, 3, 3, subsample=(2, 2)), (5, 5, 3)),
+    (lambda: L.MaxPooling2D((2, 2)), (8, 8, 3)),
+    (lambda: L.AveragePooling2D((2, 2)), (8, 8, 3)),
+    (lambda: L.GlobalAveragePooling2D(), (6, 6, 3)),
+    (lambda: L.GlobalMaxPooling2D(), (6, 6, 3)),
+    (lambda: L.UpSampling2D((2, 2)), (4, 4, 3)),
+    (lambda: L.ZeroPadding2D((1, 1)), (5, 5, 2)),
+    (lambda: L.Cropping2D(((1, 1), (1, 1))), (6, 6, 2)),
+    # recurrent
+    (lambda: L.LSTM(6), (7, 4)),
+    (lambda: L.LSTM(6, return_sequences=True), (7, 4)),
+    (lambda: L.GRU(5), (7, 4)),
+    (lambda: L.SimpleRNN(5), (7, 4)),
+    (lambda: L.Bidirectional(L.LSTM(3, return_sequences=True)), (7, 4)),
+    (lambda: L.ConvLSTM2D(4, 3, return_sequences=True), (5, 6, 6, 2)),
+    # embedding / norm
+    (lambda: L.Embedding(20, 6), (7,)),
+    (lambda: L.BatchNormalization(), (8,)),
+    (lambda: L.LayerNormalization(), (8,)),
+    # advanced
+    (lambda: L.Masking(0.0), (5, 3)),
+    (lambda: L.Highway(), (6,)),
+    (lambda: L.MaxoutDense(5, nb_feature=3), (6,)),
+    (lambda: L.TimeDistributed(L.Dense(4)), (5, 6)),
+    (lambda: L.SpatialDropout2D(0.3), (6, 6, 3)),
+    (lambda: L.GaussianNoise(0.1), (5,)),
+    (lambda: L.LeakyReLU(0.1), (5,)),
+    (lambda: L.PReLU(), (5,)),
+    (lambda: L.ELU(), (5,)),
+    (lambda: L.ThresholdedReLU(), (5,)),
+    # attention / crf
+    (lambda: L.CRF(5), (6, 5)),
+]
+
+
+def _ids():
+    out = []
+    for thunk, _ in CASES:
+        try:
+            out.append(type(thunk()).__name__)
+        except Exception:
+            out.append("broken")
+    return out
+
+
+@pytest.mark.parametrize("thunk,in_shape", CASES, ids=_ids())
+def test_declared_shape_matches_forward(thunk, in_shape):
+    layer = thunk()
+    batch = 4
+    declared = layer.compute_output_shape((None,) + tuple(in_shape))
+    rng = jax.random.PRNGKey(0)
+    params, state = layer.build(rng, (None,) + tuple(in_shape))
+    if isinstance(layer, L.Embedding):
+        x = np.random.RandomState(0).randint(0, 19, (batch,) + in_shape)
+        x = x.astype(np.float32)
+    else:
+        x = np.random.RandomState(0).rand(*((batch,) + in_shape))
+        x = x.astype(np.float32)
+    y, _ = layer.call(params, state, x, training=False,
+                      rng=jax.random.PRNGKey(1))
+    actual = np.asarray(y).shape
+    expect = tuple(batch if d is None else d for d in declared)
+    assert actual == expect, (
+        f"{type(layer).__name__}: declared {declared} -> {expect}, "
+        f"forward produced {actual}")
