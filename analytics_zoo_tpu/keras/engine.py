@@ -378,6 +378,26 @@ class _TrainableMixin:
         est = self.get_estimator()
         return est.predict(x, batch_size=batch_size)
 
+    def predict_classes(self, x, batch_size=32, zero_based_label=True):
+        """Hard class predictions (reference ``predict_classes``,
+        topology.py:329): argmax over the final axis for categorical
+        outputs, elementwise 0.5-threshold for single-channel outputs
+        (trailing singleton squeezed); ``zero_based_label=False`` shifts
+        labels to start at 1."""
+        probs = self.predict(x, batch_size=batch_size)
+        if isinstance(probs, (list, tuple)):
+            raise ValueError(
+                "predict_classes is ambiguous for multi-output models; "
+                "call predict() and decode each output yourself")
+        probs = np.asarray(probs)
+        if probs.ndim > 1 and probs.shape[-1] > 1:
+            classes = probs.argmax(axis=-1)
+        else:
+            if probs.ndim > 1 and probs.shape[-1] == 1:
+                probs = probs[..., 0]
+            classes = (probs > 0.5).astype(np.int64)
+        return classes if zero_based_label else classes + 1
+
     def get_weights(self):
         est = self.get_estimator()
         return est.get_params()

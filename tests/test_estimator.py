@@ -167,6 +167,32 @@ class TestKerasFacade:
         np.testing.assert_allclose(model.predict(x), preds1, rtol=1e-6)
 
 
+class TestPredictClasses:
+    def test_categorical_and_binary(self, ctx):
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras.layers import Dense
+        x, y = make_regression(n=64)
+        m = Sequential([Dense(8, activation="relu"),
+                        Dense(3, activation="softmax")])
+        m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+        m.fit(x, (np.abs(y[:, 0]) % 3).astype(np.float32), batch_size=32,
+              nb_epoch=1)
+        probs = np.asarray(m.predict(x))
+        cls = m.predict_classes(x)
+        np.testing.assert_array_equal(cls, probs.argmax(-1))
+        one_based = m.predict_classes(x, zero_based_label=False)
+        np.testing.assert_array_equal(one_based, cls + 1)
+
+        mb = Sequential([Dense(4, activation="relu"),
+                         Dense(1, activation="sigmoid")])
+        mb.compile(optimizer="adam", loss="binary_crossentropy")
+        mb.fit(x, (y[:, 0] > 0).astype(np.float32), batch_size=32,
+               nb_epoch=1)
+        cls_b = mb.predict_classes(x)
+        np.testing.assert_array_equal(
+            cls_b, (np.asarray(mb.predict(x))[:, 0] > 0.5).astype(int))
+
+
 class TestMixedPrecision:
     def test_bf16_compute_dtype_trains(self, ctx):
         import jax.numpy as jnp
