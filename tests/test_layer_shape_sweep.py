@@ -64,6 +64,55 @@ CASES = [
     (lambda: L.ThresholdedReLU(), (5,)),
     # attention / crf
     (lambda: L.CRF(5), (6, 5)),
+    # second wave: 1D/3D variants, elementwise, locally connected
+    (lambda: L.AtrousConvolution1D(4, 3, atrous_rate=2,
+                                   border_mode="same"), (10, 3)),
+    (lambda: L.AveragePooling1D(2), (8, 3)),
+    (lambda: L.AveragePooling3D((2, 2, 2)), (4, 4, 4, 2)),
+    (lambda: L.MaxPooling1D(2), (8, 3)),
+    (lambda: L.MaxPooling3D((2, 2, 2)), (4, 4, 4, 2)),
+    (lambda: L.GlobalAveragePooling1D(), (6, 3)),
+    (lambda: L.GlobalAveragePooling3D(), (4, 4, 4, 2)),
+    (lambda: L.GlobalMaxPooling1D(), (6, 3)),
+    (lambda: L.GlobalMaxPooling3D(), (4, 4, 4, 2)),
+    (lambda: L.UpSampling1D(2), (5, 3)),
+    (lambda: L.UpSampling3D((2, 2, 2)), (3, 3, 3, 2)),
+    (lambda: L.ZeroPadding1D(2), (5, 3)),
+    (lambda: L.ZeroPadding3D((1, 1, 1)), (3, 3, 3, 2)),
+    (lambda: L.Cropping1D((1, 1)), (6, 3)),
+    (lambda: L.Cropping3D(((1, 1), (1, 1), (1, 1))), (5, 5, 5, 2)),
+    (lambda: L.LocallyConnected1D(4, 3), (8, 3)),
+    (lambda: L.LocallyConnected2D(4, 3, 3), (6, 6, 2)),
+    (lambda: L.SpatialDropout1D(0.3), (6, 3)),
+    (lambda: L.SpatialDropout3D(0.3), (4, 4, 4, 2)),
+    (lambda: L.GaussianDropout(0.3), (5,)),
+    (lambda: L.SparseDense(6), (9,)),
+    (lambda: L.LRN2D(), (6, 6, 4)),
+    (lambda: L.ResizeBilinear(12, 10), (6, 5, 3)),
+    (lambda: L.ShareConvolution2D(4, 3, 3, border_mode="same"), (6, 6, 2)),
+    (lambda: L.Scale((5,)), (5,)),
+    (lambda: L.CAdd((5,)), (5,)),
+    (lambda: L.CMul((5,)), (5,)),
+    (lambda: L.AddConstant(2.0), (5,)),
+    (lambda: L.MulConstant(2.0), (5,)),
+    (lambda: L.Power(2.0), (5,)),
+    (lambda: L.Negative(), (5,)),
+    (lambda: L.Square(), (5,)),
+    (lambda: L.Sqrt(), (5,)),
+    (lambda: L.Exp(), (5,)),
+    (lambda: L.Identity(), (5,)),
+    (lambda: L.Softmax(), (5,)),
+    (lambda: L.SReLU(), (5,)),
+    (lambda: L.RReLU(), (5,)),
+    (lambda: L.HardTanh(), (5,)),
+    (lambda: L.HardShrink(), (5,)),
+    (lambda: L.SoftShrink(), (5,)),
+    (lambda: L.Threshold(0.5), (5,)),
+    (lambda: L.BinaryThreshold(0.5), (5,)),
+    (lambda: L.ExpandDim(1), (5,)),
+    (lambda: L.Squeeze(1), (1, 5)),
+    (lambda: L.Narrow(1, 1, 3), (6,)),
+    (lambda: L.GetShape(), (4, 3)),
 ]
 
 
