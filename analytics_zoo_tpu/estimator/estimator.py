@@ -345,7 +345,7 @@ class Estimator:
             try:
                 for x, y in feed:
                     step_rng = jax.random.fold_in(self.root_rng, self.global_step)
-                    step_start = time.time()
+                    step_start = time.perf_counter()
                     with time_it("train_step"):
                         (self.params, self.opt_state, self.model_state,
                          loss) = self._train_step(
@@ -373,7 +373,7 @@ class Estimator:
                             # the loss sync just above, which bounds this
                             # step's device work — validation/checkpoint time
                             # between steps is deliberately NOT counted
-                            step_time = time.time() - step_start
+                            step_time = time.perf_counter() - step_start
                             if step_time > 0:
                                 global_batch = (local_batch
                                                 * self.ctx.process_count)

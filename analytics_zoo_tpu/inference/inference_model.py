@@ -267,6 +267,11 @@ class InferenceModel:
         if self._forward is None and aot is None:
             raise RuntimeError("no model loaded")
         is_multi = isinstance(x, (list, tuple))
+        if aot is not None and is_multi != self._aot_multi:
+            want = "a list of inputs" if self._aot_multi else "one array"
+            raise ValueError(
+                f"this AOT artifact was exported for {want}; got "
+                f"{'a list' if is_multi else 'one array'}")
         xs = [np.asarray(a) for a in (x if is_multi else [x])]
         n = xs[0].shape[0]
 

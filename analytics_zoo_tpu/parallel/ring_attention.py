@@ -50,18 +50,24 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         """Attention of the local Q against one visiting K/V shard,
         returned as (normalized partial out, per-row lse) — each hop runs
         the flash kernel (pallas on TPU), and partials merge by lse."""
+        def lse_attend(causal_flag):
+            out, lse = flash_attention_lse(q, kc, vc, causal=causal_flag,
+                                           scale=scale, q_block=q_block,
+                                           kv_block=kv_block)
+            # normalize to v.dtype: the pallas path returns q.dtype, the
+            # blockwise path v.dtype — lax.switch needs identical avals
+            # across branches for mixed-dtype q/v
+            return out.astype(v.dtype), lse
+
         if not causal:
-            return flash_attention_lse(q, kc, vc, causal=False, scale=scale,
-                                       q_block=q_block, kv_block=kv_block)
+            return lse_attend(False)
         src_rank = (my + i) % n  # which shard's K/V we currently hold
 
         def full(_):  # visiting shard is entirely in the past
-            return flash_attention_lse(q, kc, vc, causal=False, scale=scale,
-                                       q_block=q_block, kv_block=kv_block)
+            return lse_attend(False)
 
         def diag(_):  # own shard: standard causal mask
-            return flash_attention_lse(q, kc, vc, causal=True, scale=scale,
-                                       q_block=q_block, kv_block=kv_block)
+            return lse_attend(True)
 
         def skip(_):  # entirely in the future: contributes nothing
             # neutral element derives from q so it stays device-varying
