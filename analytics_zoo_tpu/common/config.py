@@ -15,6 +15,7 @@ import os
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
+from . import file_io
 
 _ENV_PREFIX = "ZOO_TPU_"
 
@@ -62,7 +63,7 @@ class Config:
             self._flags[name] = _Flag(name, default, parser, help)
 
     def load_file(self, path: str) -> None:
-        with open(path) as f:
+        with file_io.fopen(path) as f:
             values = json.load(f)
         with self._lock:
             self._file_values.update(values)

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ..common import file_io
 from ..common.config import global_config
 from ..common.context import get_context
 from ..common.triggers import EveryEpoch, MaxEpoch, TrainingState, Trigger
@@ -588,26 +589,35 @@ class Estimator:
         return tree
 
     def _save_snapshot(self) -> None:
-        path = os.path.join(self._ckpt_dir, f"snapshot-{self.global_step}")
+        path = file_io.join(self._ckpt_dir, f"snapshot-{self.global_step}")
         self.save_checkpoint(path)
 
     def _latest_snapshot(self) -> Optional[str]:
-        if not self._ckpt_dir or not os.path.isdir(self._ckpt_dir):
+        if not self._ckpt_dir or not file_io.isdir(self._ckpt_dir):
             return None
-        snaps = [d for d in os.listdir(self._ckpt_dir) if d.startswith("snapshot-")]
+        snaps = [d for d in file_io.listdir(self._ckpt_dir)
+                 if d.startswith("snapshot-")]
         if not snaps:
             return None
         newest = max(snaps, key=lambda s: int(s.split("-")[1]))
-        return os.path.join(self._ckpt_dir, newest)
+        return file_io.join(self._ckpt_dir, newest)
 
     def save_checkpoint(self, path: str) -> None:
         """Write a snapshot. EVERY process must call this: orbax's save is a
         collective (it barriers across ``jax.process_count()`` processes and
         elects process 0 as the writer) — gating it to rank 0 deadlocks the
-        pod at the barrier."""
+        pod at the barrier. Remote URIs (``gs://...``) are written via a
+        local staging dir (the reference's HDFS-aware save,
+        ``common/Utils.scala:97``)."""
         import orbax.checkpoint as ocp
         ckptr = ocp.PyTreeCheckpointer()
-        ckptr.save(os.path.abspath(path), self._snapshot_tree(), force=True)
+        if file_io.is_remote(path):
+            with file_io.localized(path, "w") as tmp:
+                ckptr.save(os.path.join(tmp, "ckpt"),
+                           self._snapshot_tree(), force=True)
+            return
+        ckptr.save(os.path.abspath(file_io.local_path(path)),
+                   self._snapshot_tree(), force=True)
 
     def load_checkpoint(self, path: str) -> None:
         """Restore a snapshot. Restores are data-only (orbax reads arrays,
@@ -615,9 +625,16 @@ class Estimator:
         reference, ``common/CheckedObjectInputStream.scala:1``, is designed
         away), but the STRUCTURE is still validated before any state is
         touched so a truncated/foreign checkpoint can't half-install."""
+        if file_io.is_remote(path):
+            with file_io.localized(path, "r") as tmp:
+                self._load_checkpoint_local(os.path.join(tmp, "ckpt"))
+            return
+        self._load_checkpoint_local(
+            os.path.abspath(file_io.local_path(path)))
+
+    def _load_checkpoint_local(self, path: str) -> None:
         import orbax.checkpoint as ocp
         ckptr = ocp.PyTreeCheckpointer()
-        path = os.path.abspath(path)
         tree = ckptr.restore(path)
         missing = {"params", "opt_state", "model_state", "meta"} - set(tree)
         if missing:

@@ -17,6 +17,7 @@ import numpy as np
 
 from ..featureset import FeatureSet
 from ..preprocessing import Preprocessing
+from ...common import file_io
 
 _IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
 
@@ -35,28 +36,39 @@ class ImageSet:
     def read(path: str, with_label: bool = False,
              one_based_label: bool = True) -> "LocalImageSet":
         """Read images from ``path`` (a dir of images, or with ``with_label``
-        a dir of class-named subdirs, labels alphabetical)."""
+        a dir of class-named subdirs, labels alphabetical). ``path`` may be a
+        local directory or a ``scheme://`` URI (gs://...) — all reads go
+        through the filesystem layer and decode from bytes."""
         import cv2
+
+        def _load(fpath):
+            with file_io.fopen(fpath, "rb") as f:
+                buf = np.frombuffer(f.read(), np.uint8)
+            return cv2.imdecode(buf, cv2.IMREAD_COLOR)
+
         images, labels, paths = [], [], []
         if with_label:
-            classes = sorted(d for d in os.listdir(path)
-                             if os.path.isdir(os.path.join(path, d)))
+            classes = sorted(d for d in file_io.listdir(path)
+                             if file_io.isdir(file_io.join(path, d)))
             base = 1 if one_based_label else 0
             for ci, cls in enumerate(classes):
-                for f in sorted(glob.glob(os.path.join(path, cls, "*"))):
-                    if not f.lower().endswith(_IMG_EXTS):
+                cdir = file_io.join(path, cls)
+                for name in sorted(file_io.listdir(cdir)):
+                    if not name.lower().endswith(_IMG_EXTS):
                         continue
-                    img = cv2.imread(f)
+                    f = file_io.join(cdir, name)
+                    img = _load(f)
                     if img is None:
                         continue
                     images.append(img)
                     labels.append(ci + base)
                     paths.append(f)
             return LocalImageSet(images, np.asarray(labels, np.float32), paths)
-        for f in sorted(glob.glob(os.path.join(path, "*"))):
-            if not f.lower().endswith(_IMG_EXTS):
+        for name in sorted(file_io.listdir(path)):
+            if not name.lower().endswith(_IMG_EXTS):
                 continue
-            img = cv2.imread(f)
+            f = file_io.join(path, name)
+            img = _load(f)
             if img is not None:
                 images.append(img)
                 paths.append(f)

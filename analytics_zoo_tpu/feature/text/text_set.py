@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..featureset import FeatureSet
+from ...common import file_io
 
 
 @dataclass
@@ -31,7 +32,7 @@ class Relation:
 def read_relations(path: str) -> List[Relation]:
     """CSV ``id1,id2,label`` (with or without header)."""
     rels = []
-    with open(path) as f:
+    with file_io.fopen(path) as f:
         for line in f:
             parts = line.strip().split(",")
             if len(parts) != 3 or parts[2].lower() == "label":
@@ -79,16 +80,16 @@ class TextSet:
         """Read a dir of class-named subdirs of .txt files (reference
         ``TextSet.read``); labels follow alphabetical class order."""
         feats = []
-        classes = sorted(d for d in os.listdir(path)
-                         if os.path.isdir(os.path.join(path, d)))
+        classes = sorted(d for d in file_io.listdir(path)
+                         if file_io.isdir(file_io.join(path, d)))
         base = 1 if one_based_label else 0
         for ci, cls in enumerate(classes):
-            cdir = os.path.join(path, cls)
-            for fname in sorted(os.listdir(cdir)):
-                fpath = os.path.join(cdir, fname)
-                if not os.path.isfile(fpath):
+            cdir = file_io.join(path, cls)
+            for fname in sorted(file_io.listdir(cdir)):
+                fpath = file_io.join(cdir, fname)
+                if file_io.isdir(fpath):
                     continue
-                with open(fpath, errors="ignore") as f:
+                with file_io.fopen(fpath, errors="ignore") as f:
                     feats.append(TextFeature(f.read(), ci + base, uri=fpath))
         return LocalTextSet(feats)
 
@@ -204,12 +205,12 @@ class TextSet:
         return self.word_index
 
     def save_word_index(self, path: str) -> None:
-        with open(path, "w") as f:
-            json.dump(self.word_index, f)
+        with file_io.fopen(path, "w") as f:
+            f.write(json.dumps(self.word_index))
 
     def load_word_index(self, path: str) -> "TextSet":
-        with open(path) as f:
-            self.word_index = json.load(f)
+        with file_io.fopen(path) as f:
+            self.word_index = json.loads(f.read())
         return self
 
     # -- lowering -------------------------------------------------------------

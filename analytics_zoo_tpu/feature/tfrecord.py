@@ -13,12 +13,12 @@ decoder — no tensorflow dependency anywhere.
 from __future__ import annotations
 
 import ctypes
-import os
 import struct
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
 
+from ..common import file_io
 from ..utils.protowire import Field, parse
 from ..utils.tensorboard import frame_record, masked_crc32c
 
@@ -105,11 +105,14 @@ class TFRecordWriter:
         self._handle = None
         self._f = None
         lib = _NativeReader.lib()
-        if lib is not None and hasattr(lib, "ztw_open"):
+        # the native writer is posix-only; scheme URIs (gs://...) stream
+        # through the filesystem layer's python path instead
+        if (lib is not None and hasattr(lib, "ztw_open")
+                and not file_io.is_remote(path)):
             self._lib = lib
-            self._handle = lib.ztw_open(path.encode())
+            self._handle = lib.ztw_open(file_io.local_path(path).encode())
         if self._handle is None:
-            self._f = open(path, "wb")
+            self._f = file_io.fopen(path, "wb")
 
     def write(self, record: bytes) -> None:
         if self._handle is not None:
@@ -262,7 +265,7 @@ class _PythonReader:
 
     def __init__(self, path: str, verify_crc: bool = True):
         self._records: List[bytes] = []
-        with open(path, "rb") as f:
+        with file_io.fopen(path, "rb") as f:
             data = f.read()
         pos, size = 0, len(data)
         while pos + 12 <= size:
@@ -298,9 +301,11 @@ class _PythonReader:
 
 
 def open_tfrecord(path: str, verify_crc: bool = True):
-    """Open a TFRecord file with the native reader, falling back to Python."""
-    if _NativeReader.lib() is not None:
-        return _NativeReader(path, verify_crc)
+    """Open a TFRecord file with the native reader, falling back to Python.
+    Remote URIs (gs://...) always use the Python reader over the filesystem
+    layer — the mmap-based native reader needs a posix file."""
+    if _NativeReader.lib() is not None and not file_io.is_remote(path):
+        return _NativeReader(file_io.local_path(path), verify_crc)
     return _PythonReader(path, verify_crc)
 
 

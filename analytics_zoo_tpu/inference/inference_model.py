@@ -206,13 +206,14 @@ class InferenceModel:
         platform in ``platforms`` so an export made on a CPU host serves on
         TPU."""
         import json
-        import os
 
         import jax.export as jex
 
+        from ..common import file_io
+
         if self._forward is None:
             raise RuntimeError("load a model first")
-        os.makedirs(path, exist_ok=True)
+        file_io.makedirs(path, exist_ok=True)
         multi = isinstance(example, (list, tuple))
         xs = [np.asarray(a) for a in (example if multi else [example])]
         params = self._params
@@ -226,11 +227,13 @@ class InferenceModel:
         for b in sorted(batch_sizes):
             shaped = [np.repeat(a[:1], b, axis=0) for a in xs]
             exp = jex.export(frozen, platforms=tuple(platforms))(*shaped)
-            with open(os.path.join(path, f"batch-{b}.stablehlo"), "wb") as f:
+            with file_io.fopen(file_io.join(path, f"batch-{b}.stablehlo"),
+                               "wb") as f:
                 f.write(exp.serialize())
-        with open(os.path.join(path, "aot_meta.json"), "w") as f:
-            json.dump({"batch_sizes": sorted(batch_sizes), "multi": multi,
-                       "platforms": list(platforms)}, f)
+        with file_io.fopen(file_io.join(path, "aot_meta.json"), "w") as f:
+            f.write(json.dumps({"batch_sizes": sorted(batch_sizes),
+                                "multi": multi,
+                                "platforms": list(platforms)}))
         return self
 
     def load_compiled(self, path: str) -> "InferenceModel":
@@ -238,15 +241,17 @@ class InferenceModel:
         then runs the pre-compiled programs (pad to the bucket, trim) with
         zero JIT compiles at serve time."""
         import json
-        import os
 
         import jax.export as jex
 
-        with open(os.path.join(path, "aot_meta.json")) as f:
-            meta = json.load(f)
+        from ..common import file_io
+
+        with file_io.fopen(file_io.join(path, "aot_meta.json")) as f:
+            meta = json.loads(f.read())
         arts = {}
         for b in meta["batch_sizes"]:
-            with open(os.path.join(path, f"batch-{b}.stablehlo"), "rb") as f:
+            with file_io.fopen(file_io.join(path, f"batch-{b}.stablehlo"),
+                               "rb") as f:
                 arts[b] = jex.deserialize(f.read())
         self._aot = arts
         self._aot_multi = bool(meta["multi"])
@@ -254,7 +259,8 @@ class InferenceModel:
 
     # -- predict (doPredict) --------------------------------------------------
 
-    def predict(self, x, batch_size: Optional[int] = None):
+    def predict(self, x, batch_size: Optional[int] = None, *,
+                _fetch: bool = True):
         """Borrow a pool slot, pad to the shape bucket, run, trim.
         ``batch_size`` splits oversized inputs into chunks (each bucketed).
         With a :meth:`load_compiled` artifact, the pre-compiled program for
@@ -262,7 +268,8 @@ class InferenceModel:
         contract."""
         if self._host_predict is not None:
             with self._slots:
-                return self._host_predict(x)
+                res = self._host_predict(x)
+                return res if _fetch else (lambda: res)
         aot = getattr(self, "_aot", None)
         if self._forward is None and aot is None:
             raise RuntimeError("no model loaded")
@@ -287,13 +294,15 @@ class InferenceModel:
                 else xs[0][i:i + limit], batch_size=limit)
                 for i in range(0, n, limit)]
             if isinstance(chunks[0], (list, tuple)):
-                return type(chunks[0])(
+                out = type(chunks[0])(
                     np.concatenate([c[i] for c in chunks])
                     for i in range(len(chunks[0])))
-            if isinstance(chunks[0], dict):
-                return {k: np.concatenate([c[k] for c in chunks])
-                        for k in chunks[0]}
-            return np.concatenate(chunks)
+            elif isinstance(chunks[0], dict):
+                out = {k: np.concatenate([c[k] for c in chunks])
+                       for k in chunks[0]}
+            else:
+                out = np.concatenate(chunks)
+            return out if _fetch else (lambda: out)
 
         if aot is not None:
             # smallest exported bucket that fits; empty batches still run
@@ -312,12 +321,22 @@ class InferenceModel:
                 y = aot[bucket].call(*args)
             else:
                 y = self._jit(self._params, args if is_multi else args[0])
-        trim = lambda t: np.asarray(t)[:n]
-        if isinstance(y, dict):
-            return {k: trim(v) for k, v in y.items()}
-        if isinstance(y, (list, tuple)):
-            return type(y)(trim(t) for t in y)
-        return trim(y)
+        def fetch():
+            trim = lambda t: np.asarray(t)[:n]
+            if isinstance(y, dict):
+                return {k: trim(v) for k, v in y.items()}
+            if isinstance(y, (list, tuple)):
+                return type(y)(trim(t) for t in y)
+            return trim(y)
+
+        return fetch() if _fetch else fetch
+
+    def predict_async(self, x, batch_size: Optional[int] = None):
+        """Dispatch a predict WITHOUT blocking on the device→host fetch.
+        Returns a zero-argument callable producing :meth:`predict`'s result;
+        the device computes while the caller overlaps other work (the
+        serving pipeline decodes batch N+1 during batch N's flight)."""
+        return self.predict(x, batch_size, _fetch=False)
 
     def predict_many(self, batches: Sequence) -> List:
         """Concurrent batch predicts through the pool (thread fan-out)."""

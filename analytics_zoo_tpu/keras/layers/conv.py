@@ -26,12 +26,28 @@ def _pair(v) -> Tuple[int, int]:
     return (v, v)
 
 
-def _conv_out(size, k, stride, padding):
+def _padding_of(border_mode):
+    """``border_mode`` → lax padding: "same"/"valid", or an explicit int /
+    (ph, pw) pair of symmetric pads (extension beyond keras-1 — needed for
+    bit-exact torch-geometry imports, where stride-2 convs pad both sides
+    while SAME pads asymmetrically)."""
+    if border_mode == "same":
+        return "SAME"
+    if border_mode == "valid":
+        return "VALID"
+    ph, pw = _pair(border_mode)
+    return ((int(ph), int(ph)), (int(pw), int(pw)))
+
+
+def _conv_out(size, k, stride, padding, axis=0):
     if size is None:
         return None
     if padding == "SAME":
         return -(-size // stride)
-    return (size - k) // stride + 1
+    if padding == "VALID":
+        return (size - k) // stride + 1
+    lo, hi = padding[axis]
+    return (size + lo + hi - k) // stride + 1
 
 
 class Convolution2D(Layer):
@@ -44,7 +60,7 @@ class Convolution2D(Layer):
         self.filters = nb_filter
         self.kernel_size = (nb_row, nb_col)
         self.strides = _pair(subsample)
-        self.padding = "SAME" if border_mode == "same" else "VALID"
+        self.padding = _padding_of(border_mode)
         self.activation = get_activation(activation)
         self.init = initializers.get(init)
         self.use_bias = bias
@@ -73,8 +89,8 @@ class Convolution2D(Layer):
         n, h, w, _ = input_shape
         kh, kw = self.kernel_size
         sh, sw = self.strides
-        return (n, _conv_out(h, kh, sh, self.padding),
-                _conv_out(w, kw, sw, self.padding), self.filters)
+        return (n, _conv_out(h, kh, sh, self.padding, 0),
+                _conv_out(w, kw, sw, self.padding, 1), self.filters)
 
 
 Conv2D = Convolution2D
@@ -125,20 +141,23 @@ class _Pool2D(Layer):
         super().__init__(name)
         self.pool_size = _pair(pool_size)
         self.strides = _pair(strides) if strides is not None else self.pool_size
-        self.padding = "SAME" if border_mode == "same" else "VALID"
+        self.padding = _padding_of(border_mode)
 
     def compute_output_shape(self, input_shape):
         n, h, w, c = input_shape
         ph, pw = self.pool_size
         sh, sw = self.strides
-        return (n, _conv_out(h, ph, sh, self.padding),
-                _conv_out(w, pw, sw, self.padding), c)
+        return (n, _conv_out(h, ph, sh, self.padding, 0),
+                _conv_out(w, pw, sw, self.padding, 1), c)
 
     def _reduce(self, inputs, init, op):
         ph, pw = self.pool_size
         sh, sw = self.strides
+        padding = self.padding
+        if not isinstance(padding, str):
+            padding = ((0, 0), padding[0], padding[1], (0, 0))
         return lax.reduce_window(inputs, init, op, (1, ph, pw, 1),
-                                 (1, sh, sw, 1), self.padding)
+                                 (1, sh, sw, 1), padding)
 
 
 class MaxPooling2D(_Pool2D):

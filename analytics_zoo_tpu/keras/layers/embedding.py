@@ -18,6 +18,7 @@ import numpy as np
 
 from .. import initializers
 from ..engine import Layer
+from ...common import file_io
 
 
 class Embedding(Layer):
@@ -77,7 +78,7 @@ class WordEmbedding(Embedding):
         """
         vectors = {}
         dim = None
-        with open(path, "r", encoding="utf-8") as f:
+        with file_io.fopen(path, "r", encoding="utf-8") as f:
             for line in f:
                 parts = line.rstrip().split(" ")
                 if len(parts) < 3:

@@ -9,3 +9,5 @@ from .textmatching import KNRM  # noqa: F401
 from .seq2seq import Seq2seq  # noqa: F401
 from .textmodels import (  # noqa: F401
     IntentEntity, NER, POSTagger, SequenceTagger)
+from .image.imageclassification import ImageClassifier  # noqa: F401
+from .image.objectdetection import ObjectDetector  # noqa: F401

@@ -13,10 +13,11 @@ over (user, item) pair arrays — numpy in place of RDDs.
 from __future__ import annotations
 
 import json
-import os
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ..common import file_io
 
 _MODEL_REGISTRY: Dict[str, type] = {}
 
@@ -61,16 +62,19 @@ class ZooModel:
     # -- persistence (ZooModel.saveModel / loadModel) -------------------------
 
     def save_model(self, path: str) -> None:
-        os.makedirs(path, exist_ok=True)
+        """Accepts local paths or ``scheme://`` URIs (gs:// etc. — the
+        reference saves models through its HDFS-aware filesystem layer,
+        ``common/Utils.scala:97``)."""
+        file_io.makedirs(path, exist_ok=True)
         config = {"class": type(self).__name__, "config": self.get_config()}
-        with open(os.path.join(path, "zoo_model.json"), "w") as f:
-            json.dump(config, f, indent=2)
-        self._ensure_built().save_model(os.path.join(path, "weights"))
+        with file_io.fopen(file_io.join(path, "zoo_model.json"), "w") as f:
+            f.write(json.dumps(config, indent=2))
+        self._ensure_built().save_model(file_io.join(path, "weights"))
 
     @staticmethod
     def load_model(path: str) -> "ZooModel":
-        with open(os.path.join(path, "zoo_model.json")) as f:
-            spec = json.load(f)
+        with file_io.fopen(file_io.join(path, "zoo_model.json")) as f:
+            spec = json.loads(f.read())
         cls = _MODEL_REGISTRY.get(spec["class"])
         if cls is None:
             raise ValueError(f"unknown zoo model class {spec['class']}; "
@@ -80,7 +84,7 @@ class ZooModel:
         # models must be compiled before weights load to own an estimator
         if not hasattr(inst.model, "loss_fn"):
             inst.default_compile()
-        inst.model.load_weights(os.path.join(path, "weights"))
+        inst.model.load_weights(file_io.join(path, "weights"))
         return inst
 
     def default_compile(self):

@@ -43,29 +43,31 @@ def _input_preprocess(x, mode: Optional[str]):
     raise ValueError(f"unknown preprocess mode {mode!r}")
 
 
-def _conv_bn(x, filters, k, stride=1, activation="relu", name=""):
+def _conv_bn(x, filters, k, stride=1, activation="relu", name="",
+             border_mode="same"):
     x = Convolution2D(filters, k, k, subsample=(stride, stride),
-                      border_mode="same", bias=False, name=f"{name}_conv")(x)
+                      border_mode=border_mode, bias=False,
+                      name=f"{name}_conv")(x)
     x = BatchNormalization(name=f"{name}_bn")(x)
     if activation:
         x = Activation(activation, name=f"{name}_act")(x)
     return x
 
 
-def _basic_block(x, filters, stride, name):
+def _basic_block(x, filters, stride, name, pad3="same"):
     shortcut = x
-    y = _conv_bn(x, filters, 3, stride, "relu", f"{name}_a")
-    y = _conv_bn(y, filters, 3, 1, None, f"{name}_b")
+    y = _conv_bn(x, filters, 3, stride, "relu", f"{name}_a", pad3)
+    y = _conv_bn(y, filters, 3, 1, None, f"{name}_b", pad3)
     if stride != 1 or x.shape[-1] != filters:
         shortcut = _conv_bn(x, filters, 1, stride, None, f"{name}_sc")
     return Activation("relu", name=f"{name}_out")(
         merge([y, shortcut], mode="sum"))
 
 
-def _bottleneck_block(x, filters, stride, name):
+def _bottleneck_block(x, filters, stride, name, pad3="same"):
     shortcut = x
     y = _conv_bn(x, filters, 1, 1, "relu", f"{name}_a")
-    y = _conv_bn(y, filters, 3, stride, "relu", f"{name}_b")
+    y = _conv_bn(y, filters, 3, stride, "relu", f"{name}_b", pad3)
     y = _conv_bn(y, filters * 4, 1, 1, None, f"{name}_c")
     if stride != 1 or x.shape[-1] != filters * 4:
         shortcut = _conv_bn(x, filters * 4, 1, stride, None, f"{name}_sc")
@@ -76,23 +78,34 @@ def _bottleneck_block(x, filters, stride, name):
 def resnet(depth: int = 50, num_classes: int = 1000,
            input_shape: Tuple[int, int, int] = (224, 224, 3),
            include_top: bool = True,
-           preprocess: Optional[str] = None) -> Model:
-    """ResNet-v1 (18/34/50/101/152)."""
+           preprocess: Optional[str] = None,
+           padding_mode: str = "same") -> Model:
+    """ResNet-v1 (18/34/50/101/152).
+
+    ``padding_mode="torch"`` reproduces torch geometry exactly (symmetric
+    explicit pads on the stride-2 convs and the stem pool, where SAME pads
+    asymmetrically) so imported torchvision weights are bit-faithful — the
+    golden-import test depends on it.
+    """
     if depth not in _RESNET_BLOCKS:
         raise ValueError(f"unsupported depth {depth}; have "
                          f"{sorted(_RESNET_BLOCKS)}")
+    torch_geo = padding_mode == "torch"
     blocks = _RESNET_BLOCKS[depth]
     block_fn = _basic_block if depth < 50 else _bottleneck_block
+    pad3 = 1 if torch_geo else "same"
     inp = Input(input_shape, name="image")
     x = _input_preprocess(inp, preprocess)
-    x = _conv_bn(x, 64, 7, 2, "relu", "stem")
-    x = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same",
+    x = _conv_bn(x, 64, 7, 2, "relu", "stem", 3 if torch_geo else "same")
+    x = MaxPooling2D((3, 3), strides=(2, 2),
+                     border_mode=1 if torch_geo else "same",
                      name="stem_pool")(x)
     filters = 64
     for stage, n in enumerate(blocks):
         for i in range(n):
             stride = 2 if (i == 0 and stage > 0) else 1
-            x = block_fn(x, filters, stride, f"stage{stage + 1}_block{i + 1}")
+            x = block_fn(x, filters, stride,
+                         f"stage{stage + 1}_block{i + 1}", pad3)
         filters *= 2
     if not include_top:
         return Model(inp, x, name=f"resnet{depth}_features")
@@ -314,7 +327,8 @@ class ImageClassifier(ZooModel):
 
     def __init__(self, model_name: str = "resnet50", num_classes: int = 1000,
                  input_shape: Sequence[int] = (224, 224, 3),
-                 labels: Optional[List[str]] = None):
+                 labels: Optional[List[str]] = None,
+                 padding_mode: str = "same"):
         super().__init__()
         if model_name not in _BACKBONES:
             raise ValueError(f"unknown model_name {model_name}; have "
@@ -323,14 +337,76 @@ class ImageClassifier(ZooModel):
         self.num_classes = num_classes
         self.input_shape = tuple(input_shape)
         self.labels = labels
+        self.padding_mode = padding_mode
+
+    @staticmethod
+    def load_label_map(path: str) -> List[str]:
+        """Load a class-index→name map (the reference ships label maps with
+        each pretrained artifact, ``ImageClassificationConfig.scala``).
+        Accepts a JSON list ``["tench", ...]``, a JSON dict keyed by index
+        (zero- OR one-based, both published formats exist), or plain text
+        with one label per line; local path or scheme URI."""
+        import json
+
+        from ...common import file_io
+        with file_io.fopen(path) as f:
+            text = f.read()
+        try:
+            data = json.loads(text)
+        except ValueError:
+            return [line.strip() for line in text.splitlines() if line.strip()]
+        if isinstance(data, dict):
+            base = 0 if "0" in data else 1 if "1" in data else None
+            if base is None or not all(
+                    str(i + base) in data for i in range(len(data))):
+                raise ValueError(
+                    f"label map dict at {path} is not contiguously indexed "
+                    f"from 0 or 1 (got keys like {sorted(data)[:3]}...)")
+            return [data[str(i + base)] for i in range(len(data))]
+        return list(data)
+
+    def with_label_map(self, path: str) -> "ImageClassifier":
+        self.labels = self.load_label_map(path)
+        return self
+
+    def load_pretrained_torch(self, module_or_path,
+                              padding_mode: str = "torch"
+                              ) -> "ImageClassifier":
+        """Import pretrained torch weights (e.g. a torchvision state_dict)
+        into this classifier's backbone — golden-validated by
+        ``tests/test_torch_golden.py`` (logits match torch within 1e-4)."""
+        from ...net.torch_import import load_torch
+        if self.model_name.startswith("resnet") and padding_mode == "torch":
+            # record the geometry so save_model/load_model round-trips
+            # rebuild the SAME network (bit-faithfulness survives reload)
+            self.padding_mode = "torch"
+            depth = int(self.model_name[len("resnet"):])
+            self.model = resnet(depth, self.num_classes, self.input_shape,
+                                padding_mode="torch")
+        model = self._ensure_built()
+        params, state = load_torch(model, module_or_path)
+        if not hasattr(model, "loss_fn"):
+            self.default_compile()
+        est = model.get_estimator()
+        est.set_params(params)
+        est.set_model_state(state)
+        return self
 
     def get_config(self) -> Dict[str, Any]:
         return {"model_name": self.model_name,
                 "num_classes": self.num_classes,
                 "input_shape": list(self.input_shape),
-                "labels": self.labels}
+                "labels": self.labels,
+                "padding_mode": self.padding_mode}
 
     def build_model(self) -> Model:
+        if self.model_name.startswith("resnet"):
+            # padding geometry is part of the persisted config so a
+            # torch-imported model round-trips save_model/load_model
+            # without silently changing its stride-2 pads
+            return resnet(int(self.model_name[len("resnet"):]),
+                          self.num_classes, self.input_shape,
+                          padding_mode=self.padding_mode)
         return _BACKBONES[self.model_name](self.num_classes, self.input_shape)
 
     def default_compile(self):

@@ -53,15 +53,36 @@ class ColumnFeatureInfo:
         return list(self.wide_base_cols) + list(self.wide_cross_cols)
 
 
+def _crc32_codes(col) -> np.ndarray:
+    """Per-value ``crc32(str(v))`` vectorized through the column's uniques:
+    categorical columns repeat values heavily, so hashing each UNIQUE once
+    and gathering by inverse index does ~cardinality hashes instead of ~rows
+    (50-500x on Criteo-scale columns) while producing bit-identical buckets
+    to the per-value loop."""
+    import zlib
+    try:
+        import pandas as pd
+        # hash-based factorize: O(rows), no sort — np.unique on a string
+        # column sorts and ends up slower than the loop it replaces.
+        # use_na_sentinel=False keeps NaN IN the uniques (code >= 0) so it
+        # hashes as crc32("nan") like every other value; the default -1
+        # sentinel would silently gather the LAST unique's hash instead
+        inv, uniq = pd.factorize(np.asarray(col), use_na_sentinel=False)
+        uniq = np.asarray(uniq)
+    except ImportError:
+        uniq, inv = np.unique(np.asarray(col), return_inverse=True)
+    table = np.fromiter((zlib.crc32(str(v).encode()) for v in uniq),
+                        dtype=np.int64, count=len(uniq))
+    return table[inv]
+
+
 def cross_columns(df, cols: Sequence[str], bucket_size: int) -> np.ndarray:
     """Hash-cross of categorical columns into ``bucket_size`` buckets
     (reference ``Utils.buckBucket``). Uses crc32, stable across processes —
     train-time and serve-time features must land in the same bucket."""
-    import zlib
     acc = np.zeros(len(df), dtype=np.int64)
     for c in cols:
-        acc = acc * 1000003 + np.asarray(
-            [zlib.crc32(str(v).encode()) for v in df[c]], dtype=np.int64)
+        acc = acc * 1000003 + _crc32_codes(df[c])
     return np.abs(acc) % bucket_size
 
 

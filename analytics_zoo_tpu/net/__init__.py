@@ -10,6 +10,7 @@
 from .caffe_loader import load_caffe  # noqa: F401
 from .onnx_loader import OnnxLoaderError, load_onnx  # noqa: F401
 from .torch_import import load_torch, load_torch_state_dict  # noqa: F401
+from ..common import file_io
 
 
 class Net:
@@ -21,7 +22,7 @@ class Net:
         ``model.save_model`` (our native checkpoint format)."""
         import os
         from ..models.common import ZooModel
-        if os.path.exists(os.path.join(path, "zoo_model.json")):
+        if file_io.exists(file_io.join(path, "zoo_model.json")):
             return ZooModel.load_model(path)
         raise ValueError(
             f"{path} is not a saved zoo model; for raw estimator "

@@ -24,6 +24,7 @@ import numpy as np
 
 from ..utils.protowire import Field, parse
 from .onnx_loader import _GraphBuilder, OnnxLoaderError, _Value
+from ..common import file_io
 
 # --------------------------------------------------------------------------
 # prototxt (text protobuf) parsing
@@ -148,7 +149,7 @@ def _blob_array(blob: Dict[str, Any]) -> np.ndarray:
 
 def load_caffemodel_weights(path: str) -> Dict[str, List[np.ndarray]]:
     """.caffemodel → {layer_name: [blob arrays]}."""
-    with open(path, "rb") as f:
+    with file_io.fopen(path, "rb") as f:
         net = parse(f.read(), _NET)
     out: Dict[str, List[np.ndarray]] = {}
     for layer in net.get("layer", []):
@@ -459,7 +460,7 @@ def load_caffe(prototxt_path: str, caffemodel_path: Optional[str] = None,
     the same NCHW→NHWC conversion (pass NHWC images at call time).
     ``input_shape`` = (C, H, W) overrides/supplies the input declaration.
     """
-    with open(prototxt_path) as f:
+    with file_io.fopen(prototxt_path) as f:
         net = parse_prototxt(f.read())
     weights = (load_caffemodel_weights(caffemodel_path)
                if caffemodel_path else None)

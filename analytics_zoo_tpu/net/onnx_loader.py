@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from . import onnx_wire as wire
+from ..common import file_io
 
 
 class _Value:
@@ -892,7 +893,7 @@ def load_onnx(path_or_bytes, dtype=np.float32):
     if isinstance(path_or_bytes, (bytes, bytearray)):
         data = bytes(path_or_bytes)
     else:
-        with open(path_or_bytes, "rb") as f:
+        with file_io.fopen(path_or_bytes, "rb") as f:
             data = f.read()
     proto = wire.load_model(data)
     graph = proto.get("graph")

@@ -30,9 +30,19 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# v5e-tuned (scripts/sweep_flash_blocks.py, seq 4096 fwd+bwd train step,
+# dispatch-cancelled differenced timing): 512/1024 is the consistent best
+# across sweeps; q blocks >= 2048 overflow VMEM/registers in the exp2
+# kernels. Shorter or indivisible sequences clamp via _largest_divisor_leq.
 DEFAULT_Q_BLOCK = 512
-DEFAULT_KV_BLOCK = 512
+DEFAULT_KV_BLOCK = 1024
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() grads finite
+_LOG2E = 1.4426950408889634  # the pallas kernels run softmax in exp2 space:
+# scale*log2(e) folds into q OUTSIDE the kernel, turning the per-element
+# `s*scale` multiply + `exp` into a bare `exp2` — at head_dim 64 the kernels
+# are VPU-bound (softmax ops per element rival the 2·64 MXU flops), so every
+# elementwise op removed is direct wall-clock
+_LN2 = 1.0 / _LOG2E
 
 
 def _largest_divisor_leq(n: int, cap: int) -> int:
@@ -197,23 +207,25 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale: float, causal: bool,
     def _step():
         # inputs stay in their storage dtype (bf16 on the fast path): the
         # MXU natively multiplies bf16 with f32 accumulation — upcasting
-        # first would force 8x-slower f32 matmul passes
+        # first would force 8x-slower f32 matmul passes. q arrives
+        # pre-multiplied by scale*log2(e), so s/m/l live in exp2 space.
         q = q_ref[0]
         k = k_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            preferred_element_type=jnp.float32)  # [bq, bk], exp2 domain
         if bias_ref is not None:
-            # per-key additive bias (padding mask), broadcast over query rows
-            s = s + bias_ref[0].astype(jnp.float32)  # [1, bk] broadcasts
+            # per-key additive bias (padding mask), broadcast over query
+            # rows; the bias is natural-log units → exp2 domain
+            s = s + bias_ref[0].astype(jnp.float32) * _LOG2E  # [1, bk]
         if causal:
             rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
         m_prev = m_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp2(s - m_new)
+        corr = jnp.exp2(m_prev - m_new)
         l_ref[:, :1] = l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
         m_ref[:, :1] = m_new
         acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
@@ -225,8 +237,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale: float, causal: bool,
         o_ref[0] = (acc_ref[:] /
                     jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
         if lse_ref is not None:
-            # per-row logsumexp residual for the backward kernels
-            lse_ref[0, 0, :] = (m_ref[:, 0]
+            # per-row logsumexp residual for the backward kernels, converted
+            # back to natural-log units (the ring-merge contract)
+            lse_ref[0, 0, :] = (m_ref[:, 0] * _LN2
                                 + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30)))
 
 
@@ -277,7 +290,10 @@ def _flash_fwd_pallas(q, k, v, scale: float, causal: bool,
         # (8, 128) tiling rules with a unit sublane
         key_bias = key_bias.reshape(b, 1, kv_len)
     bh = b * h
-    qf = q.reshape(bh, q_len, d)
+    # scale*log2e folds into q here — XLA fuses it into the preceding
+    # producer, and the kernel's softmax runs in exp2 space with no
+    # per-element multiplies
+    qf = (q * (scale * _LOG2E)).astype(q.dtype).reshape(bh, q_len, d)
     kf = k.reshape(bh, kv_len, d)
     vf = v.reshape(bh, kv_len, d)
 
@@ -321,6 +337,11 @@ def _flash_fwd_pallas(q, k, v, scale: float, causal: bool,
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
+        # batch·head and query blocks are independent; only the kv axis
+        # carries the streaming-softmax accumulator — telling Mosaic lets it
+        # overlap DMA and compute across the parallel axes
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(*operands)
     if return_lse:
         o, lse = out
@@ -333,8 +354,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
                          causal: bool, bq: int, bk: int):
     """dq = Σ_k ds @ K with ds = p * (dO V^T − D + glse), where glse is the
     cotangent of the lse output (zero when only the attention output is
-    used). p = exp(qk·scale − lse). Grid (bh, n_q, n_kv); accumulates over
-    the innermost kv axis."""
+    used). q arrives pre-scaled by scale*log2e so p = exp2(qk − lse·log2e)
+    with no per-element multiplies; the deferred ds·scale lands on the
+    [bq, d] result at finalize. Grid (bh, n_q, n_kv); accumulates over the
+    innermost kv axis."""
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -355,24 +378,24 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         k = k_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            preferred_element_type=jnp.float32)  # [bq, bk], exp2 domain
         if causal:
             rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0, 0][:, None])  # [bq, bk]
+        p = jnp.exp2(s - lse_ref[0, 0][:, None] * _LOG2E)  # [bq, bk]
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bq, bk]
         ds = p * (dp - dd_ref[0, 0][:, None]
-                  + gl_ref[0, 0][:, None]) * scale
+                  + gl_ref[0, 0][:, None])  # scale deferred to finalize
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == n_kv - 1)
     def _finalize():
-        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+        dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
@@ -397,17 +420,17 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0]
+        q = q_ref[0]  # pre-scaled by scale*log2e
         k = k_ref[0]
         do = do_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            preferred_element_type=jnp.float32)  # [bq, bk], exp2 domain
         if causal:
             rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0, 0][:, None])  # [bq, bk]
+        p = jnp.exp2(s - lse_ref[0, 0][:, None] * _LOG2E)  # [bq, bk]
         pt = p.astype(do.dtype)
         dv_acc[:] += jax.lax.dot_general(
             pt, do, (((0,), (0,)), ((), ())),
@@ -415,15 +438,18 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         dp = jax.lax.dot_general(
             do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bq, bk]
+        # ds against the PRE-SCALED q accumulates scale*log2e·(true dk); one
+        # ln2 multiply on the [bk, d] result at finalize undoes the log2e
+        # (the caller's q carried the scale, so dk keeps the bare `scale`)
         ds = (p * (dp - dd_ref[0, 0][:, None]
-                   + gl_ref[0, 0][:, None]) * scale).astype(q.dtype)
+                   + gl_ref[0, 0][:, None])).astype(q.dtype)
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bk, d]
 
     @pl.when(qi == n_q - 1)
     def _finalize():
-        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dk_ref[0] = (dk_acc[:] * _LN2).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
@@ -440,7 +466,9 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
     bq = _largest_divisor_leq(q_len, q_block)
     bk = _largest_divisor_leq(kv_len, kv_block)
     bh = b * h
-    qf = q.reshape(bh, q_len, d)
+    # pre-scale q by scale*log2e (see _flash_fwd_pallas): the kernels run
+    # exp2-space softmax; dq multiplies back `scale`, dk multiplies `ln2`
+    qf = (q * (scale * _LOG2E)).astype(q.dtype).reshape(bh, q_len, d)
     kf = k.reshape(bh, kv_len, d)
     vf = v.reshape(bh, kv_len, d)
     dof = g.reshape(bh, q_len, d).astype(q.dtype)
@@ -467,6 +495,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
                   row_spec],
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(qf, kf, vf, dof, lse, dd, gl)
 
     # second pass swaps the roles of the two block axes
@@ -487,6 +517,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
         out_specs=(kv_spec2, kv_spec2),
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(qf, kf, vf, dof, lse, dd, gl)
     return (dq.reshape(b, h, q_len, d), dk.reshape(b, h, kv_len, d),
             dv.reshape(b, h, kv_len, d))

@@ -76,8 +76,11 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
                 return lax.pvary(a, axis_name)  # older spelling
             except AttributeError:  # oldest: multiply by a varying zero
                 return a + jnp.zeros((), a.dtype) * lax.axis_index(axis_name)
-    buf0 = _vary(jnp.zeros_like(micro[0]))
-    out_acc0 = _vary(jnp.zeros((m,) + micro[0].shape, micro[0].dtype))
+    # derive the initial carry from the INPUT (times zero) so it inherits
+    # x's varying axes too — under a combined mesh (dp x pp) x is
+    # data-varying, and a carry missing that axis fails scan's vma check
+    buf0 = _vary(micro[0] * 0)
+    out_acc0 = _vary(micro * 0)
     perm = [(i, (i + 1) % p) for i in range(p)]
 
     def tick(carry, t):

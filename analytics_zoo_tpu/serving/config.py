@@ -22,6 +22,7 @@ class ServingConfig:
     batch_wait_ms: int = 20  # micro-batch window
     max_pending: int = 10000  # backpressure trim threshold
     concurrent_num: int = 1
+    decode_threads: int = 4  # host threads decoding while the device runs
     quantize: Optional[str] = None  # bf16 | int8
     log_dir: Optional[str] = None  # TensorBoard serving summaries
 
@@ -57,6 +58,8 @@ class ServingConfig:
         cfg.max_pending = int(params.get("max_pending", cfg.max_pending))
         cfg.concurrent_num = int(params.get("concurrent_num",
                                             cfg.concurrent_num))
+        cfg.decode_threads = int(params.get("decode_threads",
+                                            cfg.decode_threads))
         cfg.quantize = params.get("quantize", cfg.quantize)
         cfg.log_dir = raw.get("log_dir", cfg.log_dir)
         return cfg

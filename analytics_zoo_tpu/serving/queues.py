@@ -8,6 +8,9 @@ Here the backend is pluggable:
 - :class:`FileQueue` (default): a spool directory with atomic renames —
   zero extra dependencies, works single-host and on a shared filesystem
   across hosts (requests claimed by rename, results as per-uri JSON files).
+  The spool root may be a ``scheme://`` URI (e.g. ``gs://bucket/q``) via the
+  filesystem layer; remote renames are not atomic, so remote spools support
+  many producers but a SINGLE serving consumer.
 - :class:`RedisQueue`: the reference's wire contract (stream + hash), gated
   on the ``redis`` package being installed.
 """
@@ -18,6 +21,8 @@ import hashlib
 import json
 import os
 import time
+
+from ..common import file_io
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -50,37 +55,37 @@ class QueueBackend:
 class FileQueue(QueueBackend):
     def __init__(self, root: str):
         self.root = root
-        self.req_dir = os.path.join(root, "requests")
-        self.claim_dir = os.path.join(root, "claimed")
-        self.res_dir = os.path.join(root, "results")
+        self.req_dir = file_io.join(root, "requests")
+        self.claim_dir = file_io.join(root, "claimed")
+        self.res_dir = file_io.join(root, "results")
         for d in (self.req_dir, self.claim_dir, self.res_dir):
-            os.makedirs(d, exist_ok=True)
+            file_io.makedirs(d, exist_ok=True)
 
     def enqueue(self, uri: str, payload: Dict[str, Any]) -> None:
         name = f"{time.time_ns():020d}-{uuid.uuid4().hex[:8]}.json"
-        tmp = os.path.join(self.req_dir, "." + name)
-        with open(tmp, "w") as f:
-            json.dump({"uri": uri, **payload}, f)
-        os.replace(tmp, os.path.join(self.req_dir, name))  # atomic publish
+        tmp = file_io.join(self.req_dir, "." + name)
+        with file_io.fopen(tmp, "w") as f:
+            f.write(json.dumps({"uri": uri, **payload}))
+        file_io.replace(tmp, file_io.join(self.req_dir, name))  # atomic publish
 
     def claim_batch(self, max_items: int) -> List[Tuple[str, Dict[str, Any]]]:
         out = []
         try:
-            names = sorted(os.listdir(self.req_dir))
+            names = sorted(file_io.listdir(self.req_dir))
         except FileNotFoundError:
             return out
         for name in names:
             if name.startswith(".") or len(out) >= max_items:
                 continue
-            src = os.path.join(self.req_dir, name)
-            dst = os.path.join(self.claim_dir, name)
+            src = file_io.join(self.req_dir, name)
+            dst = file_io.join(self.claim_dir, name)
             try:
-                os.replace(src, dst)  # atomic claim; loser raises
-            except OSError:
+                file_io.replace(src, dst)  # atomic claim; loser raises
+            except (OSError, FileNotFoundError):
                 continue
             try:
-                with open(dst) as f:
-                    rec = json.load(f)
+                with file_io.fopen(dst) as f:
+                    rec = json.loads(f.read())
                 out.append((rec["uri"], rec))
             except (ValueError, KeyError, OSError):
                 # malformed request file (partial write / foreign producer):
@@ -90,50 +95,50 @@ class FileQueue(QueueBackend):
                     "dropping malformed request file %s", name)
             finally:
                 try:
-                    os.remove(dst)
+                    file_io.remove(dst)
                 except OSError:
                     pass
         return out
 
     def put_result(self, uri: str, value: Dict[str, Any]) -> None:
         key = hashlib.md5(uri.encode()).hexdigest()
-        tmp = os.path.join(self.res_dir, "." + key)
-        with open(tmp, "w") as f:
-            json.dump({"uri": uri, **value}, f)
-        os.replace(tmp, os.path.join(self.res_dir, key + ".json"))
+        tmp = file_io.join(self.res_dir, "." + key)
+        with file_io.fopen(tmp, "w") as f:
+            f.write(json.dumps({"uri": uri, **value}))
+        file_io.replace(tmp, file_io.join(self.res_dir, key + ".json"))
 
     def get_result(self, uri: str) -> Optional[Dict[str, Any]]:
         key = hashlib.md5(uri.encode()).hexdigest()
-        path = os.path.join(self.res_dir, key + ".json")
-        if not os.path.exists(path):
+        path = file_io.join(self.res_dir, key + ".json")
+        if not file_io.exists(path):
             return None
-        with open(path) as f:
-            return json.load(f)
+        with file_io.fopen(path) as f:
+            return json.loads(f.read())
 
     def all_results(self) -> Dict[str, Dict[str, Any]]:
         out = {}
-        for name in os.listdir(self.res_dir):
+        for name in file_io.listdir(self.res_dir):
             if name.startswith("."):
                 continue
-            with open(os.path.join(self.res_dir, name)) as f:
-                rec = json.load(f)
+            with file_io.fopen(file_io.join(self.res_dir, name)) as f:
+                rec = json.loads(f.read())
             out[rec["uri"]] = rec
         return out
 
     def pending_count(self) -> int:
         try:
-            return sum(1 for n in os.listdir(self.req_dir)
+            return sum(1 for n in file_io.listdir(self.req_dir)
                        if not n.startswith("."))
         except FileNotFoundError:
             return 0
 
     def trim(self, max_pending: int) -> int:
-        names = sorted(n for n in os.listdir(self.req_dir)
+        names = sorted(n for n in file_io.listdir(self.req_dir)
                        if not n.startswith("."))
         dropped = 0
         for name in names[:max(0, len(names) - max_pending)]:
             try:
-                os.remove(os.path.join(self.req_dir, name))
+                file_io.remove(file_io.join(self.req_dir, name))
                 dropped += 1
             except OSError:
                 pass
@@ -192,9 +197,12 @@ class RedisQueue(QueueBackend):
 
 
 def make_queue(src: str) -> QueueBackend:
-    """``dir:///path`` or a path → FileQueue; ``host:port`` → RedisQueue."""
+    """``dir:///path``, a path, or a ``scheme://`` URI → FileQueue;
+    ``host:port`` → RedisQueue."""
     if src.startswith("dir://"):
         return FileQueue(src[len("dir://"):])
+    if file_io.scheme_of(src) is not None:
+        return FileQueue(src)
     if ":" in src and not os.sep in src.split(":")[0]:
         host, port = src.rsplit(":", 1)
         try:

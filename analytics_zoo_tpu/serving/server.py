@@ -37,7 +37,9 @@ class ClusterServing:
         self.model = model if model is not None else self._load_model()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._pool = None
         self.records_served = 0
+        self.device_seconds = 0.0  # dispatch→fetch time across batches
         self._writer = None
         if config.log_dir:
             from ..utils.tensorboard import SummaryWriter
@@ -84,10 +86,19 @@ class ClusterServing:
         raise ValueError(f"record has neither image nor tensor: "
                          f"{sorted(record)}")
 
-    # -- the serve loop -------------------------------------------------------
+    def _decode_pool(self):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.config.decode_threads,
+                thread_name_prefix="zoo-serving-decode")
+        return self._pool
 
-    def serve_once(self) -> int:
-        """One micro-batch; returns number of records served."""
+    # -- pipeline stages ------------------------------------------------------
+
+    def _claim(self) -> List:
+        """Claim up to one micro-batch, honoring the batch-wait deadline and
+        the backpressure trim guard."""
         cfg = self.config
         dropped = self.queue.trim(cfg.max_pending)
         if dropped:
@@ -99,54 +110,177 @@ class ClusterServing:
             if got:
                 batch.extend(got)
             elif not batch:
-                return 0  # nothing pending at all
+                return []  # nothing pending at all
             else:
                 time.sleep(0.001)
-        if not batch:
-            return 0
+        return batch
+
+    def _decode(self, batch: List):
+        """Decode a claimed batch on the thread pool (cv2 releases the GIL);
+        undecodable records become error results immediately."""
         uris, arrays, errors = [], [], []
-        for uri, rec in batch:
+        futures = [(uri, self._decode_pool().submit(self._prepare, rec))
+                   for uri, rec in batch]
+        for uri, fut in futures:
             try:
-                arrays.append(self._prepare(rec))
+                arrays.append(fut.result())
                 uris.append(uri)
             except Exception as e:  # undecodable record → error result
                 errors.append((uri, str(e)))
         for uri, msg in errors:
             self.queue.put_result(uri, {"error": msg})
+        return uris, arrays
+
+    def _writeback(self, uris: List[str], probs: np.ndarray,
+                   device_elapsed: float) -> None:
+        cfg = self.config
+        for uri, p in zip(uris, probs):
+            p = np.asarray(p).reshape(-1)
+            if cfg.filter_top_n:
+                self.queue.put_result(uri, {"topN": top_n(p, cfg.filter_top_n)})
+            else:
+                self.queue.put_result(uri, {"value": p.tolist()})
+        self.records_served += len(uris)
+        self.device_seconds += device_elapsed
+        if self._writer is not None:
+            self._writer.add_scalar("Serving Throughput",
+                                    len(uris) / max(device_elapsed, 1e-9),
+                                    self.records_served)
+            self._writer.add_scalar("Total Records Number",
+                                    self.records_served, self.records_served)
+
+    # -- the serve loop -------------------------------------------------------
+
+    def serve_once(self) -> int:
+        """One synchronous micro-batch (claim → decode → predict → writeback);
+        returns number of records served. ``run()`` pipelines these stages —
+        this method is the single-step form for tests and manual driving."""
+        batch = self._claim()
+        if not batch:
+            return 0
+        uris, arrays = self._decode(batch)
         if arrays:
             x = np.stack(arrays)
             start = time.perf_counter()
             probs = np.asarray(self.model.predict(x))
             elapsed = time.perf_counter() - start
-            for uri, p in zip(uris, probs):
-                p = np.asarray(p).reshape(-1)
-                if cfg.filter_top_n:
-                    self.queue.put_result(uri, {"topN": top_n(
-                        p, cfg.filter_top_n)})
-                else:
-                    self.queue.put_result(uri, {"value": p.tolist()})
-            self.records_served += len(uris)
-            if self._writer is not None:
-                self._writer.add_scalar("Serving Throughput",
-                                        len(uris) / max(elapsed, 1e-9),
-                                        self.records_served)
-                self._writer.add_scalar("Total Records Number",
-                                        self.records_served,
-                                        self.records_served)
+            self._writeback(uris, probs, elapsed)
         return len(batch)
 
     def run(self, poll_interval_s: float = 0.005) -> None:
+        """Pipelined serve loop: a claim+decode thread feeds the dispatch
+        stage, and a writeback thread drains device results — batch N+1
+        decodes on host threads while batch N runs on the device and batch
+        N-1's results upload (the reference runs decode serially inside the
+        structured-streaming micro-batch, ``ClusterServing.scala:160-259``;
+        overlapping the stages is what keeps a fast chip fed)."""
+        import queue as pyqueue
+
         logger.info("serving started (src=%s batch=%d)",
                     self.config.data_src, self.config.batch_size)
-        while not self._stop.is_set():
-            if self.serve_once() == 0:
-                time.sleep(poll_interval_s)
+        decoded_q: "pyqueue.Queue" = pyqueue.Queue(maxsize=2)
+        fetch_q: "pyqueue.Queue" = pyqueue.Queue(maxsize=2)
+        errors: List[BaseException] = []
+        dead = threading.Event()  # any stage died — unblock everyone
+
+        def _put(q: "pyqueue.Queue", item) -> bool:
+            """Bounded put that can never wedge the pipeline: gives up when
+            the loop is stopping or a peer stage has died."""
+            while not dead.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except pyqueue.Full:
+                    continue
+            return False
+
+        def decoder() -> None:
+            try:
+                while not self._stop.is_set() and not dead.is_set():
+                    batch = self._claim()
+                    if not batch:
+                        time.sleep(poll_interval_s)
+                        continue
+                    uris, arrays = self._decode(batch)
+                    if arrays and not _put(decoded_q, (uris,
+                                                       np.stack(arrays))):
+                        return
+            except BaseException as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+                dead.set()
+            finally:
+                while True:  # the sentinel must land even when the q is full
+                    try:
+                        decoded_q.put(None, timeout=0.2)
+                        return
+                    except pyqueue.Full:
+                        try:
+                            decoded_q.get_nowait()
+                        except pyqueue.Empty:
+                            pass
+
+        def writeback() -> None:
+            while True:
+                item = fetch_q.get()
+                if item is None:
+                    return
+                uris, fetch = item
+                try:
+                    t0 = time.perf_counter()
+                    probs = fetch()  # blocks on the device fetch only
+                    self._writeback(uris, np.asarray(probs),
+                                    time.perf_counter() - t0)
+                except BaseException as e:
+                    # one failed batch must not wedge the server: record
+                    # error results and keep draining
+                    logger.exception("writeback failed for %d records",
+                                     len(uris))
+                    for uri in uris:
+                        try:
+                            self.queue.put_result(uri, {"error": repr(e)})
+                        except Exception:
+                            pass
+
+        threads = [threading.Thread(target=decoder, daemon=True,
+                                    name="zoo-serving-claim"),
+                   threading.Thread(target=writeback, daemon=True,
+                                    name="zoo-serving-writeback")]
+        for t in threads:
+            t.start()
+        try:
+            while True:
+                item = decoded_q.get()
+                if item is None:
+                    break
+                uris, x = item
+                # async dispatch: the device computes while the NEXT batch
+                # decodes and the PREVIOUS batch's fetch+writeback runs
+                fetch = self.model.predict_async(x)
+                if not _put(fetch_q, (uris, fetch)):
+                    break
+        finally:
+            self._stop.set()
+            dead.set()
+            while True:
+                try:
+                    fetch_q.put(None, timeout=0.2)
+                    break
+                except pyqueue.Full:
+                    try:
+                        fetch_q.get_nowait()
+                    except pyqueue.Empty:
+                        pass
+            for t in threads:
+                t.join(timeout=10)
+        if errors:
+            raise errors[0]
         if self._writer is not None:
             self._writer.flush()
 
     def start(self) -> "ClusterServing":
         """Run the loop in a background thread (the spark-submit long-running
         job role)."""
+        self._stop.clear()
         self._thread = threading.Thread(target=self.run, daemon=True)
         self._thread.start()
         return self

@@ -13,13 +13,14 @@ TFRecord frame layout:
 """
 from __future__ import annotations
 
-import os
 import queue
 import socket
 import struct
 import threading
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..common import file_io
 
 # ---------------------------------------------------------------------------
 # CRC32C (Castagnoli), table-driven, pure python.
@@ -166,7 +167,7 @@ def decode_event(data: bytes) -> Dict[str, object]:
 
 def read_events(path: str) -> List[Dict[str, object]]:
     events = []
-    with open(path, "rb") as f:
+    with file_io.fopen(path, "rb") as f:
         while True:
             header = f.read(8)
             if len(header) < 8:
@@ -199,12 +200,12 @@ class SummaryWriter:
     """
 
     def __init__(self, logdir: str, flush_secs: float = 2.0):
-        os.makedirs(logdir, exist_ok=True)
+        file_io.makedirs(logdir, exist_ok=True)
         self.logdir = logdir
         fname = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
-        self.path = os.path.join(logdir, fname)
+        self.path = file_io.join(logdir, fname)
         self._queue: "queue.Queue[Optional[bytes]]" = queue.Queue()
-        self._file = open(self.path, "ab")
+        self._file = file_io.fopen(self.path, "ab")
         self._file.write(frame_record(encode_file_version_event()))
         self._file.flush()
         self._flush_secs = flush_secs
@@ -254,10 +255,10 @@ class SummaryWriter:
 def read_scalars(logdir: str, tag: str) -> List[Tuple[int, float]]:
     """Read back all (step, value) pairs for ``tag`` — ``getTrainSummary``."""
     out: List[Tuple[int, float]] = []
-    for fname in sorted(os.listdir(logdir)):
+    for fname in sorted(file_io.listdir(logdir)):
         if not fname.startswith("events.out.tfevents"):
             continue
-        for event in read_events(os.path.join(logdir, fname)):
+        for event in read_events(file_io.join(logdir, fname)):
             for t, v in event.get("scalars", []):
                 if t == tag:
                     out.append((int(event.get("step", 0)), v))

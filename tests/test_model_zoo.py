@@ -103,6 +103,30 @@ class TestWideAndDeep:
         assert feats[0][0, 1] == 5 + 2  # offset applied
         assert labels.tolist() == [0.0, 1.0]
 
+    def test_cross_columns_matches_per_value_crc32(self):
+        # the vectorized unique+gather hash must be bit-identical to the
+        # per-value crc32 loop it replaced (train/serve bucket stability)
+        import zlib
+
+        import pandas as pd
+        from analytics_zoo_tpu.models.recommendation.wide_and_deep import (
+            cross_columns)
+        rs = np.random.RandomState(3)
+        df = pd.DataFrame({
+            "s": rs.choice(["alpha", "beta", "gamma", "delta"], 5000),
+            "i": rs.randint(0, 50, 5000),
+            "f": rs.choice([0.5, 1.25, 7.0], 5000),
+        })
+        # NaN must hash as crc32("nan"), not gather a sentinel bucket
+        df.loc[::7, "s"] = np.nan
+        df.loc[::11, "f"] = np.nan
+        got = cross_columns(df, ["s", "i", "f"], 1 << 20)
+        acc = np.zeros(len(df), dtype=np.int64)
+        for c in ["s", "i", "f"]:
+            acc = acc * 1000003 + np.asarray(
+                [zlib.crc32(str(v).encode()) for v in df[c]], dtype=np.int64)
+        np.testing.assert_array_equal(got, np.abs(acc) % (1 << 20))
+
 
 class TestSessionRecommender:
     def test_session_only(self, ctx):

@@ -130,6 +130,33 @@ class TestEndToEnd:
         finally:
             serving.stop()
 
+    def test_pipelined_run_many_batches(self, ctx, tmp_path):
+        # the run() pipeline (decode thread / dispatch / writeback thread)
+        # must serve every record across many micro-batches and account
+        # device time
+        import jax
+        from analytics_zoo_tpu.inference import InferenceModel
+        from analytics_zoo_tpu.serving import (
+            ClusterServing, InputQueue, OutputQueue, ServingConfig)
+        im = InferenceModel().load_jax(
+            lambda p, x: x.reshape(x.shape[0], -1).sum(1, keepdims=True), {})
+        src = f"dir://{tmp_path}"
+        cfg = ServingConfig(data_src=src, image_shape=(4, 4, 3),
+                            batch_size=4, batch_wait_ms=5, decode_threads=2)
+        serving = ClusterServing(cfg, model=im).start()
+        try:
+            inq, outq = InputQueue(src), OutputQueue(src)
+            rs = np.random.RandomState(1)
+            for i in range(17):  # several batches + a ragged tail
+                inq.enqueue_image(
+                    f"p{i}", rs.randint(0, 255, (4, 4, 3)).astype(np.uint8))
+            for i in range(17):
+                assert outq.query(f"p{i}", timeout_s=20.0) is not None
+        finally:
+            serving.stop()
+        assert serving.records_served >= 17
+        assert serving.device_seconds > 0
+
     def test_bad_record_gets_error_result(self, ctx, tmp_path):
         import jax.numpy as jnp
         from analytics_zoo_tpu.inference import InferenceModel
