@@ -1,3 +1,4 @@
 from .abstract import SearchEngine, TrialOutput  # noqa: F401
 from .local_search import LocalSearchEngine  # noqa: F401
 from .parallel_search import ParallelSearchEngine  # noqa: F401
+from .pod_search import PodSearchEngine  # noqa: F401

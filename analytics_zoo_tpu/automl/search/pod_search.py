@@ -1,0 +1,114 @@
+"""PodSearchEngine — AutoML trials distributed over PodLauncher workers.
+
+The reference distributes hyperparameter trials across the cluster with Ray
+Tune (``pyzoo/zoo/automl/search/RayTuneSearchEngine.py:28``: one Ray actor
+per trial, results gathered on the driver). The TPU-native equivalent reuses
+the framework's own pod orchestration (``cluster/launcher.py`` PodLauncher):
+the driver expands the full deterministic trial list, spools the trainable +
+data ONCE via pickle, launches N workers that each run the
+``rank::num_workers`` stride of trials on the CPU backend, and merges the
+per-worker result files rank-0-style. Config generation is identical to the
+sequential engine (same seed → same trials → same best config); only the
+placement changes.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any, Dict, List, Optional
+
+from .abstract import TrialOutput
+from .local_search import LocalSearchEngine, _expand_grid, _materialize
+
+
+def _pod_worker(spool_dir: str) -> int:
+    """Worker target (runs under ``cluster.bootstrap``): execute this rank's
+    stride of trials and write ``results_{rank}.pkl``."""
+    rank = int(os.environ["ZOO_TPU_PROC_ID"])
+    nprocs = int(os.environ["ZOO_TPU_NPROCS"])
+    with open(os.path.join(spool_dir, "payload.pkl"), "rb") as f:
+        payload = pickle.load(f)
+    fit_fn = payload["fit_fn"]
+    model_create_fn = payload["model_create_fn"]
+    data, metric = payload["data"], payload["metric"]
+    results: List[Dict[str, Any]] = []
+    for idx in range(rank, len(payload["configs"]), nprocs):
+        config = payload["configs"][idx]
+        if fit_fn is not None:
+            score = fit_fn(config, data)
+        else:
+            model = model_create_fn()
+            score = model.fit_eval(data, metric=metric, **config)
+        results.append({"index": idx, "config": config,
+                        "metric": float(score)})
+    tmp = os.path.join(spool_dir, f".results_{rank}.pkl")
+    with open(tmp, "wb") as f:
+        pickle.dump(results, f)
+    os.replace(tmp, os.path.join(spool_dir, f"results_{rank}.pkl"))
+    return 0
+
+
+class PodSearchEngine(LocalSearchEngine):
+    """Cluster-wide trial execution over PodLauncher worker processes.
+
+    Differences from :class:`ParallelSearchEngine` (one-host process pool):
+    workers are full pod workers — parent-death guarded, per-worker log
+    files, fail-fast reaping — the same machinery that runs distributed
+    training, so a search can span every host a pod spans. Bayes search
+    stays sequential (each step conditions on all previous results).
+    """
+
+    def __init__(self, num_workers: int = 2, seed: int = 0,
+                 timeout: Optional[float] = None):
+        super().__init__(seed=seed)
+        self.num_workers = num_workers
+        self.timeout = timeout
+
+    def run(self) -> List[TrialOutput]:
+        if not self._compiled:
+            raise RuntimeError("compile first")
+        if self.recipe.search_algorithm() == "bayes":
+            import logging
+            logging.getLogger("analytics_zoo_tpu").info(
+                "bayes search is sequential by construction; running trials "
+                "in-process")
+            self.trials = self._run_bayes()
+            return self.trials
+        points = _expand_grid(self.space)
+        n_samples = max(1, self.recipe.runtime_params()["num_samples"])
+        configs = [_materialize(point, self.rng)
+                   for point in points for _ in range(n_samples)]
+        payload = {"fit_fn": self.fit_fn,
+                   "model_create_fn": self.model_create_fn,
+                   "data": self.data, "metric": self.metric,
+                   "configs": configs}
+        spool = tempfile.mkdtemp(prefix="zoo_pod_search_")
+        try:
+            with open(os.path.join(spool, "payload.pkl"), "wb") as f:
+                pickle.dump(payload, f)
+        except Exception as e:
+            raise ValueError(
+                "PodSearchEngine needs a picklable trainable (module-level "
+                "fit_fn / model_create_fn) and picklable data; use "
+                f"LocalSearchEngine for closures. Underlying error: {e!r}")
+        from ...cluster.launcher import run_pod
+        nprocs = min(self.num_workers, len(configs))
+        run_pod("analytics_zoo_tpu.automl.search.pod_search:_pod_worker",
+                nprocs, args=[spool], platform="cpu",
+                timeout=self.timeout)
+        merged: List[Dict[str, Any]] = []
+        for rank in range(nprocs):
+            path = os.path.join(spool, f"results_{rank}.pkl")
+            if not os.path.exists(path):
+                raise RuntimeError(
+                    f"search worker {rank} exited OK but wrote no results "
+                    f"file — {path} missing")
+            with open(path, "rb") as f:
+                merged.extend(pickle.load(f))
+        # submission order == the sequential engine's trial order, so the
+        # seed-compatibility contract (identical best config) holds
+        merged.sort(key=lambda r: r["index"])
+        self.trials = [TrialOutput(config=r["config"], metric=r["metric"])
+                       for r in merged]
+        return self.trials

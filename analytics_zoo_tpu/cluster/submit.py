@@ -1,0 +1,128 @@
+"""``zoo-tpu-submit`` — launch a training script across pod workers.
+
+The reference submits jobs to the cluster with shell wrappers around
+spark-submit (``scripts/spark-submit-python-with-zoo.sh``,
+``make-dist.sh``); the TPU-native equivalent wraps :class:`PodLauncher`
+(``cluster/launcher.py``): N coordinated worker processes, each joining the
+``jax.distributed`` coordination service, running the SAME user script — the
+standard multi-controller JAX/TPU-pod execution model.
+
+Modes:
+
+- local run (default): spawn ``--nprocs`` workers on this host and wait.
+  ``--devices-per-proc`` + ``--platform cpu`` simulate a pod on one machine
+  (CI); on real TPU-VM hosts leave them unset.
+- ``--emit k8s``: print a GKE-style manifest skeleton (one worker per pod
+  replica, the coordination env each container needs) instead of running —
+  the deploy story for real clusters, where a scheduler, not this CLI,
+  places the processes.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+from typing import List, Optional
+
+
+def _run_script(script: str, argv: List[str]) -> int:
+    """Worker target: execute the user script as ``__main__`` (the worker
+    already joined jax.distributed via cluster.bootstrap)."""
+    sys.argv = [script] + list(argv)
+    script_dir = os.path.dirname(os.path.abspath(script))
+    if script_dir not in sys.path:
+        sys.path.insert(0, script_dir)
+    runpy.run_path(script, run_name="__main__")
+    return 0
+
+
+def _emit_k8s(args, script_args: List[str]) -> str:
+    """GKE-style manifest skeleton: a headless service for the coordinator
+    plus one worker Job per process, wired with the same env contract the
+    local launcher uses."""
+    image = args.image or "analytics-zoo-tpu:latest"
+    cmd = ["python", args.script] + list(script_args)
+    lines = [
+        "# zoo-tpu-submit --emit k8s skeleton",
+        "# worker 0's pod DNS name is the coordinator; every worker gets the",
+        "# same env apart from its rank. Adapt resources/selectors to your",
+        "# TPU node pools (e.g. cloud.google.com/gke-tpu-topology).",
+        "apiVersion: v1",
+        "kind: Service",
+        "metadata: {name: zoo-tpu-coord}",
+        "spec:",
+        "  clusterIP: None",
+        "  selector: {app: zoo-tpu-worker, rank: '0'}",
+        "  ports: [{port: 8476, name: coord}]",
+        "---",
+    ]
+    for rank in range(args.nprocs):
+        lines += [
+            "apiVersion: batch/v1",
+            "kind: Job",
+            f"metadata: {{name: zoo-tpu-worker-{rank}}}",
+            "spec:",
+            "  template:",
+            "    metadata:",
+            f"      labels: {{app: zoo-tpu-worker, rank: '{rank}'}}",
+            "    spec:",
+            "      restartPolicy: Never",
+            "      containers:",
+            "      - name: worker",
+            f"        image: {image}",
+            f"        command: {cmd!r}",
+            "        env:",
+            "        - {name: ZOO_TPU_COORD, value: 'zoo-tpu-coord:8476'}",
+            f"        - {{name: ZOO_TPU_NPROCS, value: '{args.nprocs}'}}",
+            f"        - {{name: ZOO_TPU_PROC_ID, value: '{rank}'}}",
+            "---",
+        ]
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="zoo-tpu-submit",
+        description="Run a script across coordinated pod workers.")
+    ap.add_argument("--nprocs", type=int, default=1,
+                    help="number of worker processes")
+    ap.add_argument("--devices-per-proc", type=int, default=None,
+                    help="virtual CPU devices per worker (simulation/CI)")
+    ap.add_argument("--platform", default="",
+                    help="force JAX platform in workers (e.g. cpu)")
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="kill the pod after this many seconds")
+    ap.add_argument("--log-dir", default=None,
+                    help="per-worker log directory (tempdir default)")
+    ap.add_argument("--emit", choices=["k8s"], default=None,
+                    help="print a deployment manifest instead of running")
+    ap.add_argument("--image", default=None,
+                    help="container image for --emit k8s")
+    ap.add_argument("script", help="python script to run in every worker")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER,
+                    help="arguments passed to the script")
+    args = ap.parse_args(argv)
+
+    if args.emit == "k8s":
+        print(_emit_k8s(args, args.script_args))
+        return 0
+
+    from .launcher import PodLauncher
+    script = os.path.abspath(args.script)
+    if not os.path.exists(script):
+        ap.error(f"script not found: {args.script}")
+    launcher = PodLauncher(num_processes=args.nprocs,
+                           devices_per_process=args.devices_per_proc,
+                           platform=args.platform,
+                           log_dir=args.log_dir)
+    results = launcher.run("analytics_zoo_tpu.cluster.submit:_run_script",
+                           args=[script, args.script_args],
+                           timeout=args.timeout)
+    for r in results:
+        print(f"worker {r.process_id}: rc={r.returncode} log={r.log_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -117,12 +117,30 @@ def isdir(path: str) -> bool:
     return bool(_fs(path).isdir(str(path)))
 
 
-def listdir(path: str) -> List[str]:
-    """Child names (basenames), like ``os.listdir``."""
+def listdir(path: str, refresh: bool = False) -> List[str]:
+    """Child names (basenames), like ``os.listdir``. ``refresh`` drops the
+    filesystem's cached listing first — fsspec backends cache directory
+    listings indefinitely, so a POLLING consumer (e.g. the serving file
+    queue) would otherwise never see entries written by another process."""
     if not is_remote(path):
         return os.listdir(local_path(path))
-    names = _fs(path).ls(str(path), detail=False)
+    fs = _fs(path)
+    if refresh:
+        try:
+            fs.invalidate_cache(str(path))
+        except Exception:
+            pass  # backend without a listing cache
+    names = fs.ls(str(path), detail=False, refresh=True) \
+        if refresh and _accepts_refresh(fs) else fs.ls(str(path), detail=False)
     return [posixpath.basename(str(n).rstrip("/")) for n in names]
+
+
+def _accepts_refresh(fs) -> bool:
+    try:
+        import inspect
+        return "refresh" in inspect.signature(fs.ls).parameters
+    except (TypeError, ValueError):
+        return False
 
 
 def makedirs(path: str, exist_ok: bool = True) -> None:

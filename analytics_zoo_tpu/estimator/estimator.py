@@ -254,6 +254,14 @@ class Estimator:
             return jax.tree_util.tree_map(
                 lambda t: t.astype(jnp.float32), y_pred)
 
+        if self.ctx.process_count > 1:
+            # multi-host: every host must be able to fetch the predictions
+            # (np.asarray on a batch-sharded output would span
+            # non-addressable devices) — replicate outputs; XLA inserts the
+            # all-gather over the batch axis
+            from jax.sharding import NamedSharding, PartitionSpec
+            return jax.jit(predict_step, out_shardings=NamedSharding(
+                self.mesh, PartitionSpec()))
         return jax.jit(predict_step)
 
     # -- train (the InternalDistriOptimizer.train equivalent) -----------------
@@ -565,6 +573,11 @@ class Estimator:
         self._state_resolved = True
 
     def _snapshot_tree(self):
+        if self.opt_state is None and self.params is not None:
+            # saving a compiled-but-never-stepped model: materialize the
+            # optimizer state so the checkpoint restores against the same
+            # structure a trained snapshot has
+            self.opt_state = self.optimizer.init(self.params)
         tree = {
             "params": jax.tree_util.tree_map(np.asarray, self.params),
             "opt_state": jax.tree_util.tree_map(np.asarray, self.opt_state),

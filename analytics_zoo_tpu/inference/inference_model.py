@@ -78,6 +78,8 @@ class InferenceModel:
 
         self._set_forward(forward)
         self._params = self._device(est.params)
+        self._keras_model = model  # calibrated int8 needs the layer graph
+        self._keras_state = est.model_state
         return self
 
     def load_keras(self, model, params=None, model_state=None
@@ -94,6 +96,8 @@ class InferenceModel:
 
         self._set_forward(forward)
         self._params = self._device(params)
+        self._keras_model = model  # calibration needs the layer graph
+        self._keras_state = model_state
         return self
 
     def load_jax(self, forward_fn: Callable, params: Any) -> "InferenceModel":
@@ -172,11 +176,40 @@ class InferenceModel:
 
     # -- quantization (int8/VNNI path equivalent) -----------------------------
 
-    def quantize(self, dtype: str = "bf16") -> "InferenceModel":
+    def quantize(self, dtype: str = "bf16", calibration_data=None,
+                 percentile: float = 99.9) -> "InferenceModel":
+        """``bf16`` casts weights; ``int8`` without calibration is
+        weight-only (dequantized on the fly). ``int8`` WITH
+        ``calibration_data`` (an iterable of input batches, e.g. a
+        FeatureSet iterator) runs activation observers over the batches and
+        installs the static-quantization path: Dense/Conv kernels carry
+        per-tensor activation scales and execute on the int8 grid
+        (the reference's calibrated OpenVINO int8,
+        ``OpenVinoInferenceSupportive.scala:64``)."""
         if self._params is None:
             raise RuntimeError("load a model first")
-        qparams = quantize_params(self._params, dtype)
         base = self._forward
+        if dtype == "int8" and calibration_data is not None:
+            model = getattr(self, "_keras_model", None)
+            if model is None:
+                raise ValueError(
+                    "calibrated int8 needs a keras-graph model "
+                    "(load_keras/load_zoo); weight-only int8 works for "
+                    "opaque forwards — call quantize('int8') without "
+                    "calibration_data")
+            from .quantize import observe_activation_scales
+            host_params = jax.tree_util.tree_map(np.asarray, self._params)
+            act_scales = observe_activation_scales(
+                model, host_params, self._keras_state, calibration_data,
+                percentile=percentile)
+            qparams = quantize_params(self._params, "int8",
+                                      act_scales=act_scales)
+            self._act_scales = act_scales
+            # layers consume their quantized kernels natively — the base
+            # forward runs unchanged on the mixed tree
+            self._params = self._device(qparams)
+            return self
+        qparams = quantize_params(self._params, dtype)
 
         if dtype == "int8":
             def forward(qp, x):
@@ -289,20 +322,26 @@ class InferenceModel:
             biggest = max(aot)
             limit = biggest if limit is None else min(limit, biggest)
         if limit is not None and n > limit:
-            chunks = [self.predict(
+            # chunks inherit _fetch: an async caller gets every chunk
+            # DISPATCHED now and a thunk that fetches/concats later, so the
+            # pipeline overlap survives bucketed chunking
+            chunk_thunks = [self.predict(
                 [a[i:i + limit] for a in xs] if is_multi
-                else xs[0][i:i + limit], batch_size=limit)
+                else xs[0][i:i + limit], batch_size=limit, _fetch=False)
                 for i in range(0, n, limit)]
-            if isinstance(chunks[0], (list, tuple)):
-                out = type(chunks[0])(
-                    np.concatenate([c[i] for c in chunks])
-                    for i in range(len(chunks[0])))
-            elif isinstance(chunks[0], dict):
-                out = {k: np.concatenate([c[k] for c in chunks])
-                       for k in chunks[0]}
-            else:
-                out = np.concatenate(chunks)
-            return out if _fetch else (lambda: out)
+
+            def gather():
+                chunks = [t() for t in chunk_thunks]
+                if isinstance(chunks[0], (list, tuple)):
+                    return type(chunks[0])(
+                        np.concatenate([c[i] for c in chunks])
+                        for i in range(len(chunks[0])))
+                if isinstance(chunks[0], dict):
+                    return {k: np.concatenate([c[k] for c in chunks])
+                            for k in chunks[0]}
+                return np.concatenate(chunks)
+
+            return gather() if _fetch else gather
 
         if aot is not None:
             # smallest exported bucket that fits; empty batches still run

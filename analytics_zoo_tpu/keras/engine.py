@@ -407,7 +407,24 @@ class _TrainableMixin:
         est.set_params(params)
 
     def save_model(self, path: str) -> None:
-        self.get_estimator().save_checkpoint(path)
+        est = self.get_estimator()
+        if est.params is None:
+            # a fresh (never fit/predicted) model still saves: materialize
+            # the deterministic init params so the checkpoint restores with
+            # the same structure a trained one has
+            shape = getattr(self, "built_shape", None)
+            if isinstance(self, Model):
+                params, state = self.build(jax.random.PRNGKey(0))
+            elif shape is not None:
+                params, state = self.build(jax.random.PRNGKey(0), shape)
+            else:
+                raise ValueError(
+                    "save_model on an unbuilt Sequential — run "
+                    "fit/predict once (or build(rng, input_shape)) so the "
+                    "parameter shapes are known")
+            est.set_params(params)
+            est.set_model_state(state)
+        est.save_checkpoint(path)
 
     def load_weights(self, path: str) -> None:
         self.get_estimator().load_checkpoint(path)

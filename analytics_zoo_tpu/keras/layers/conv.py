@@ -76,11 +76,19 @@ class Convolution2D(Layer):
         return params, {}
 
     def call(self, params, state, inputs, *, training=False, rng=None):
-        y = lax.conv_general_dilated(
-            inputs, params["kernel"].astype(inputs.dtype),
-            window_strides=self.strides, padding=self.padding,
-            rhs_dilation=self.dilation, feature_group_count=self.groups,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        kernel = params["kernel"]
+        if isinstance(kernel, dict) and "q" in kernel:
+            # int8-quantized kernel (inference/quantize.py): int8 conv with
+            # calibrated activation scales, weight-dequant otherwise
+            from ...inference.quantize import qconv_apply
+            y = qconv_apply(inputs, kernel, self.strides, self.padding,
+                            self.dilation, self.groups)
+        else:
+            y = lax.conv_general_dilated(
+                inputs, kernel.astype(inputs.dtype),
+                window_strides=self.strides, padding=self.padding,
+                rhs_dilation=self.dilation, feature_group_count=self.groups,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
         if self.use_bias:
             y = y + params["bias"].astype(y.dtype)
         return self.activation(y), state

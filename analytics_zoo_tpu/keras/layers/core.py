@@ -72,7 +72,13 @@ class Dense(Layer):
         return params, {}
 
     def call(self, params, state, inputs, *, training=False, rng=None):
-        y = inputs @ params["kernel"].astype(inputs.dtype)
+        kernel = params["kernel"]
+        if isinstance(kernel, dict) and "q" in kernel:
+            # int8-quantized kernel (inference/quantize.py): static path
+            from ...inference.quantize import qdense_apply
+            y = qdense_apply(inputs, kernel)
+        else:
+            y = inputs @ kernel.astype(inputs.dtype)
         if self.use_bias:
             y = y + params["bias"].astype(y.dtype)
         return self.activation(y), state

@@ -71,7 +71,9 @@ class FileQueue(QueueBackend):
     def claim_batch(self, max_items: int) -> List[Tuple[str, Dict[str, Any]]]:
         out = []
         try:
-            names = sorted(file_io.listdir(self.req_dir))
+            # refresh: another process's enqueues must be visible despite
+            # fsspec listing caches (remote spools)
+            names = sorted(file_io.listdir(self.req_dir, refresh=True))
         except FileNotFoundError:
             return out
         for name in names:
@@ -127,13 +129,13 @@ class FileQueue(QueueBackend):
 
     def pending_count(self) -> int:
         try:
-            return sum(1 for n in file_io.listdir(self.req_dir)
+            return sum(1 for n in file_io.listdir(self.req_dir, refresh=True)
                        if not n.startswith("."))
         except FileNotFoundError:
             return 0
 
     def trim(self, max_pending: int) -> int:
-        names = sorted(n for n in file_io.listdir(self.req_dir)
+        names = sorted(n for n in file_io.listdir(self.req_dir, refresh=True)
                        if not n.startswith("."))
         dropped = 0
         for name in names[:max(0, len(names) - max_pending)]:

@@ -149,6 +149,32 @@ class ClusterServing:
             self._writer.add_scalar("Total Records Number",
                                     self.records_served, self.records_served)
 
+    def _force_sentinel(self, q) -> None:
+        """Land a ``None`` sentinel on a possibly-full queue. Any real
+        in-flight item displaced to make room was already CLAIMED from the
+        spool — its requests get error results rather than vanishing (the
+        client would otherwise poll to its timeout)."""
+        import queue as pyqueue
+        while True:
+            try:
+                q.put(None, timeout=0.2)
+                return
+            except pyqueue.Full:
+                try:
+                    item = q.get_nowait()
+                except pyqueue.Empty:
+                    continue
+                if item is None:
+                    continue
+                uris = item[0]
+                for uri in uris:
+                    try:
+                        self.queue.put_result(
+                            uri, {"error": "serving shut down before this "
+                                           "request completed"})
+                    except Exception:
+                        pass
+
     # -- the serve loop -------------------------------------------------------
 
     def serve_once(self) -> int:
@@ -209,15 +235,7 @@ class ClusterServing:
                 errors.append(e)
                 dead.set()
             finally:
-                while True:  # the sentinel must land even when the q is full
-                    try:
-                        decoded_q.put(None, timeout=0.2)
-                        return
-                    except pyqueue.Full:
-                        try:
-                            decoded_q.get_nowait()
-                        except pyqueue.Empty:
-                            pass
+                self._force_sentinel(decoded_q)
 
         def writeback() -> None:
             while True:
@@ -261,15 +279,7 @@ class ClusterServing:
         finally:
             self._stop.set()
             dead.set()
-            while True:
-                try:
-                    fetch_q.put(None, timeout=0.2)
-                    break
-                except pyqueue.Full:
-                    try:
-                        fetch_q.get_nowait()
-                    except pyqueue.Empty:
-                        pass
+            self._force_sentinel(fetch_q)
             for t in threads:
                 t.join(timeout=10)
         if errors:

@@ -1,2 +1,3 @@
 from .shard import (  # noqa: F401
     DataShards, read_csv, read_json, read_parquet)
+from .pod_shard import PodDataShards  # noqa: F401

@@ -499,6 +499,63 @@ def bench_longseq(batch_size: int = 4, heads: int = 8, seq: int = 4096,
                 "flops_per_step": flops})
 
 
+def bench_quantized(batch_size: int = 32, steps: int = 30, warmup: int = 3):
+    """ResNet-18 inference latency across precisions: fp32 vs bf16 vs
+    calibrated int8 (activation observers + static grid — the reference's
+    OpenVINO VNNI int8 role, ``examples/vnni/openvino/Perf.scala``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.inference import InferenceModel
+    from analytics_zoo_tpu.models.image.imageclassification import resnet
+
+    init_tpu_context()
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(batch_size, 224, 224, 3).astype(np.float32))
+    model = resnet(18, num_classes=1000, input_shape=(224, 224, 3))
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+    params, state = model.build(jax.random.PRNGKey(0))
+
+    def measure(im):
+        fwd = im._forward
+        p = im._params
+        eps = jnp.float32(0.0)
+
+        def chained(p, x, eps, n):
+            def body(carry, _):
+                y = fwd(p, carry)
+                s = jnp.sum(jnp.asarray(y, jnp.float32))
+                return carry + eps * s, ()
+            out, _ = jax.lax.scan(body, x, None, length=n)
+            return jnp.sum(out)
+
+        c1 = jax.jit(lambda p, x, e: chained(p, x, e, steps)
+                     ).lower(p, x, eps).compile()
+        c2 = jax.jit(lambda p, x, e: chained(p, x, e, 2 * steps)
+                     ).lower(p, x, eps).compile()
+        float(c1(p, x, eps)); float(c2(p, x, eps))
+        t1 = min(_timed(lambda: float(c1(p, x, eps))) for _ in range(2))
+        t2 = min(_timed(lambda: float(c2(p, x, eps))) for _ in range(2))
+        dev = max(t2 - t1, 1e-9)
+        return round(batch_size * steps / dev, 1)
+
+    fp32 = measure(InferenceModel().load_keras(model, params, state))
+    b16 = measure(InferenceModel().load_keras(model, params, state)
+                  .quantize("bf16"))
+    calib = [np.asarray(x[:8])]
+    i8 = measure(InferenceModel().load_keras(model, params, state)
+                 .quantize("int8", calibration_data=calib))
+    return _BenchResult(
+        metric="quantized_resnet18_images_per_sec",
+        value=i8, unit="images/s", mfu=None,
+        detail={"batch_size": batch_size, "model": "resnet18 224px 1000c",
+                "fp32_images_per_sec": fp32,
+                "bf16_images_per_sec": b16,
+                "int8_calibrated_images_per_sec": i8,
+                "loop": "single-dispatch scan, differenced (2N-N) timing"})
+
+
 _WORKLOADS = {
     "resnet50": bench_resnet50,
     "ncf": bench_ncf,
@@ -507,6 +564,7 @@ _WORKLOADS = {
     "longseq": bench_longseq,
     "pipeline": bench_input_pipeline,
     "serving": bench_serving,
+    "quantized": bench_quantized,
 }
 
 

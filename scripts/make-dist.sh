@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Build distributable artifacts (reference make-dist.sh role): wheel +
+# sdist into dist/. Uses `python -m build` when available, falling back to
+# a pip-built wheel (sdist skipped) on minimal images.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+rm -rf build dist *.egg-info
+if python -c "import build" 2>/dev/null; then
+    python -m build
+else
+    echo "python-build not installed; building wheel via pip"
+    pip wheel . --no-deps -w dist
+fi
+echo "== dist artifacts =="
+ls -l dist/

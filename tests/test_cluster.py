@@ -58,3 +58,37 @@ class TestPodTraining:
         from analytics_zoo_tpu.cluster.bootstrap import resolve_target
         with pytest.raises(ValueError):
             resolve_target("no_colon_here")
+
+
+class TestSubmitCLI:
+    def test_submit_runs_example_across_workers(self):
+        """The deploy CLI contract: zoo-tpu-submit --nprocs 2 <example>
+        --smoke completes with every worker green."""
+        import subprocess
+        import sys
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, "-m", "analytics_zoo_tpu.cluster.submit",
+             "--nprocs", "2", "--platform", "cpu", "--devices-per-proc", "2",
+             os.path.join(repo, "examples", "recommendation",
+                          "ncf_example.py"), "--smoke"],
+            capture_output=True, text=True, timeout=600, cwd=repo)
+        assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+        assert "worker 0: rc=0" in proc.stdout
+        assert "worker 1: rc=0" in proc.stdout
+
+    def test_emit_k8s_manifest(self):
+        import subprocess
+        import sys
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, "-m", "analytics_zoo_tpu.cluster.submit",
+             "--nprocs", "3", "--emit", "k8s", "--image", "zoo:v1",
+             "train.py", "--epochs", "2"],
+            capture_output=True, text=True, timeout=60, cwd=repo)
+        assert proc.returncode == 0, proc.stderr[-500:]
+        out = proc.stdout
+        assert out.count("kind: Job") == 3
+        assert "ZOO_TPU_NPROCS, value: '3'" in out
+        assert "zoo:v1" in out
+        assert "'--epochs', '2'" in out

@@ -66,6 +66,48 @@ def _pid_trial(config, data):
     return float(os.getpid())
 
 
+class TestPodSearch:
+    def test_matches_sequential_best_config(self):
+        from analytics_zoo_tpu.automl.search import PodSearchEngine
+        seq = LocalSearchEngine(seed=0)
+        seq.compile(data=None, model_create_fn=None, recipe=_GridRecipe(),
+                    metric="mse", fit_fn=_quadratic_trial)
+        seq_trials = seq.run()
+
+        pod = PodSearchEngine(num_workers=2, seed=0, timeout=300)
+        pod.compile(data=None, model_create_fn=None, recipe=_GridRecipe(),
+                    metric="mse", fit_fn=_quadratic_trial)
+        pod_trials = pod.run()
+
+        assert [(t.config["lr"], t.config["units"]) for t in pod_trials] \
+            == [(t.config["lr"], t.config["units"]) for t in seq_trials]
+        best = pod.get_best_trials(1)[0]
+        seq_best = seq.get_best_trials(1)[0]
+        assert best.config == seq_best.config
+        assert best.metric == pytest.approx(seq_best.metric)
+
+    def test_distinct_trials_per_worker(self):
+        from analytics_zoo_tpu.automl.search import PodSearchEngine
+        pod = PodSearchEngine(num_workers=2, seed=0, timeout=300)
+        pod.compile(data=None, model_create_fn=None, recipe=_GridRecipe(),
+                    metric="mse", fit_fn=_pid_trial)
+        trials = pod.run()
+        pids = {int(t.metric) for t in trials}
+        assert os.getpid() not in pids
+        assert len(pids) == 2, "expected trials spread over 2 pod workers"
+        # stride placement: trial i runs on worker i % 2
+        assert len({int(t.metric) for t in trials[0::2]}) == 1
+        assert len({int(t.metric) for t in trials[1::2]}) == 1
+
+    def test_unpicklable_rejected(self):
+        from analytics_zoo_tpu.automl.search import PodSearchEngine
+        pod = PodSearchEngine(num_workers=2, seed=0)
+        pod.compile(data=None, model_create_fn=None, recipe=_GridRecipe(),
+                    metric="mse", fit_fn=lambda c, d: 0.0)
+        with pytest.raises(ValueError, match="picklable"):
+            pod.run()
+
+
 class TestParallelPredictor:
     def test_time_sequence_parallel_search(self):
         """The end-user path: AutoTS-style predictor with parallel trials."""

@@ -1,0 +1,124 @@
+"""Calibrated int8 quantization (reference OpenVINO calibrated-int8 role,
+``OpenVinoInferenceSupportive.scala:64``): activation observers over a
+calibration set produce per-tensor activation scales; the quantized model's
+accuracy must stay within 1% top-1 of fp32."""
+import numpy as np
+import pytest
+
+
+def _blobs(n, seed=0):
+    """Linearly separable 3-class image blobs a small CNN learns quickly."""
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, 3, n)
+    x = rs.randn(n, 8, 8, 3).astype(np.float32) * 0.3
+    for i, c in enumerate(y):
+        x[i, :, :, c] += 1.5  # class = dominant channel
+    return x, y.astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from analytics_zoo_tpu.keras import Sequential
+    from analytics_zoo_tpu.keras.layers import (
+        Activation, Convolution2D, Dense, Flatten, GlobalAveragePooling2D)
+    from analytics_zoo_tpu.feature import FeatureSet
+    model = Sequential([
+        Convolution2D(8, 3, 3, border_mode="same", name="c1"),
+        Activation("relu"),
+        Convolution2D(16, 3, 3, border_mode="same", name="c2"),
+        Activation("relu"),
+        GlobalAveragePooling2D(name="gap"),
+        Dense(16, activation="relu", name="d1"),
+        Dense(3, activation="softmax", name="head")])
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    x, y = _blobs(512)
+    model.fit(FeatureSet.from_ndarrays(x, y, shuffle=True), batch_size=64,
+              nb_epoch=6)
+    return model
+
+
+class TestCalibratedInt8:
+    def test_observer_scales_collected(self, ctx, trained):
+        from analytics_zoo_tpu.inference.quantize import (
+            observe_activation_scales)
+        est = trained.get_estimator()
+        params = est.get_params()
+        state = {k: np.asarray(v) for k, v in (est.model_state or {}).items()}
+        x, _ = _blobs(64, seed=1)
+        scales = observe_activation_scales(trained, params, est.model_state,
+                                           [x[i:i + 16] for i in range(0, 64, 16)])
+        assert set(scales) == {"c1", "c2", "d1", "head"}
+        assert all(s > 0 for s in scales.values())
+        # observers must be REMOVED afterwards
+        for l in [l for l in trained.layers]:
+            assert "wrapped" not in repr(getattr(l, "call", None))
+
+    def test_int8_within_1pct_top1_of_fp32(self, ctx, trained):
+        from analytics_zoo_tpu.inference import InferenceModel
+        xe, ye = _blobs(512, seed=2)
+        im = InferenceModel().load_keras(trained)
+        fp32_top1 = np.argmax(np.asarray(im.predict(xe)), -1)
+        fp32_acc = float((fp32_top1 == ye).mean())
+        assert fp32_acc > 0.9, "fixture failed to train"
+
+        xc, _ = _blobs(128, seed=3)
+        im8 = InferenceModel().load_keras(trained).quantize(
+            "int8", calibration_data=[xc[i:i + 32] for i in range(0, 128, 32)])
+        int8_top1 = np.argmax(np.asarray(im8.predict(xe)), -1)
+        agreement = float((int8_top1 == fp32_top1).mean())
+        int8_acc = float((int8_top1 == ye).mean())
+        assert agreement >= 0.99, f"top-1 agreement {agreement}"
+        assert abs(fp32_acc - int8_acc) <= 0.01
+
+    def test_act_scales_ride_in_params(self, ctx, trained):
+        import jax
+        from analytics_zoo_tpu.inference import InferenceModel
+        xc, _ = _blobs(64, seed=4)
+        im8 = InferenceModel().load_keras(trained).quantize(
+            "int8", calibration_data=[xc])
+        leaves = jax.tree_util.tree_leaves(
+            im8._params, is_leaf=lambda t: isinstance(t, dict) and "q" in t)
+        qleaves = [l for l in leaves if isinstance(l, dict) and "q" in l]
+        assert len(qleaves) == 4  # c1, c2, d1, head kernels
+        assert all("act_scale" in l for l in qleaves)
+        assert all(l["q"].dtype == np.int8 for l in qleaves)
+
+    def test_weight_only_int8_still_works(self, ctx, trained):
+        from analytics_zoo_tpu.inference import InferenceModel
+        xe, _ = _blobs(32, seed=5)
+        im = InferenceModel().load_keras(trained)
+        ref = np.asarray(im.predict(xe))
+        im8 = InferenceModel().load_keras(trained).quantize("int8")
+        got = np.asarray(im8.predict(xe))
+        assert np.mean(np.argmax(got, -1) == np.argmax(ref, -1)) >= 0.95
+
+    def test_load_zoo_calibration_path(self, ctx, tmp_path):
+        # calibrated int8 must work for models loaded from disk, not just
+        # in-memory load_keras handles
+        from analytics_zoo_tpu.inference import InferenceModel
+        from analytics_zoo_tpu.models import NeuralCF
+        m = NeuralCF(20, 10, 2, user_embed=4, item_embed=4,
+                     hidden_layers=[8], mf_embed=4)
+        m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+        m.save_model(str(tmp_path / "zoo"))
+        rs = np.random.RandomState(0)
+        x = np.stack([rs.randint(1, 21, 16), rs.randint(1, 11, 16)],
+                     1).astype(np.float32)
+        im = InferenceModel().load_zoo(str(tmp_path / "zoo"))
+        ref = np.asarray(im.predict(x))
+        im8 = InferenceModel().load_zoo(str(tmp_path / "zoo"))
+        im8.quantize("int8", calibration_data=[x[:8]])
+        got = np.asarray(im8.predict(x))
+        assert got.shape == ref.shape
+        assert np.mean(np.argmax(got, -1) == np.argmax(ref, -1)) >= 0.9
+
+    def test_opaque_forward_rejects_calibration(self, ctx):
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.inference import InferenceModel
+        im = InferenceModel().load_jax(lambda p, x: x @ p["w"],
+                                      {"w": jnp.eye(4)})
+        with pytest.raises(ValueError, match="keras-graph"):
+            im.quantize("int8", calibration_data=[np.zeros((2, 4))])
