@@ -497,13 +497,77 @@ class Estimator:
                          ) -> Dict[str, float]:
         """Record-weighted average of the captured loss (direct-loss capture
         mode: the loss fn sees the raw batch, so padding cannot be masked).
-        Full batches run sharded; the tail batch runs UNPADDED through the
-        same jitted step — its batch axis is simply replicated over the mesh
-        (one extra compile at the tail shape) — so every record counts and a
-        validation set smaller than one batch still evaluates."""
-        local_batch = min(self.ctx.local_batch(batch_size), val_set.size)
+        Single process: full batches run sharded and the tail runs UNPADDED
+        through the same jitted step (one extra compile at the tail shape) —
+        exact. Multi-process: every host runs the same number of
+        identically-shaped padded steps (batch count agreed by allgather),
+        tail batches weighted by their global valid count — every record
+        counts; see the inline note for the tail-pad approximation."""
+        multiproc = self.ctx.process_count > 1
         ndev = self.mesh.devices.size
+        local_batch = self.ctx.local_batch(batch_size)
+        if not multiproc:
+            # single process may clamp to the data; multi-process must NOT —
+            # local_batch derives from batch_size alone there, so every host
+            # compiles the same global shape regardless of its shard size
+            local_batch = min(local_batch, val_set.size)
         local_batch = max(ndev, (local_batch // ndev) * ndev)
+        if multiproc:
+            # all-hosts-agree padded-tail eval: every host runs the SAME
+            # number of identically-shaped sharded steps (the black-box
+            # direct loss is a global-batch program — per-host early exit
+            # or shape changes would diverge SPMD). The full per-step
+            # valid-count schedule is known upfront on every host, so ONE
+            # allgather (before any data is touched — an empty shard fails
+            # collectively, not with a bare StopIteration leaving peers
+            # hung) exchanges both the batch counts and the weights. Short
+            # hosts re-feed their last batch with valid=0. Tail batches are
+            # weighted by their GLOBAL valid count — the pad rows (repeats
+            # of the last row) leave an O(pad/batch) bias on that one
+            # batch's mean, but every record is counted (previously tails
+            # were silently dropped).
+            import math
+
+            from jax.experimental import multihost_utils as mhu
+            n_local = math.ceil(val_set.size / local_batch)
+            cap = int(np.asarray(mhu.process_allgather(
+                np.asarray([n_local], np.int64))).max())
+            sched = np.zeros(cap + 1, np.int64)
+            sched[0] = n_local
+            for t in range(n_local):
+                sched[t + 1] = min(val_set.size - t * local_batch,
+                                   local_batch)
+            all_sched = np.asarray(mhu.process_allgather(sched)
+                                   ).reshape(self.ctx.process_count, cap + 1)
+            if all_sched[:, 0].min() == 0:
+                raise ValueError(
+                    "a host has an empty validation shard; every process "
+                    "needs at least one batch for the collective eval")
+            n_global = cap
+            v_globals = all_sched[:, 1:].sum(axis=0)  # per-step weights
+            sample = next(val_set.eval_iterator(local_batch,
+                                                pad_remainder=True))
+            self._ensure_initialized(sample[0])
+            if self._direct_eval_step is None:
+                direct = self.direct_eval_loss_fn
+                self._direct_eval_step = jax.jit(
+                    lambda p, s, rng, x, y: direct(p, s, rng, x, y)[0])
+            eval_rng = jax.random.PRNGKey(0)
+            it = val_set.eval_iterator(local_batch, pad_remainder=True)
+            last = None
+            total, weight = 0.0, 0
+            for t in range(n_global):
+                try:
+                    x, y, _ = next(it)
+                    last = (x, y)
+                except StopIteration:
+                    x, y = last
+                xs, ys = shard_batch(self.mesh, (x, y))
+                loss = float(self._direct_eval_step(
+                    self.params, self.model_state, eval_rng, xs, ys))
+                total += loss * int(v_globals[t])
+                weight += int(v_globals[t])
+            return {"loss": total / weight}
         sample = next(val_set.eval_iterator(local_batch, pad_remainder=True))
         self._ensure_initialized(sample[0])
         if self._direct_eval_step is None:
@@ -511,26 +575,20 @@ class Estimator:
             self._direct_eval_step = jax.jit(
                 lambda p, s, rng, x, y: direct(p, s, rng, x, y)[0])
         eval_rng = jax.random.PRNGKey(0)
-        # multi-process: each host's shard has its OWN tail, so running it
-        # unsharded would diverge the SPMD programs across hosts — drop tails
-        # there (full batches only, as before); single-process evaluates the
-        # tail exactly via a replicated-batch compile
-        multiproc = self.ctx.process_count > 1
         total, weight = 0.0, 0
         for x, y, valid in val_set.eval_iterator(local_batch,
                                                  pad_remainder=False):
             if valid == local_batch:
                 x, y = shard_batch(self.mesh, (x, y))
-            elif multiproc:
-                continue
+            # single-process: the tail evaluates exactly via a
+            # replicated-batch compile at its true size
             loss = float(self._direct_eval_step(
                 self.params, self.model_state, eval_rng, x, y))
             total += loss * valid
             weight += valid
         if weight == 0:
             raise ValueError(
-                f"validation set smaller than one batch ({val_set.size} < "
-                f"{local_batch}) on a multi-host run; reduce batch_size")
+                f"validation set is empty ({val_set.size} records)")
         return {"loss": total / weight}
 
     # -- predict (TFNet/Predictable equivalent) -------------------------------

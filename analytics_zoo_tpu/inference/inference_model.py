@@ -29,6 +29,46 @@ from .quantize import dequantize_params, quantize_params
 _BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
 
 
+class _TextArtifact:
+    """A raw-StableHLO AOT artifact (TF-imported models, export_compiled's
+    ``stablehlo_text`` format): compiled straight through PJRT on first
+    call — serving needs neither TF nor the exporting process.
+
+    ``output_keys``: for dict-output signatures, the names matching the
+    program's flat result order (tf.nest flattens dicts by sorted key), so
+    the artifact path returns the SAME dict shape as the live call_tf
+    path."""
+
+    def __init__(self, text: str, n_outputs: int, output_keys=None):
+        self._text = text
+        self._n = n_outputs
+        self._keys = list(output_keys) if output_keys else None
+        self._exe = None
+        self._lock = threading.Lock()
+
+    def _compile(self):
+        from jax._src.interpreters import mlir as jmlir
+        from jax._src.lib import _jax, xla_client as xc
+        from jax._src.lib.mlir import ir as mlir_ir
+        client = jax.devices()[0].client
+        with jmlir.make_ir_context():
+            module = mlir_ir.Module.parse(self._text)
+            return client.compile_and_load(
+                module, _jax.DeviceList(tuple(jax.devices()[:1])),
+                xc.CompileOptions(), [])
+
+    def call(self, *args):
+        with self._lock:
+            if self._exe is None:
+                self._exe = self._compile()
+        bufs = [jax.device_put(np.asarray(a)) for a in args]
+        res = self._exe.execute_sharded(bufs)
+        outs = [a[0] for a in res.disassemble_into_single_device_arrays()]
+        if self._keys is not None:
+            return dict(zip(self._keys, outs))
+        return outs[0] if self._n == 1 else tuple(outs)
+
+
 def _bucket(n: int) -> int:
     for b in _BUCKETS:
         if n <= b:
@@ -53,6 +93,11 @@ class InferenceModel:
         instead of racing to build separate wrappers."""
         self._forward = forward
         self._jit = jax.jit(forward)
+        # loader-specific side channels die with the forward they belong
+        # to — a reused InferenceModel must not export a PREVIOUS model
+        self._savedmodel_ir = None
+        self._keras_model = None
+        self._keras_state = None
 
     @staticmethod
     def _device(tree):
@@ -115,8 +160,15 @@ class InferenceModel:
 
     def load_savedmodel(self, path: str, signature: str = "serving_default"
                         ) -> "InferenceModel":
-        """TF SavedModel via ``jax2tf.call_tf`` (≙ doLoadTF SavedModel,
-        ``TFNetForInference.scala``). Requires tensorflow at runtime."""
+        """TF SavedModel import (≙ doLoadTF SavedModel,
+        ``TFNetForInference.scala``). The signature is wrapped with
+        ``jax2tf.call_tf`` and predict()'s jit EMBEDS the lowered TF
+        computation into the XLA program — TF runs at trace time (once per
+        shape bucket), not per request. For serving with no TF dependency
+        at all, round-trip to a serialized artifact:
+        ``load_savedmodel(p).export_compiled(dir, example)`` then serve via
+        ``load_compiled(dir)`` (pure StableHLO; tested TF-free in
+        ``tests/test_capture_inference.py``)."""
         import tensorflow as tf  # gated import
         from jax.experimental import jax2tf
         loaded = tf.saved_model.load(path)
@@ -134,9 +186,21 @@ class InferenceModel:
                 return next(iter(out.values()))
             return out
 
+        def stablehlo_ir(shaped):
+            """Lower the signature at concrete shapes via TF's own XLA
+            bridge — raw StableHLO text, no call_tf effect, serializable
+            (export_compiled's TF-free artifact path)."""
+            jfn = tf.function(positional_fn, jit_compile=True)
+            specs = [tf.TensorSpec(np.asarray(a).shape,
+                                   tf.as_dtype(np.asarray(a).dtype))
+                     for a in shaped]
+            return str(jfn.experimental_get_compiler_ir(*specs)(
+                stage="stablehlo"))
+
         self._set_forward(forward)
         self._params = {}
         self._keep_alive = loaded
+        self._savedmodel_ir = stablehlo_ir
         return self
 
     def load_onnx(self, path: str) -> "InferenceModel":
@@ -249,6 +313,32 @@ class InferenceModel:
         file_io.makedirs(path, exist_ok=True)
         multi = isinstance(example, (list, tuple))
         xs = [np.asarray(a) for a in (example if multi else [example])]
+        if getattr(self, "_savedmodel_ir", None) is not None:
+            # TF-imported model: the artifact is the TF-side StableHLO
+            # lowering itself (raw text per bucket) — serving it never
+            # touches TF (jax.export can't serialize call_tf's effect)
+            y = self._forward(self._params, xs if multi else xs[0])
+            n_out = (len(jax.tree_util.tree_leaves(y))
+                     if isinstance(y, (dict, list, tuple)) else 1)
+            # dict outputs keep their names: XLA's flat result order is
+            # tf.nest's flatten order (sorted keys)
+            out_keys = sorted(y.keys()) if isinstance(y, dict) else None
+            for b in sorted(batch_sizes):
+                shaped = [np.repeat(a[:1], b, axis=0) for a in xs]
+                text = self._savedmodel_ir(shaped)
+                with file_io.fopen(
+                        file_io.join(path, f"batch-{b}.stablehlo.txt"),
+                        "w") as f:
+                    f.write(text)
+            with file_io.fopen(file_io.join(path, "aot_meta.json"),
+                               "w") as f:
+                f.write(json.dumps({"batch_sizes": sorted(batch_sizes),
+                                    "multi": multi,
+                                    "format": "stablehlo_text",
+                                    "n_outputs": n_out,
+                                    "output_keys": out_keys,
+                                    "platforms": list(platforms)}))
+            return self
         params = self._params
         fwd = self._forward
         # mirror predict()'s calling convention exactly: a list input stays
@@ -282,10 +372,19 @@ class InferenceModel:
         with file_io.fopen(file_io.join(path, "aot_meta.json")) as f:
             meta = json.loads(f.read())
         arts = {}
-        for b in meta["batch_sizes"]:
-            with file_io.fopen(file_io.join(path, f"batch-{b}.stablehlo"),
-                               "rb") as f:
-                arts[b] = jex.deserialize(f.read())
+        if meta.get("format") == "stablehlo_text":
+            for b in meta["batch_sizes"]:
+                with file_io.fopen(
+                        file_io.join(path, f"batch-{b}.stablehlo.txt")) as f:
+                    arts[b] = _TextArtifact(f.read(),
+                                            int(meta.get("n_outputs", 1)),
+                                            meta.get("output_keys"))
+        else:
+            for b in meta["batch_sizes"]:
+                with file_io.fopen(
+                        file_io.join(path, f"batch-{b}.stablehlo"),
+                        "rb") as f:
+                    arts[b] = jex.deserialize(f.read())
         self._aot = arts
         self._aot_multi = bool(meta["multi"])
         return self

@@ -68,3 +68,43 @@ def failing_worker(_workdir: str) -> int:
     multihost_utils.process_allgather(np.float32(1.0))  # blocks forever
     time.sleep(600)
     return 0
+
+
+def direct_eval_tail_worker(workdir: str) -> int:
+    """Multi-host direct-loss eval must COUNT tail records (previously
+    dropped): 2 hosts x 2 devices, per-host val shard of 11 rows with
+    local_batch 4 -> 3 padded steps, global weight 22."""
+    import jax
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.estimator import Estimator
+    from analytics_zoo_tpu.feature import FeatureSet
+    from analytics_zoo_tpu.keras import optimizers
+
+    ctx = init_tpu_context()
+    assert ctx.process_count == 2
+
+    def direct_loss(params, state, rng, x, y):
+        pred = x @ params["w"]
+        return jnp.mean((pred[:, 0] - y) ** 2), state
+
+    # UNEVEN shards (11 vs 5 rows), neither divisible by the local batch:
+    # host 0 has more batches than host 1, so host 1 exercises the
+    # StopIteration re-feed (valid=0) branch while host 0 still has data
+    n = 11 if ctx.process_index == 0 else 5
+    rs = np.random.RandomState(ctx.process_index)
+    x = rs.randn(n, 3).astype(np.float32)
+    y = rs.randn(n).astype(np.float32)
+    fs = FeatureSet.from_ndarrays(x, y, shuffle=False, shard=False)
+    est = Estimator(model=None, loss_fn=None,
+                    optimizer=optimizers.SGD(0.1),
+                    direct_loss_fn=direct_loss)
+    est.params = jax.device_put({"w": jnp.ones((3, 1), jnp.float32)})
+    est.model_state = {}
+    est._state_resolved = True
+    result = est.evaluate(fs, batch_size=8)  # local_batch 4 after division
+    assert np.isfinite(result["loss"])
+    with open(os.path.join(workdir, f"eval_{ctx.process_index}.json"),
+              "w") as f:
+        json.dump({"loss": float(result["loss"])}, f)
+    return 0

@@ -92,3 +92,19 @@ class TestSubmitCLI:
         assert "ZOO_TPU_NPROCS, value: '3'" in out
         assert "zoo:v1" in out
         assert "'--epochs', '2'" in out
+
+
+class TestMultiHostDirectEval:
+    def test_direct_eval_counts_tails(self, tmp_path):
+        launcher = PodLauncher(num_processes=2, devices_per_process=2,
+                               platform="cpu",
+                               log_dir=os.path.join(str(tmp_path), "logs"))
+        launcher.run("tests.pod_workers:direct_eval_tail_worker",
+                     args=[str(tmp_path)], timeout=300)
+        import json
+        losses = []
+        for rank in range(2):
+            with open(os.path.join(str(tmp_path), f"eval_{rank}.json")) as f:
+                losses.append(json.load(f)["loss"])
+        # one logical eval: both hosts must agree on the weighted loss
+        assert losses[0] == pytest.approx(losses[1])
