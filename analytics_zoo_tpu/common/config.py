@@ -121,3 +121,8 @@ _global_config.register("version_check", False,
 _global_config.register("data.prefetch", 2, "Device-feed prefetch depth.")
 _global_config.register("mesh.data_axis", "data", "Default data-parallel mesh axis name.")
 _global_config.register("mesh.model_axis", "model", "Default model-parallel mesh axis name.")
+_global_config.register("rng.impl", "",
+                        "JAX PRNG implementation for estimator rng streams "
+                        "('' = default threefry; 'rbg'/'unsafe_rbg' use the "
+                        "TPU hardware RNG — faster bit generation, streams "
+                        "differ from threefry's).")

@@ -95,9 +95,53 @@ def main():
     for nm, gf, gr in zip("dq dk dv".split(), gl, glr):
         ok &= check(f"lse-cotangent {nm}", gf, gr, 4e-2)
 
+    ok &= check_fused_short()
     print("ALL OK" if ok else "FAILURES PRESENT")
     return 0 if ok else 1
 
+
+def check_fused_short():
+    """Fused short-seq kernel (non-causal, bias, dropout determinism)."""
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.ops.attention import fused_short_attention
+    rs = np.random.RandomState(1)
+    ok = True
+    for (b, h, s, d) in [(8, 4, 128, 64), (2, 12, 256, 64), (4, 2, 384, 32)]:
+        q, k, v = (jnp.asarray(rs.randn(b, h, s, d) * 0.4, jnp.bfloat16)
+                   for _ in range(3))
+        kb = jnp.asarray(np.where(rs.rand(b, s) > 0.2, 0.0, -30.0),
+                         np.float32)
+        ref = dot_product_attention(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), bias=kb[:, None, None, :])
+        got = fused_short_attention(q, k, v, key_bias=kb)
+        ok &= check(f"fused fwd b{b} s{s}", got, ref, 2e-2)
+        gf = jax.grad(lambda q, k, v: jnp.sum(
+            fused_short_attention(q, k, v, key_bias=kb).astype(jnp.float32)
+            * 0.01), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(dot_product_attention(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), bias=kb[:, None, None, :]) * 0.01),
+            argnums=(0, 1, 2))(q, k, v)
+        for nm, a, bb in zip("dq dk dv".split(), gf, gr):
+            ok &= check(f"fused {nm} b{b} s{s}", a, bb, 4e-2)
+    # dropout: deterministic per rng, different across rngs, grads finite
+    b, h, s, d = 4, 4, 128, 64
+    q, k, v = (jnp.asarray(rs.randn(b, h, s, d) * 0.4, jnp.bfloat16)
+               for _ in range(3))
+    rng = jax.random.PRNGKey(7)
+    o1 = fused_short_attention(q, k, v, dropout_rate=0.1, dropout_rng=rng)
+    o2 = fused_short_attention(q, k, v, dropout_rate=0.1, dropout_rng=rng)
+    det = bool(jnp.all(o1 == o2))
+    dif = not bool(jnp.all(o1 == fused_short_attention(
+        q, k, v, dropout_rate=0.1, dropout_rng=jax.random.PRNGKey(8))))
+    g = jax.grad(lambda q: jnp.sum(fused_short_attention(
+        q, k, v, dropout_rate=0.1, dropout_rng=rng).astype(jnp.float32)))(q)
+    fin = bool(jnp.all(jnp.isfinite(g.astype(jnp.float32))))
+    print(("OK " if det and dif and fin else "FAIL")
+          + f" fused dropout det={det} dif={dif} finite={fin}")
+    return ok and det and dif and fin
 
 if __name__ == "__main__":
     sys.exit(main())
