@@ -107,7 +107,8 @@ class MultiHeadAttention(Layer):
         if training and self.attn_drop > 0.0 and rng is not None:
             rng, drop_rng = jax.random.split(rng)
         from ...ops.attention import (
-            fused_short_applicable, fused_short_attention)
+            FUSED_SHORT_MAX_SEQ, fused_short_applicable,
+            fused_short_attention)
         if (self.use_flash
                 and fused_short_applicable(q.shape[-2], k.shape[-2],
                                            self.causal)):
@@ -124,7 +125,7 @@ class MultiHeadAttention(Layer):
             # short sequences: the materialized prob matrix is small and the
             # fused-softmax path wins; long ones: streaming + per-block
             # dropout (measured cutover ~512 on v5e)
-            if self.use_flash and k.shape[-2] >= 512:
+            if self.use_flash and k.shape[-2] > FUSED_SHORT_MAX_SEQ:
                 # streaming attention with per-block dropout: never
                 # materializes the [q, kv] probability matrix (equals
                 # post-softmax dropout exactly — see blockwise_attention)
@@ -136,11 +137,11 @@ class MultiHeadAttention(Layer):
                 ctx = dot_product_attention(
                     q, k, v, bias=bias, causal=self.causal,
                     dropout_rate=self.attn_drop, dropout_rng=drop_rng)
-        elif self.use_flash and k.shape[-2] >= 512:
-            # same cutover as the dropout path: below ~512 the materialized
-            # prob matrix is small and XLA's fused softmax chain beats the
-            # pallas kernel (measured 0.9ms vs 1.5ms fwd+bwd per call at
-            # the BERT-base shape b128 h12 s128)
+        elif self.use_flash and k.shape[-2] > FUSED_SHORT_MAX_SEQ:
+            # one shared cutover constant: at or below it the fused short
+            # kernel (or, when inapplicable, XLA's fused softmax chain —
+            # measured 0.9ms vs 1.5ms fwd+bwd per call at the BERT-base
+            # shape) beats the streaming flash kernels
             ctx = flash_attention(q, k, v, bias=bias, causal=self.causal)
         else:
             ctx = dot_product_attention(q, k, v, bias=bias, causal=self.causal)

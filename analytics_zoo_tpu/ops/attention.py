@@ -539,8 +539,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
 # the backward so the recomputed mask matches the forward's).
 
 
-def _fused_short_fwd_kernel(*refs, scale2: float, has_bias: bool,
-                            rate: float):
+def _fused_short_fwd_kernel(*refs, has_bias: bool, rate: float):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -682,7 +681,7 @@ def _fused_short_call(q, k, v, key_bias, scale, rate, seed, fwd=True,
         dimension_semantics=("parallel",))
     if fwd:
         out = pl.pallas_call(
-            functools.partial(_fused_short_fwd_kernel, scale2=scale,
+            functools.partial(_fused_short_fwd_kernel,
                               has_bias=has_bias, rate=rate),
             out_shape=_vma_struct((bh, s, d), q.dtype, q),
             grid=(bh // G,), in_specs=in_specs, out_specs=tile,

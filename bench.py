@@ -481,7 +481,7 @@ def bench_longseq(batch_size: int = 4, heads: int = 8, seq: int = 4096,
 
     grad_fn = jax.grad(loss, argnums=(0, 1, 2))
 
-    def chained(q, k, v, eps):
+    def chained(q, k, v, eps, n):
         # every step's inputs depend on the previous step's grads so the
         # scan measures SERIAL step latency, and the result is reduced to a
         # scalar whose host readback is the only reliable completion fence
@@ -492,23 +492,35 @@ def bench_longseq(batch_size: int = 4, heads: int = 8, seq: int = 4096,
             dq, dk, dv = grad_fn(cq, ck, cv)
             return (cq + eps * dq, ck + eps * dk, cv + eps * dv), ()
 
-        (q, k, v), _ = jax.lax.scan(body, (q, k, v), None, length=steps)
+        (q, k, v), _ = jax.lax.scan(body, (q, k, v), None, length=n)
         return jnp.sum(q.astype(jnp.float32))
 
     eps = jnp.bfloat16(0.0)
-    compiled = jax.jit(chained).lower(q, k, v, eps).compile()
     # analytic FLOPs: XLA's cost analysis can't see inside the pallas custom
     # calls. One causal [S, S, D] matmul = B*H*S^2*D FLOPs (2x for MAC, /2
     # for the causal half). The kernels run 9 such matmuls per step: fwd
     # (s, p@v), dq pass (s, dp, dq), dkv pass (s, dv, dp, dk).
     flops = 9 * batch_size * heads * seq * seq * head_dim
-    for _ in range(max(1, warmup // 2)):
-        float(compiled(q, k, v, eps))
-    # subtract the tunnel's scalar-readback floor (measured, not assumed)
-    rpc = _rpc_floor()
-    total = min(_timed(lambda: float(compiled(q, k, v, eps)))
-                for _ in range(2))
-    elapsed = max(total - rpc, 1e-9)
+    # differenced timing — t(2N) − t(N) cancels the tunnel's noisy 0.1-2s
+    # dispatch latency exactly (the rpc-floor subtraction used before left
+    # ±30% run-to-run scatter)
+    del warmup
+    c1 = jax.jit(lambda q, k, v, e: chained(q, k, v, e, steps)
+                 ).lower(q, k, v, eps).compile()
+    c2 = jax.jit(lambda q, k, v, e: chained(q, k, v, e, 2 * steps)
+                 ).lower(q, k, v, eps).compile()
+    float(c1(q, k, v, eps)); float(c2(q, k, v, eps))
+    elapsed = None
+    for _attempt in range(3):
+        t1 = min(_timed(lambda: float(c1(q, k, v, eps))) for _ in range(3))
+        t2 = min(_timed(lambda: float(c2(q, k, v, eps))) for _ in range(3))
+        if t2 - t1 > 1e-4:  # the N extra steps must dominate the jitter
+            elapsed = t2 - t1
+            break
+    if elapsed is None:
+        raise RuntimeError(
+            f"differenced timing collapsed (t1={t1:.4f} t2={t2:.4f}): "
+            "tunnel jitter exceeded the compute delta; rerun")
     tokens = batch_size * seq
     return _BenchResult(
         metric="longseq_attention_tokens_per_sec",
@@ -557,10 +569,13 @@ def bench_quantized(batch_size: int = 32, steps: int = 30, warmup: int = 3):
         c2 = jax.jit(lambda p, x, e: chained(p, x, e, 2 * steps)
                      ).lower(p, x, eps).compile()
         float(c1(p, x, eps)); float(c2(p, x, eps))
-        t1 = min(_timed(lambda: float(c1(p, x, eps))) for _ in range(2))
-        t2 = min(_timed(lambda: float(c2(p, x, eps))) for _ in range(2))
-        dev = max(t2 - t1, 1e-9)
-        return round(batch_size * steps / dev, 1)
+        for _attempt in range(3):
+            t1 = min(_timed(lambda: float(c1(p, x, eps))) for _ in range(2))
+            t2 = min(_timed(lambda: float(c2(p, x, eps))) for _ in range(2))
+            if t2 - t1 > 1e-4:
+                return round(batch_size * steps / (t2 - t1), 1)
+        raise RuntimeError(
+            f"differenced timing collapsed (t1={t1:.4f} t2={t2:.4f})")
 
     fp32 = measure(InferenceModel().load_keras(model, params, state))
     b16 = measure(InferenceModel().load_keras(model, params, state)
