@@ -174,3 +174,59 @@ def load_torch(model, module_or_path, strict: bool = True):
     if hasattr(sd, "state_dict"):
         sd = sd.state_dict()
     return load_torch_state_dict(model, sd, strict=strict)
+
+
+def torchvision_resnet18(num_classes: int = 1000):
+    """A torchvision-compatible ResNet-18 built from plain ``torch.nn``
+    (torchvision itself is not a dependency): module DEFINITION ORDER
+    matches torchvision's, so real published ``resnet18`` state_dicts load
+    into it — and its state_dict imports into the native
+    ``resnet(18, padding_mode="torch")`` graph bit-faithfully (the golden
+    test and the pretrained-import example both build their reference from
+    here)."""
+    import torch
+    from torch import nn
+
+    class BasicBlock(nn.Module):
+        def __init__(self, cin, cout, stride=1):
+            super().__init__()
+            self.conv1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+            self.bn1 = nn.BatchNorm2d(cout)
+            self.relu = nn.ReLU(inplace=True)
+            self.conv2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+            self.bn2 = nn.BatchNorm2d(cout)
+            self.downsample = None
+            if stride != 1 or cin != cout:
+                self.downsample = nn.Sequential(
+                    nn.Conv2d(cin, cout, 1, stride, bias=False),
+                    nn.BatchNorm2d(cout))
+
+        def forward(self, x):
+            idt = x if self.downsample is None else self.downsample(x)
+            out = self.bn2(self.conv2(self.relu(self.bn1(self.conv1(x)))))
+            return self.relu(out + idt)
+
+    class ResNet18(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+            self.bn1 = nn.BatchNorm2d(64)
+            self.relu = nn.ReLU(inplace=True)
+            self.maxpool = nn.MaxPool2d(3, 2, 1)
+            self.layer1 = nn.Sequential(BasicBlock(64, 64),
+                                        BasicBlock(64, 64))
+            self.layer2 = nn.Sequential(BasicBlock(64, 128, 2),
+                                        BasicBlock(128, 128))
+            self.layer3 = nn.Sequential(BasicBlock(128, 256, 2),
+                                        BasicBlock(256, 256))
+            self.layer4 = nn.Sequential(BasicBlock(256, 512, 2),
+                                        BasicBlock(512, 512))
+            self.avgpool = nn.AdaptiveAvgPool2d(1)
+            self.fc = nn.Linear(512, num_classes)
+
+        def forward(self, x):
+            x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+            x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+            return self.fc(self.avgpool(x).flatten(1))
+
+    return ResNet18()

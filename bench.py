@@ -378,6 +378,17 @@ def bench_input_pipeline(batch_size: int = 256, steps: int = 30):
         / std.astype(jnp.bfloat16))
     dev_rate = run(FeatureSet.from_ndarrays(raw, labels, shuffle=True),
                    device_fn=dev_norm)
+
+    # host-only rate (no device transfer): what the shuffle+gather path can
+    # sustain — on a direct-attached chip THIS is the number that must beat
+    # the model's consumption, the wire rates above are tunnel-bound
+    host_fs2 = FeatureSet.from_ndarrays(raw, labels, shuffle=True)
+    it = host_fs2.train_iterator(batch_size)
+    next(it)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        next(it)
+    host_only_rate = batch_size * steps / (time.perf_counter() - t0)
     return _BenchResult(
         metric="input_pipeline_images_per_sec",
         value=round(dev_rate, 1),
@@ -385,6 +396,7 @@ def bench_input_pipeline(batch_size: int = 256, steps: int = 30):
         detail={"batch_size": batch_size, "image": "224x224x3",
                 "device_normalize_uint8_transfer": round(dev_rate, 1),
                 "host_normalize_f32_transfer": round(host_rate, 1),
+                "host_only_shuffle_gather": round(host_only_rate, 1),
                 "includes": "shuffle+gather+device_put+normalize",
                 "note": "bench-host bound: absolute rate tracks the TPU "
                         "tunnel's transfer bandwidth, which varies run to "

@@ -13,6 +13,7 @@ EXAMPLES = [
     "examples/recommendation/ncf_example.py",
     "examples/recommendation/wide_and_deep_example.py",
     "examples/imageclassification/resnet_transfer.py",
+    "examples/imageclassification/pretrained_import.py",
     "examples/textclassification/bert_classifier_example.py",
     "examples/tfrecord/tfrecord_train.py",
     "examples/serving/serving_example.py",
@@ -32,9 +33,15 @@ EXAMPLES = [
 ]
 
 
+# examples whose --smoke path needs an optional extra (pyproject extras)
+_NEEDS = {"examples/imageclassification/pretrained_import.py": "torch"}
+
+
 @pytest.mark.parametrize("script", EXAMPLES, ids=[os.path.basename(p)
                                                   for p in EXAMPLES])
 def test_example_smoke(script):
+    if script in _NEEDS:
+        pytest.importorskip(_NEEDS[script])
     env = dict(os.environ)
     # examples assume `pip install analytics-zoo-tpu`; in-tree CI runs them
     # against the checkout instead

@@ -19,63 +19,11 @@ torch = pytest.importorskip("torch")
 nn = torch.nn
 
 
-def _torch_resnet18(num_classes=10):
-    """torchvision-compatible ResNet-18 (BasicBlock), matching module
-    definition order so state_dict ordering equals torchvision's."""
-
-    class BasicBlock(nn.Module):
-        def __init__(self, cin, cout, stride=1):
-            super().__init__()
-            self.conv1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
-            self.bn1 = nn.BatchNorm2d(cout)
-            self.relu = nn.ReLU(inplace=True)
-            self.conv2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
-            self.bn2 = nn.BatchNorm2d(cout)
-            self.downsample = None
-            if stride != 1 or cin != cout:
-                self.downsample = nn.Sequential(
-                    nn.Conv2d(cin, cout, 1, stride, bias=False),
-                    nn.BatchNorm2d(cout))
-
-        def forward(self, x):
-            idt = x
-            out = self.relu(self.bn1(self.conv1(x)))
-            out = self.bn2(self.conv2(out))
-            if self.downsample is not None:
-                idt = self.downsample(x)
-            return self.relu(out + idt)
-
-    class ResNet18(nn.Module):
-        def __init__(self):
-            super().__init__()
-            self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
-            self.bn1 = nn.BatchNorm2d(64)
-            self.relu = nn.ReLU(inplace=True)
-            self.maxpool = nn.MaxPool2d(3, 2, 1)
-            self.layer1 = nn.Sequential(BasicBlock(64, 64),
-                                        BasicBlock(64, 64))
-            self.layer2 = nn.Sequential(BasicBlock(64, 128, 2),
-                                        BasicBlock(128, 128))
-            self.layer3 = nn.Sequential(BasicBlock(128, 256, 2),
-                                        BasicBlock(256, 256))
-            self.layer4 = nn.Sequential(BasicBlock(256, 512, 2),
-                                        BasicBlock(512, 512))
-            self.avgpool = nn.AdaptiveAvgPool2d(1)
-            self.fc = nn.Linear(512, num_classes)
-
-        def forward(self, x):
-            x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
-            x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
-            x = self.avgpool(x).flatten(1)
-            return self.fc(x)
-
-    return ResNet18()
-
-
 @pytest.fixture(scope="module")
 def imported():
+    from analytics_zoo_tpu.net.torch_import import torchvision_resnet18
     torch.manual_seed(0)
-    tm = _torch_resnet18(num_classes=10)
+    tm = torchvision_resnet18(num_classes=10)
     # a couple of train-mode passes give the BN running stats non-trivial
     # values, so a stats-transfer bug can't hide behind zeros/ones
     tm.train()
