@@ -468,7 +468,7 @@ def bench_serving(requests: int = 512, batch_size: int = 64):
                         "stage (dispatch and decode overlap it)"})
 
 
-def bench_longseq(batch_size: int = 4, heads: int = 8, seq: int = 4096,
+def bench_longseq(batch_size: int = 8, heads: int = 8, seq: int = 4096,
                   head_dim: int = 64, steps: int = 20, warmup: int = 3):
     """Long-context attention train step (the new long-context capability;
     no reference counterpart — SURVEY §5 notes the reference has none).
@@ -542,6 +542,10 @@ def bench_longseq(batch_size: int = 4, heads: int = 8, seq: int = 4096,
         detail={"batch_size": batch_size, "heads": heads, "seq_len": seq,
                 "head_dim": head_dim, "causal": True,
                 "kernel": "pallas flash fwd + pallas flash bwd (dq; dkv)",
+                "config_note": "batch_size default raised 4->8 in round 3 "
+                               "(fills the kernel grid better); rows in "
+                               "BENCH_r01/r02 measured batch 4 — compare "
+                               "tokens/s per batch row, or MFU",
                 "flops_per_step": flops})
 
 

@@ -164,3 +164,33 @@ class TestChromeTrace:
             m.fit(FeatureSet.from_ndarrays(x, y), batch_size=32, nb_epoch=1)
         spans = [e for e in json.load(open(path)) if e.get("ph") == "X"]
         assert sum(s["name"] == "train_step" for s in spans) == 2
+
+
+class TestRngImplConfig:
+    def test_rng_impl_knob_builds_working_estimator(self):
+        import jax
+        import numpy as np
+
+        from analytics_zoo_tpu.common.config import global_config
+        from analytics_zoo_tpu.estimator import Estimator
+        from analytics_zoo_tpu.feature import FeatureSet
+        from analytics_zoo_tpu.keras import Sequential, objectives, optimizers
+        from analytics_zoo_tpu.keras.layers import Dense, Dropout
+
+        global_config().set("rng.impl", "rbg")
+        try:
+            model = Sequential([Dense(8, name="d1"), Dropout(0.2),
+                                Dense(2, name="d2")])
+            est = Estimator(
+                model=model,
+                loss_fn=objectives.get("sparse_categorical_crossentropy"),
+                optimizer=optimizers.SGD(0.05))
+            assert jax.dtypes.issubdtype(est.root_rng.dtype, jax.dtypes.prng_key)
+            rs = np.random.RandomState(0)
+            x = rs.randn(16, 6).astype(np.float32)
+            y = rs.randint(0, 2, 16).astype(np.float32)
+            r = est.train(FeatureSet.from_ndarrays(x, y), batch_size=8,
+                          epochs=1)
+            assert r["iterations"] >= 1
+        finally:
+            global_config().set("rng.impl", "")
