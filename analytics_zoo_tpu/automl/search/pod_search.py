@@ -16,8 +16,10 @@ from __future__ import annotations
 import os
 import pickle
 import tempfile
+
 from typing import Any, Dict, List, Optional
 
+from ...common.pickling import pickler as _pickler
 from .abstract import TrialOutput
 from .local_search import LocalSearchEngine, _expand_grid, _materialize
 
@@ -85,13 +87,14 @@ class PodSearchEngine(LocalSearchEngine):
                    "configs": configs}
         spool = tempfile.mkdtemp(prefix="zoo_pod_search_")
         try:
-            with open(os.path.join(spool, "payload.pkl"), "wb") as f:
-                pickle.dump(payload, f)
+            blob = _pickler.dumps(payload)
         except Exception as e:
             raise ValueError(
-                "PodSearchEngine needs a picklable trainable (module-level "
-                "fit_fn / model_create_fn) and picklable data; use "
-                f"LocalSearchEngine for closures. Underlying error: {e!r}")
+                "PodSearchEngine needs a serializable trainable and data "
+                f"(cloudpickle covers __main__ functions and closures); "
+                f"underlying error: {e!r}")
+        with open(os.path.join(spool, "payload.pkl"), "wb") as f:
+            f.write(blob)
         from ...cluster.launcher import run_pod
         nprocs = min(self.num_workers, len(configs))
         run_pod("analytics_zoo_tpu.automl.search.pod_search:_pod_worker",

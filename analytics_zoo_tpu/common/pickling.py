@@ -1,0 +1,12 @@
+"""Serialization policy for pod spools (search trials, xshard jobs).
+
+cloudpickle serializes ``__main__``-defined functions and closures — the
+ergonomics Ray gives remote functions — and writes standard pickle wire,
+so workers deserialize with stdlib ``pickle``. Plain pickle is the
+fallback (module-level functions only). Declared as a real dependency in
+pyproject.toml; the fallback covers exotic minimal installs.
+"""
+try:
+    import cloudpickle as pickler  # noqa: F401
+except ImportError:  # pragma: no cover - declared dependency
+    import pickle as pickler  # noqa: F401

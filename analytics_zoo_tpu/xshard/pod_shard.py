@@ -11,17 +11,20 @@ the ``rank::num_workers`` stride of files and spool results through the
 scheme-aware filesystem layer — so the spool (and the input files) can live
 on gs:// for real multi-host pods.
 
-The op chain must be picklable (module-level functions), the same contract
-Ray imposes via cloudpickle.
+The op chain serializes via cloudpickle when available — __main__-defined
+functions and closures work, the same ergonomics Ray provides — falling
+back to plain pickle (module-level functions only).
 """
 from __future__ import annotations
 
 import os
 import pickle
 import tempfile
+
 from typing import Any, Callable, List, Optional, Sequence
 
 from ..common import file_io
+from ..common.pickling import pickler as _pickler
 from .shard import DataShards, _expand
 
 _READERS = {"csv": "read_csv", "json": "read_json", "parquet": "read_parquet"}
@@ -42,7 +45,7 @@ def _xshard_worker(spool: str) -> int:
         for fn, args in job["ops"]:
             shard = fn(shard, *args)
         out.append((idx, shard))
-    payload = pickle.dumps(out)
+    payload = _pickler.dumps(out)
     tmp = file_io.join(spool, f".out_{rank}.pkl")
     with file_io.fopen(tmp, "wb") as f:
         f.write(payload)
@@ -95,7 +98,8 @@ class PodDataShards:
 
     def transform_shard(self, fn: Callable, *args) -> "PodDataShards":
         """Append ``fn(shard, *args)`` to the op chain (lazy — runs in the
-        workers at the next action). ``fn`` must be picklable."""
+        workers at the next action). Lambdas and closures work (cloudpickle
+        serialization, see ``common.pickling``)."""
         return PodDataShards(self.files, self.fmt, self.num_workers,
                              self.reader_kwargs, self.ops + [(fn, args)],
                              self.timeout, self.spool_dir)
@@ -113,11 +117,11 @@ class PodDataShards:
         spool = self.spool_dir or tempfile.mkdtemp(prefix="zoo_xshard_")
         file_io.makedirs(spool)
         try:
-            blob = pickle.dumps(job)
+            blob = _pickler.dumps(job)
         except Exception as e:
             raise ValueError(
-                "PodDataShards needs picklable transforms (module-level "
-                f"functions); use local DataShards for closures: {e!r}")
+                "PodDataShards needs serializable transforms (cloudpickle "
+                f"covers __main__ functions and closures): {e!r}")
         with file_io.fopen(file_io.join(spool, "job.pkl"), "wb") as f:
             f.write(blob)
         from ..cluster.launcher import run_pod

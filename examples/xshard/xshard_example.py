@@ -17,9 +17,17 @@ from analytics_zoo_tpu.keras import Sequential
 from analytics_zoo_tpu.keras.layers import Dense
 
 
+def add_ab(df):
+    """Module-level transform: PodDataShards ships it to worker processes
+    (the same picklability contract Ray imposes on remote functions)."""
+    return df.assign(ab=df["a"] * df["b"])
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--pod", action="store_true",
+                    help="run the transform chain in pod worker processes")
     args = ap.parse_args()
 
     rows_per_file, files = (100, 3) if args.smoke else (20000, 8)
@@ -32,12 +40,17 @@ def main():
                 "label": (x.sum(1) > 1.5).astype(np.float32),
             }).to_csv(os.path.join(d, f"part-{i}.csv"), index=False)
 
-        shards = xshard.read_csv(d)
-        print(f"read {shards.num_partitions()} shards")
-
-        # shard-wise feature engineering, then rebalance
-        shards = shards.apply(
-            lambda df: df.assign(ab=df["a"] * df["b"])).repartition(2)
+        if args.pod:
+            # distributed variant: each pod worker reads + transforms its
+            # stride of files, the driver merges (RayDataShards role)
+            pod = xshard.PodDataShards.read_csv(d, num_workers=2,
+                                                timeout=300)
+            shards = pod.transform_shard(add_ab).to_local().repartition(2)
+        else:
+            shards = xshard.read_csv(d)
+            print(f"read {shards.num_partitions()} shards")
+            # shard-wise feature engineering, then rebalance
+            shards = shards.apply(add_ab).repartition(2)
         total = sum(len(s) for s in shards.collect())
         print(f"{total} rows across {shards.num_partitions()} shards "
               f"after repartition")

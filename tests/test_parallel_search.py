@@ -99,12 +99,25 @@ class TestPodSearch:
         assert len({int(t.metric) for t in trials[0::2]}) == 1
         assert len({int(t.metric) for t in trials[1::2]}) == 1
 
-    def test_unpicklable_rejected(self):
+    def test_lambda_trainable_works_via_cloudpickle(self):
         from analytics_zoo_tpu.automl.search import PodSearchEngine
-        pod = PodSearchEngine(num_workers=2, seed=0)
+        pod = PodSearchEngine(num_workers=2, seed=0, timeout=300)
         pod.compile(data=None, model_create_fn=None, recipe=_GridRecipe(),
+                    metric="mse",
+                    fit_fn=lambda c, d: (c["lr"] - 0.01) ** 2)
+        trials = pod.run()
+        assert pod.get_best_trials(1)[0].config["lr"] == 0.01
+        assert len(trials) == 6
+
+    def test_unserializable_rejected(self):
+        import threading
+
+        from analytics_zoo_tpu.automl.search import PodSearchEngine
+        lock = threading.Lock()
+        pod = PodSearchEngine(num_workers=2, seed=0)
+        pod.compile(data=lock, model_create_fn=None, recipe=_GridRecipe(),
                     metric="mse", fit_fn=lambda c, d: 0.0)
-        with pytest.raises(ValueError, match="picklable"):
+        with pytest.raises(ValueError, match="serializable"):
             pod.run()
 
 

@@ -54,11 +54,20 @@ class TestPodDataShards:
         batch = next(fs.eval_iterator(8, pad_remainder=True))
         assert batch[0].shape == (8, 2)
 
-    def test_unpicklable_transform_rejected(self, tmp_path):
+    def test_lambda_transform_works_via_cloudpickle(self, tmp_path):
+        # cloudpickle ships lambdas/closures to workers (Ray ergonomics)
         path = _write_csvs(tmp_path)
+        out = PodDataShards.read_csv(path, num_workers=2, timeout=300) \
+            .transform_shard(lambda df: df.assign(z=1)).collect()
+        assert all("z" in s.columns for s in out)
+
+    def test_unserializable_transform_rejected(self, tmp_path):
+        import threading
+        path = _write_csvs(tmp_path)
+        lock = threading.Lock()  # not serializable by any pickler
         dist = PodDataShards.read_csv(path, num_workers=2) \
-            .transform_shard(lambda df: df)
-        with pytest.raises(ValueError, match="picklable"):
+            .transform_shard(lambda df, l: df, lock)
+        with pytest.raises(ValueError, match="serializable"):
             dist.collect()
 
     def test_empty_input_rejected(self):
