@@ -61,18 +61,27 @@ class TimeSequencePredictor:
     def fit(self, input_df, validation_df=None,
             recipe: Optional[Recipe] = None, metric: str = "mse",
             search_engine: str = "local", num_workers: Optional[int] = None,
+            search_timeout: Optional[float] = None,
             ) -> TimeSequencePipeline:
         """``search_engine="parallel"`` runs trials in spawned worker
-        processes (the RayTune role); the winning config is then re-fit
-        in-process to build the returned pipeline."""
+        processes on this host; ``"pod"`` strides them across PodLauncher
+        worker processes (the cluster-scale RayTune role). The winning
+        config is then re-fit in-process to build the returned pipeline."""
         recipe = recipe or SmokeRecipe()
         self._best = None
         self._best_score = None
         self._mode = Evaluator.get_metric_mode(metric)
         if search_engine == "parallel":
             engine = ParallelSearchEngine(num_workers=num_workers)
-        else:
+        elif search_engine == "pod":
+            from ..search.pod_search import PodSearchEngine
+            engine = PodSearchEngine(num_workers=num_workers or 2,
+                                     timeout=search_timeout or 3600.0)
+        elif search_engine == "local":
             engine = LocalSearchEngine()
+        else:
+            raise ValueError(f"search_engine must be local/parallel/pod, "
+                             f"got {search_engine!r}")
         ft_probe = TimeSequenceFeatureTransformer(
             self.future_seq_len, self.dt_col, self.target_col,
             self.extra_features_col)

@@ -19,6 +19,7 @@ import tempfile
 
 from typing import Any, Dict, List, Optional
 
+from ...common import pickling
 from ...common.pickling import pickler as _pickler
 from .abstract import TrialOutput
 from .local_search import LocalSearchEngine, _expand_grid, _materialize
@@ -85,30 +86,35 @@ class PodSearchEngine(LocalSearchEngine):
                    "model_create_fn": self.model_create_fn,
                    "data": self.data, "metric": self.metric,
                    "configs": configs}
-        spool = tempfile.mkdtemp(prefix="zoo_pod_search_")
         try:
             blob = _pickler.dumps(payload)
         except Exception as e:
             raise ValueError(
                 "PodSearchEngine needs a serializable trainable and data "
-                f"(cloudpickle covers __main__ functions and closures); "
-                f"underlying error: {e!r}")
-        with open(os.path.join(spool, "payload.pkl"), "wb") as f:
-            f.write(blob)
-        from ...cluster.launcher import run_pod
-        nprocs = min(self.num_workers, len(configs))
-        run_pod("analytics_zoo_tpu.automl.search.pod_search:_pod_worker",
-                nprocs, args=[spool], platform="cpu",
-                timeout=self.timeout)
-        merged: List[Dict[str, Any]] = []
-        for rank in range(nprocs):
-            path = os.path.join(spool, f"results_{rank}.pkl")
-            if not os.path.exists(path):
-                raise RuntimeError(
-                    f"search worker {rank} exited OK but wrote no results "
-                    f"file — {path} missing")
-            with open(path, "rb") as f:
-                merged.extend(pickle.load(f))
+                f"({pickling.capability_note()}); underlying error: {e!r}")
+        # the spool holds a full copy of the training data — always removed,
+        # success or failure (long-lived AutoML hosts must not fill /tmp)
+        spool = tempfile.mkdtemp(prefix="zoo_pod_search_")
+        try:
+            with open(os.path.join(spool, "payload.pkl"), "wb") as f:
+                f.write(blob)
+            from ...cluster.launcher import run_pod
+            nprocs = min(self.num_workers, len(configs))
+            run_pod("analytics_zoo_tpu.automl.search.pod_search:_pod_worker",
+                    nprocs, args=[spool], platform="cpu",
+                    timeout=self.timeout)
+            merged: List[Dict[str, Any]] = []
+            for rank in range(nprocs):
+                path = os.path.join(spool, f"results_{rank}.pkl")
+                if not os.path.exists(path):
+                    raise RuntimeError(
+                        f"search worker {rank} exited OK but wrote no "
+                        f"results file — {path} missing")
+                with open(path, "rb") as f:
+                    merged.extend(pickle.load(f))
+        finally:
+            import shutil
+            shutil.rmtree(spool, ignore_errors=True)
         # submission order == the sequential engine's trial order, so the
         # seed-compatibility contract (identical best config) holds
         merged.sort(key=lambda r: r["index"])

@@ -3,10 +3,20 @@
 cloudpickle serializes ``__main__``-defined functions and closures — the
 ergonomics Ray gives remote functions — and writes standard pickle wire,
 so workers deserialize with stdlib ``pickle``. Plain pickle is the
-fallback (module-level functions only). Declared as a real dependency in
-pyproject.toml; the fallback covers exotic minimal installs.
+fallback (module-level functions only; ``HAVE_CLOUDPICKLE`` tells error
+messages which contract is active). cloudpickle is a declared dependency
+in pyproject.toml; the fallback covers exotic minimal installs.
 """
 try:
     import cloudpickle as pickler  # noqa: F401
+    HAVE_CLOUDPICKLE = True
 except ImportError:  # pragma: no cover - declared dependency
     import pickle as pickler  # noqa: F401
+    HAVE_CLOUDPICKLE = False
+
+
+def capability_note() -> str:
+    return ("cloudpickle covers __main__ functions and closures"
+            if HAVE_CLOUDPICKLE else
+            "plain-pickle fallback active (cloudpickle not installed): "
+            "only module-level functions serialize")

@@ -24,6 +24,7 @@ import tempfile
 from typing import Any, Callable, List, Optional, Sequence
 
 from ..common import file_io
+from ..common import pickling
 from ..common.pickling import pickler as _pickler
 from .shard import DataShards, _expand
 
@@ -114,27 +115,37 @@ class PodDataShards:
     def _run(self) -> List[Any]:
         job = {"files": self.files, "format": self.fmt,
                "reader_kwargs": self.reader_kwargs, "ops": self.ops}
-        spool = self.spool_dir or tempfile.mkdtemp(prefix="zoo_xshard_")
-        file_io.makedirs(spool)
         try:
             blob = _pickler.dumps(job)
         except Exception as e:
             raise ValueError(
-                "PodDataShards needs serializable transforms (cloudpickle "
-                f"covers __main__ functions and closures): {e!r}")
-        with file_io.fopen(file_io.join(spool, "job.pkl"), "wb") as f:
-            f.write(blob)
-        from ..cluster.launcher import run_pod
-        nprocs = min(self.num_workers, len(self.files))
-        run_pod("analytics_zoo_tpu.xshard.pod_shard:_xshard_worker",
-                nprocs, args=[spool], platform="cpu", timeout=self.timeout)
-        indexed: List[Any] = []
-        for rank in range(nprocs):
-            path = file_io.join(spool, f"out_{rank}.pkl")
-            if not file_io.exists(path):
-                raise RuntimeError(f"xshard worker {rank} wrote no output")
-            with file_io.fopen(path, "rb") as f:
-                indexed.extend(pickle.loads(f.read()))
+                "PodDataShards needs serializable transforms "
+                f"({pickling.capability_note()}): {e!r}")
+        # caller-provided spool dirs (e.g. gs:// for multi-host) are the
+        # caller's to manage; auto-created temp spools are always removed
+        own_spool = self.spool_dir is None
+        spool = self.spool_dir or tempfile.mkdtemp(prefix="zoo_xshard_")
+        file_io.makedirs(spool)
+        try:
+            with file_io.fopen(file_io.join(spool, "job.pkl"), "wb") as f:
+                f.write(blob)
+            from ..cluster.launcher import run_pod
+            nprocs = min(self.num_workers, len(self.files))
+            run_pod("analytics_zoo_tpu.xshard.pod_shard:_xshard_worker",
+                    nprocs, args=[spool], platform="cpu",
+                    timeout=self.timeout)
+            indexed: List[Any] = []
+            for rank in range(nprocs):
+                path = file_io.join(spool, f"out_{rank}.pkl")
+                if not file_io.exists(path):
+                    raise RuntimeError(
+                        f"xshard worker {rank} wrote no output")
+                with file_io.fopen(path, "rb") as f:
+                    indexed.extend(pickle.loads(f.read()))
+        finally:
+            if own_spool:
+                import shutil
+                shutil.rmtree(spool, ignore_errors=True)
         indexed.sort(key=lambda t: t[0])  # stable file order
         return [shard for _, shard in indexed]
 

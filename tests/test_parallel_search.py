@@ -178,3 +178,32 @@ class TestWeightedDirectEval:
         result = est.evaluate(fs, batch_size=64)
         assert result["loss"] == pytest.approx(self._expected(est, x, y),
                                                rel=1e-4)
+
+
+class TestPodPredictor:
+    def test_time_sequence_pod_search(self):
+        """AutoTS-style predictor with pod-distributed trials."""
+        import pandas as pd
+
+        from analytics_zoo_tpu.automl import SmokeRecipe, TimeSequencePredictor
+        rs = np.random.RandomState(0)
+        df = pd.DataFrame({
+            "datetime": pd.date_range("2024-01-01", periods=80, freq="h"),
+            "value": np.sin(np.arange(80) / 6) + 0.05 * rs.randn(80),
+        })
+        tsp = TimeSequencePredictor(future_seq_len=1)
+        pipeline = tsp.fit(df, recipe=SmokeRecipe(), metric="mse",
+                           search_engine="pod", num_workers=2)
+        res = pipeline.evaluate(df, metrics=["mse"])
+        assert np.isfinite(res["mse"])
+
+    def test_unknown_engine_rejected(self):
+        import pandas as pd
+
+        from analytics_zoo_tpu.automl import SmokeRecipe, TimeSequencePredictor
+        df = pd.DataFrame({
+            "datetime": pd.date_range("2024-01-01", periods=20, freq="h"),
+            "value": np.arange(20.0)})
+        with pytest.raises(ValueError, match="local/parallel/pod"):
+            TimeSequencePredictor(future_seq_len=1).fit(
+                df, recipe=SmokeRecipe(), search_engine="ray")
