@@ -65,19 +65,27 @@ class TimeSequencePredictor:
             ) -> TimeSequencePipeline:
         """``search_engine="parallel"`` runs trials in spawned worker
         processes on this host; ``"pod"`` strides them across PodLauncher
-        worker processes (the cluster-scale RayTune role). The winning
-        config is then re-fit in-process to build the returned pipeline."""
+        worker processes (the cluster-scale RayTune role), killed after
+        ``search_timeout`` seconds (None = wait indefinitely; only the pod
+        engine supports a timeout). The winning config is then re-fit
+        in-process to build the returned pipeline."""
         recipe = recipe or SmokeRecipe()
         self._best = None
         self._best_score = None
         self._mode = Evaluator.get_metric_mode(metric)
         if search_engine == "parallel":
+            if search_timeout is not None:
+                raise ValueError(
+                    "search_timeout is only supported by the pod engine")
             engine = ParallelSearchEngine(num_workers=num_workers)
         elif search_engine == "pod":
             from ..search.pod_search import PodSearchEngine
             engine = PodSearchEngine(num_workers=num_workers or 2,
-                                     timeout=search_timeout or 3600.0)
+                                     timeout=search_timeout)
         elif search_engine == "local":
+            if search_timeout is not None:
+                raise ValueError(
+                    "search_timeout is only supported by the pod engine")
             engine = LocalSearchEngine()
         else:
             raise ValueError(f"search_engine must be local/parallel/pod, "

@@ -123,7 +123,7 @@ class PodDataShards:
                 f"({pickling.capability_note()}): {e!r}")
         # caller-provided spool dirs (e.g. gs:// for multi-host) are the
         # caller's to manage; auto-created temp spools are always removed
-        own_spool = self.spool_dir is None
+        own_spool = not self.spool_dir
         spool = self.spool_dir or tempfile.mkdtemp(prefix="zoo_xshard_")
         file_io.makedirs(spool)
         try:

@@ -6,11 +6,21 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 rm -rf build dist *.egg-info
+# Prefer non-isolated builds when the ambient env already has setuptools —
+# the isolated build env needs network access to bootstrap, which
+# egress-free build hosts (like this CI) don't have. Fresh venvs without
+# setuptools keep the isolated (networked) path.
+ISOLATION_FLAGS=""
+PIP_ISOLATION=""
+if python -c "import setuptools, wheel" 2>/dev/null; then
+    ISOLATION_FLAGS="--no-isolation"
+    PIP_ISOLATION="--no-build-isolation"
+fi
 if python -c "import build" 2>/dev/null; then
-    python -m build
+    python -m build $ISOLATION_FLAGS
 else
     echo "python-build not installed; building wheel via pip"
-    pip wheel . --no-deps -w dist
+    pip wheel . --no-deps $PIP_ISOLATION -w dist
 fi
 echo "== dist artifacts =="
 ls -l dist/
