@@ -474,21 +474,22 @@ def bench_serving(requests: int = 512, batch_size: int = 64):
                         "stage (dispatch and decode overlap it)"})
 
 
-def bench_longseq(batch_size: int = 8, heads: int = 8, seq: int = 4096,
-                  head_dim: int = 64, steps: int = 20, warmup: int = 3):
-    """Long-context attention train step (the new long-context capability;
-    no reference counterpart — SURVEY §5 notes the reference has none).
-    Runs fwd+bwd through the pallas flash kernel (recompute-based backward)
-    at a sequence length where a materialized [S, S] probability matrix
-    would dominate HBM, and reports tokens/s + MFU."""
+
+
+def _longseq_once(batch_size, heads, seq, head_dim, steps):
+    """One differenced flash train-step measurement; returns a detail dict.
+
+    Each step's inputs depend on the previous step's grads so the scan
+    measures SERIAL step latency; eps is a RUNTIME zero (XLA cannot fold
+    eps*grad away) and the scalar readback is the completion fence. FLOPs
+    are analytic (9 causal-halved [S,S,D] matmuls/step — cost analysis
+    cannot see inside the pallas custom calls)."""
     import jax
     import jax.numpy as jnp
 
-    from analytics_zoo_tpu.common.context import init_tpu_context
     from analytics_zoo_tpu.ops.attention import flash_attention
 
-    init_tpu_context()
-    rs = np.random.RandomState(0)
+    rs = np.random.RandomState(1)
     shape = (batch_size, heads, seq, head_dim)
     q, k, v = (jnp.asarray(rs.randn(*shape).astype(np.float32),
                            jnp.bfloat16) for _ in range(3))
@@ -500,59 +501,70 @@ def bench_longseq(batch_size: int = 8, heads: int = 8, seq: int = 4096,
     grad_fn = jax.grad(loss, argnums=(0, 1, 2))
 
     def chained(q, k, v, eps, n):
-        # every step's inputs depend on the previous step's grads so the
-        # scan measures SERIAL step latency, and the result is reduced to a
-        # scalar whose host readback is the only reliable completion fence
-        # on remote-attached chips (block_until_ready returns at enqueue
-        # there). eps is a RUNTIME zero: XLA cannot fold eps*grad away.
         def body(carry, _):
             cq, ck, cv = carry
             dq, dk, dv = grad_fn(cq, ck, cv)
             return (cq + eps * dq, ck + eps * dk, cv + eps * dv), ()
-
         (q, k, v), _ = jax.lax.scan(body, (q, k, v), None, length=n)
         return jnp.sum(q.astype(jnp.float32))
 
     eps = jnp.bfloat16(0.0)
-    # analytic FLOPs: XLA's cost analysis can't see inside the pallas custom
-    # calls. One causal [S, S, D] matmul = B*H*S^2*D FLOPs (2x for MAC, /2
-    # for the causal half). The kernels run 9 such matmuls per step: fwd
-    # (s, p@v), dq pass (s, dp, dq), dkv pass (s, dv, dp, dk).
     flops = 9 * batch_size * heads * seq * seq * head_dim
-    # differenced timing — t(2N) − t(N) cancels the tunnel's noisy 0.1-2s
-    # dispatch latency exactly (the rpc-floor subtraction used before left
-    # ±30% run-to-run scatter)
-    del warmup
     c1 = jax.jit(lambda q, k, v, e: chained(q, k, v, e, steps)
                  ).lower(q, k, v, eps).compile()
     c2 = jax.jit(lambda q, k, v, e: chained(q, k, v, e, 2 * steps)
                  ).lower(q, k, v, eps).compile()
     float(c1(q, k, v, eps)); float(c2(q, k, v, eps))
-    elapsed = None
-    for _attempt in range(3):
+    for _ in range(3):
         t1 = min(_timed(lambda: float(c1(q, k, v, eps))) for _ in range(3))
         t2 = min(_timed(lambda: float(c2(q, k, v, eps))) for _ in range(3))
-        if t2 - t1 > 1e-4:  # the N extra steps must dominate the jitter
+        if t2 - t1 > 1e-4:
             elapsed = t2 - t1
-            break
-    if elapsed is None:
-        raise RuntimeError(
-            f"differenced timing collapsed (t1={t1:.4f} t2={t2:.4f}): "
-            "tunnel jitter exceeded the compute delta; rerun")
-    tokens = batch_size * seq
+            return {"batch_size": batch_size, "head_dim": head_dim,
+                    "tokens_per_sec": round(batch_size * seq * steps
+                                            / elapsed, 1),
+                    "mfu": _mfu(flops, steps, elapsed)}
+    return {"batch_size": batch_size, "head_dim": head_dim,
+            "error": "differenced timing collapsed"}
+
+
+def bench_longseq(batch_size: int = 8, heads: int = 8, seq: int = 4096,
+                  head_dim: int = 64, steps: int = 20, warmup: int = 3):
+    """Long-context attention train step (the new long-context capability;
+    no reference counterpart — SURVEY §5 notes the reference has none).
+    Runs fwd+bwd through the pallas flash kernel (recompute-based backward)
+    at a sequence length where a materialized [S, S] probability matrix
+    would dominate HBM, and reports tokens/s + MFU. The headline stays at
+    head_dim 64 (comparable with earlier rounds); a second measurement at
+    head_dim 128 — the modern LLM config — rides in the detail (d=64 is
+    VPU-bound by construction: softmax ops per element rival its 2·64 MXU
+    flops, so d=128 roughly doubles achievable MFU)."""
+    from analytics_zoo_tpu.common.context import init_tpu_context
+
+    init_tpu_context()
+    del warmup  # both compiled scan lengths are warmed inside _longseq_once
+    head = _longseq_once(batch_size, heads, seq, head_dim, steps)
+    if "error" in head:
+        raise RuntimeError(f"longseq headline measurement failed: {head}")
+    # optional add-on config: batch halved, head_dim doubled — the SAME
+    # FLOP budget per step (token count halves). Its failure must not
+    # lose the already-measured headline.
+    try:
+        d128 = _longseq_once(batch_size // 2, heads, seq, 128, steps)
+    except Exception as e:
+        d128 = {"error": repr(e)[:200]}
     return _BenchResult(
         metric="longseq_attention_tokens_per_sec",
-        value=round(tokens * steps / elapsed, 1),
+        value=head["tokens_per_sec"],
         unit="tokens/s",
-        mfu=_mfu(flops, steps, elapsed),
+        mfu=head["mfu"],
         detail={"batch_size": batch_size, "heads": heads, "seq_len": seq,
                 "head_dim": head_dim, "causal": True,
+                "head_dim_128": d128,
                 "kernel": "pallas flash fwd + pallas flash bwd (dq; dkv)",
-                "config_note": "batch_size default raised 4->8 in round 3 "
-                               "(fills the kernel grid better); rows in "
-                               "BENCH_r01/r02 measured batch 4 — compare "
-                               "tokens/s per batch row, or MFU",
-                "flops_per_step": flops})
+                "loop": "chained lax.scan, differenced t(2N)-t(N) timing",
+                "flops_per_step": 9 * batch_size * heads * seq * seq
+                * head_dim})
 
 
 def bench_quantized(batch_size: int = 32, steps: int = 30, warmup: int = 3):
