@@ -98,10 +98,20 @@ def fopen(path: str, mode: str = "r", encoding: Optional[str] = None,
     if not is_remote(path):
         return open(local_path(path), mode, **text_kw)
     fs = _fs(path)
-    # object stores generally can't append; a fresh file opened 'a' is just
-    # a write (the TB writer's unique event files land here)
-    if "a" in mode and not fs.exists(str(path)):
+    # Object stores can't append. A fresh file opened 'a' is just a write
+    # (the TB writer's unique event files land here); appending to an
+    # EXISTING remote object would silently truncate or raise depending on
+    # the backend, so fail loudly instead of guessing.
+    if "a" in mode:
+        if fs.exists(str(path)):
+            raise ValueError(
+                f"append mode is not supported on existing remote objects "
+                f"({path!r}): object stores cannot append — write a new "
+                f"object or read-modify-write explicitly")
         mode = mode.replace("a", "w")
+    # NOTE durability contract: buffered remote writes commit at close(), not
+    # at flush() — a crash before close loses the object. Writers that must
+    # survive crashes (SummaryWriter event files) write unique per-open files.
     return fs.open(str(path), mode, **text_kw)
 
 

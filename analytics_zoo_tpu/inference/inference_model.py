@@ -47,10 +47,27 @@ class _TextArtifact:
         self._lock = threading.Lock()
 
     def _compile(self):
-        from jax._src.interpreters import mlir as jmlir
-        from jax._src.lib import _jax, xla_client as xc
-        from jax._src.lib.mlir import ir as mlir_ir
+        # Raw-StableHLO execution has no public jax surface yet; this leans
+        # on jax internals and is feature-checked so a jax upgrade fails with
+        # a clear message instead of an AttributeError mid-serving.
+        try:
+            from jax._src.interpreters import mlir as jmlir
+            from jax._src.lib import _jax, xla_client as xc
+            from jax._src.lib.mlir import ir as mlir_ir
+        except ImportError as e:  # pragma: no cover - version drift guard
+            raise RuntimeError(
+                "this jax version moved the internal StableHLO-compile "
+                "surface the AOT text-artifact loader relies on; pin jax to "
+                "a tested release or re-export the model with jax.export"
+            ) from e
         client = jax.devices()[0].client
+        if not (hasattr(client, "compile_and_load")
+                and hasattr(_jax, "DeviceList")
+                and hasattr(xc, "CompileOptions")):  # pragma: no cover
+            raise RuntimeError(
+                "jax internals moved (compile_and_load/DeviceList/"
+                "CompileOptions); this jax version is incompatible with the "
+                "raw-StableHLO loader — pin jax or re-export with jax.export")
         with jmlir.make_ir_context():
             module = mlir_ir.Module.parse(self._text)
             return client.compile_and_load(

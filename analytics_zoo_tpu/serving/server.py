@@ -289,17 +289,43 @@ class ClusterServing:
 
     def start(self) -> "ClusterServing":
         """Run the loop in a background thread (the spark-submit long-running
-        job role)."""
+        job role). A crash in the loop is captured and re-raised from
+        :meth:`stop` / :meth:`check_health` — a dead queue backend must not
+        kill the server silently."""
         self._stop.clear()
-        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._background_error: Optional[BaseException] = None
+
+        def _run() -> None:
+            try:
+                self.run()
+            except BaseException as e:
+                logger.exception("serving loop died")
+                self._background_error = e
+
+        self._thread = threading.Thread(target=_run, daemon=True)
         self._thread.start()
         return self
+
+    def check_health(self) -> None:
+        """Raise the background loop's failure, if any (liveness probe for
+        supervisors driving :meth:`start`)."""
+        err = getattr(self, "_background_error", None)
+        if err is not None:
+            raise RuntimeError("serving loop died in the background") from err
 
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+            if self._thread.is_alive():
+                # a wedged backend (claim blocked on a dead connection) is as
+                # dead as a crashed one — don't report a clean shutdown
+                self._thread = None
+                raise RuntimeError(
+                    "serving loop did not shut down within 10s (queue "
+                    "backend wedged?); thread leaked")
             self._thread = None
+        self.check_health()
 
 
 def main() -> None:

@@ -13,6 +13,7 @@ TFRecord frame layout:
 """
 from __future__ import annotations
 
+import os
 import queue
 import socket
 import struct
@@ -202,7 +203,11 @@ class SummaryWriter:
     def __init__(self, logdir: str, flush_secs: float = 2.0):
         file_io.makedirs(logdir, exist_ok=True)
         self.logdir = logdir
-        fname = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
+        # pid suffix: two writers on one host in the same second (crash-loop
+        # restarts) must not collide — remote fopen refuses to append to an
+        # existing object
+        fname = (f"events.out.tfevents.{int(time.time())}."
+                 f"{socket.gethostname()}.{os.getpid()}")
         self.path = file_io.join(logdir, fname)
         self._queue: "queue.Queue[Optional[bytes]]" = queue.Queue()
         self._file = file_io.fopen(self.path, "ab")
