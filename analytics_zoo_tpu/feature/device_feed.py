@@ -35,13 +35,14 @@ def _put_until_stopped(q: "queue.Queue", stop: threading.Event,
 
 
 def _produce(it: Iterator[Any], mesh: Mesh, q: "queue.Queue",
-             stop: threading.Event, errbox: List[BaseException]) -> None:
+             stop: threading.Event, errbox: List[BaseException],
+             shard_fn) -> None:
     # module-level on purpose: the thread must NOT hold a reference to the
     # DeviceFeed, or an abandoned feed could never be garbage-collected and
     # its __del__-triggered stop would never fire
     try:
         for batch in it:
-            if not _put_until_stopped(q, stop, shard_batch(mesh, batch)):
+            if not _put_until_stopped(q, stop, shard_fn(mesh, batch)):
                 return
     except BaseException as e:  # surfaced on the consumer side
         errbox.append(e)
@@ -62,7 +63,7 @@ class DeviceFeed:
     """
 
     def __init__(self, host_iterator: Iterator[Any], mesh: Mesh,
-                 prefetch: Optional[int] = None):
+                 prefetch: Optional[int] = None, shard_fn=None):
         depth = prefetch if prefetch is not None \
             else global_config().get("data.prefetch")
         self._queue: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
@@ -70,7 +71,8 @@ class DeviceFeed:
         self._errbox: List[BaseException] = []
         self._thread = threading.Thread(
             target=_produce,
-            args=(host_iterator, mesh, self._queue, self._stop, self._errbox),
+            args=(host_iterator, mesh, self._queue, self._stop, self._errbox,
+                  shard_fn if shard_fn is not None else shard_batch),
             daemon=True, name="device-feed")
         self._thread.start()
 

@@ -453,32 +453,179 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
-                      q_block: int, kv_block: int, glse=None):
-    """Full flash backward on TPU: recomputes p from the saved logsumexp in
-    two gridded passes (dq; dk+dv), all matmuls in the storage dtype with
-    f32 accumulation."""
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                            gl_ref, dq_ref, dk_ref, dv_ref, dq_acc, *,
+                            scale: float, causal: bool, bq: int, bk: int,
+                            kv_len: int):
+    """Single-pass flash backward: K and V ride fully VMEM-resident per
+    batch·head; dk/dv accumulate in the f32 output refs across the q sweep
+    (their block index is constant within a batch·head, so Mosaic keeps the
+    window in VMEM — the standard matmul-accumulator pattern); dq finishes
+    within one program via an inner KV loop. Each probability tile is
+    computed ONCE (the two-pass design recomputes s and dp in both grids:
+    7 matmul passes vs 5 here) and q/k/v/do stream from HBM once instead of
+    twice. Causal trip count is bounded per q block, preserving the
+    skip-masked-blocks saving."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
+    qi = pl.program_id(1)
+    n_q = pl.num_programs(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[...] = jnp.zeros_like(dk_ref)
+        dv_ref[...] = jnp.zeros_like(dv_ref)
+
+    dq_acc[:] = jnp.zeros_like(dq_acc)
+    q = q_ref[0]  # [bq, d], pre-scaled by scale*log2e
+    do = do_ref[0]  # [bq, d]
+    lse2 = lse_ref[0, 0][:, None] * _LOG2E  # exp2 domain
+    dd = dd_ref[0, 0][:, None]
+    gl = gl_ref[0, 0][:, None]
+    n_kv = kv_len // bk
+    if causal:
+        # kv blocks strictly above the diagonal contribute nothing
+        j_hi = jnp.minimum(((qi + 1) * bq + bk - 1) // bk, n_kv)
+    else:
+        j_hi = n_kv
+
+    def body(j, _):
+        kc = k_ref[0, pl.ds(j * bk, bk), :]  # [bk, d]
+        vc = v_ref[0, pl.ds(j * bk, bk), :]
+        s = jax.lax.dot_general(
+            q, kc, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk], exp2 domain
+        if causal:
+            rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp2(s - lse2)  # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, vc, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        ds = p * (dp - dd + gl)
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(kc.dtype), kc, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        pt = p.astype(do.dtype)
+        dv_ref[0, pl.ds(j * bk, bk), :] += jax.lax.dot_general(
+            pt, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bk, d]
+        dsc = ds.astype(q.dtype)
+        # against the PRE-SCALED q: carries scale*log2e·(true dk); one ln2
+        # multiply at the very end restores bare `scale` (see two-pass note)
+        dk_ref[0, pl.ds(j * bk, bk), :] += jax.lax.dot_general(
+            dsc, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return 0
+
+    lax.fori_loop(0, j_hi, body, 0, unroll=False)
+    dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
+
+    @pl.when(qi == n_q - 1)
+    def _scale_dk():
+        dk_ref[...] = dk_ref[...] * _LN2
+
+
+# VMEM budget for the fused single-pass backward: K/V (storage dtype) +
+# dk/dv f32 accumulators resident per batch·head = 2*itemsize + 8 bytes per
+# kv·d element; capping the residents at ~6.6MB leaves room for q/do/dq
+# tiles and the [bq, bk] f32 loop temporaries inside 16MB. Above it (e.g.
+# s=8192 d=128 bf16, or s=4096 d=128 f32) the two-pass design takes over.
+_FUSED_BWD_MAX_RESIDENT_BYTES = 6_600_000
+
+
+def _fused_bwd_applicable(q_len: int, kv_len: int, d: int,
+                          q_block: int, itemsize: int = 2) -> bool:
+    bq = _largest_divisor_leq(q_len, q_block)
+    resident = kv_len * d * (2 * itemsize + 8)
+    return (resident <= _FUSED_BWD_MAX_RESIDENT_BYTES
+            and (bq % 128 == 0 or bq == q_len))
+
+
+def _flash_bwd_inputs(q, k, v, o, lse, g, scale, glse):
+    """Shared backward-input preamble (fused AND two-pass kernels — they
+    must stay interchangeable under the same entry point): q pre-scaled by
+    scale*log2e, [bh, ...] reshapes, the D_i = Σ dO·O row reduction, and
+    the lse-cotangent row (zero when only the attention output is used)."""
     b, h, q_len, d = q.shape
     kv_len = k.shape[-2]
-    bq = _largest_divisor_leq(q_len, q_block)
-    bk = _largest_divisor_leq(kv_len, kv_block)
     bh = b * h
-    # pre-scale q by scale*log2e (see _flash_fwd_pallas): the kernels run
-    # exp2-space softmax; dq multiplies back `scale`, dk multiplies `ln2`
     qf = (q * (scale * _LOG2E)).astype(q.dtype).reshape(bh, q_len, d)
     kf = k.reshape(bh, kv_len, d)
     vf = v.reshape(bh, kv_len, d)
     dof = g.reshape(bh, q_len, d).astype(q.dtype)
-    # D_i = Σ_d dO_i · O_i — cheap elementwise reduction outside the kernels
     dd = jnp.sum(g.reshape(bh, q_len, d).astype(jnp.float32)
                  * o.reshape(bh, q_len, d).astype(jnp.float32),
                  axis=-1).reshape(bh, 1, q_len)
     lse = lse.reshape(bh, 1, q_len)
     gl = (jnp.zeros((bh, 1, q_len), jnp.float32) if glse is None
           else glse.astype(jnp.float32).reshape(bh, 1, q_len))
+    return qf, kf, vf, dof, dd, lse, gl
+
+
+def _flash_bwd_fused(q, k, v, o, lse, g, scale: float, causal: bool,
+                     q_block: int, kv_block: int, glse=None):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, q_len, d = q.shape
+    kv_len = k.shape[-2]
+    bq = _largest_divisor_leq(q_len, q_block)
+    # inner KV block capped at 512: the loop body holds ~6 live [bq, bk] f32
+    # temporaries (s, p, dp, ds, causal iotas); 512x512x4B each keeps them
+    # inside the VMEM left over by the resident K/V + dk/dv accumulators
+    bk = _largest_divisor_leq(kv_len, min(kv_block, 512))
+    bh = b * h
+    qf, kf, vf, dof, dd, lse, gl = _flash_bwd_inputs(q, k, v, o, lse, g,
+                                                     scale, glse)
+
+    q_spec = pl.BlockSpec((1, bq, d), lambda a, i: (a, i, 0),
+                          memory_space=pltpu.VMEM)
+    kv_full = pl.BlockSpec((1, kv_len, d), lambda a, i: (a, 0, 0),
+                           memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, 1, bq), lambda a, i: (a, 0, i),
+                            memory_space=pltpu.VMEM)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_fused_kernel, scale=scale,
+                          causal=causal, bq=bq, bk=bk, kv_len=kv_len),
+        out_shape=(_vma_struct((bh, q_len, d), q.dtype, q),
+                   _vma_struct((bh, kv_len, d), jnp.float32, k),
+                   _vma_struct((bh, kv_len, d), jnp.float32, v)),
+        grid=(bh, q_len // bq),
+        in_specs=[q_spec, kv_full, kv_full, q_spec, row_spec, row_spec,
+                  row_spec],
+        out_specs=(q_spec, kv_full, kv_full),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(qf, kf, vf, dof, lse, dd, gl)
+    return (dq.reshape(b, h, q_len, d),
+            dk.astype(k.dtype).reshape(b, h, kv_len, d),
+            dv.astype(v.dtype).reshape(b, h, kv_len, d))
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
+                      q_block: int, kv_block: int, glse=None):
+    """Full flash backward on TPU. Preferred path: the fused single-pass
+    kernel (:func:`_flash_bwd_fused`) whenever K/V + accumulators fit VMEM;
+    otherwise recomputes p from the saved logsumexp in two gridded passes
+    (dq; dk+dv), all matmuls in the storage dtype with f32 accumulation."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if _fused_bwd_applicable(q.shape[-2], k.shape[-2], q.shape[-1], q_block,
+                             q.dtype.itemsize):
+        return _flash_bwd_fused(q, k, v, o, lse, g, scale, causal,
+                                q_block, kv_block, glse=glse)
+
+    b, h, q_len, d = q.shape
+    kv_len = k.shape[-2]
+    bq = _largest_divisor_leq(q_len, q_block)
+    bk = _largest_divisor_leq(kv_len, kv_block)
+    bh = b * h
+    qf, kf, vf, dof, dd, lse, gl = _flash_bwd_inputs(q, k, v, o, lse, g,
+                                                     scale, glse)
 
     q_spec = pl.BlockSpec((1, bq, d), lambda a, i, j: (a, i, 0),
                           memory_space=pltpu.VMEM)

@@ -21,17 +21,23 @@ SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
 
 
-def data_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
-    """Shard the leading (batch) axis over the data axis; replicate the rest."""
-    spec = P(DATA_AXIS, *([None] * (ndim - 1))) if ndim > 0 else P()
-    return NamedSharding(mesh, spec)
+def data_sharding(mesh: Mesh, ndim: int, batch_axis: int = 0) -> NamedSharding:
+    """Shard the batch axis over the data axis; replicate the rest.
+    ``batch_axis`` > 0 supports step-stacked batches ``[k, B, ...]`` (the
+    multi-step dispatch path) where the STEP axis leads and must stay
+    replicated."""
+    if ndim <= batch_axis:
+        return NamedSharding(mesh, P())
+    dims = [None] * ndim
+    dims[batch_axis] = DATA_AXIS
+    return NamedSharding(mesh, P(*dims))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(mesh: Mesh, batch: Any) -> Any:
+def shard_batch(mesh: Mesh, batch: Any, batch_axis: int = 0) -> Any:
     """Device-put a host batch pytree with the batch axis sharded over
     ``data``. This is the host→device edge of the input pipeline (the
     reference's FeatureSet-iterator → model-replica feed).
@@ -40,14 +46,17 @@ def shard_batch(mesh: Mesh, batch: Any) -> Any:
     process holds only ITS rows (FeatureSet already per-host shards), so the
     local batch is assembled into the global array via
     ``make_array_from_process_local_data`` — the jit'd step then sees one
-    logical global batch, XLA handles cross-host collectives."""
+    logical global batch, XLA handles cross-host collectives.
+
+    ``batch_axis=1`` handles step-stacked ``[k, B, ...]`` groups from the
+    multi-step dispatch path."""
     multiprocess = jax.process_count() > 1
 
     def put(x):
         if x is None:  # unlabeled datasets yield (x, None)
             return None
         arr = np.asarray(x)
-        sharding = data_sharding(mesh, arr.ndim)
+        sharding = data_sharding(mesh, arr.ndim, batch_axis)
         if multiprocess:
             return jax.make_array_from_process_local_data(sharding, arr)
         return jax.device_put(arr, sharding)

@@ -45,6 +45,31 @@ class TestTraining:
         result = est.train(fs, batch_size=64, end_trigger=MaxIteration(7))
         assert result["iterations"] == 7
 
+    def test_multi_step_dispatch_matches_single(self, ctx):
+        """steps_per_dispatch>1 scans K steps in one dispatch; same data
+        order + same per-step rng schedule must reproduce the single-step
+        loss trajectory EXACTLY (and handle the 4,4,2 epoch-tail group)."""
+        x, y = make_regression(n=640, d=16)
+        h1 = make_estimator().train(
+            FeatureSet.from_ndarrays(x, y, shuffle=False),
+            batch_size=64, epochs=3)
+        est2 = make_estimator()
+        h2 = est2.train(FeatureSet.from_ndarrays(x, y, shuffle=False),
+                        batch_size=64, epochs=3, steps_per_dispatch=4)
+        assert est2.global_step == 30
+        assert len(h2["loss_history"]) == 30
+        np.testing.assert_allclose(h1["loss_history"], h2["loss_history"],
+                                   rtol=0, atol=0)
+
+    def test_multi_step_dispatch_trigger_quantized(self, ctx):
+        """MaxIteration may overshoot by < K within one dispatch group."""
+        x, y = make_regression()
+        est = make_estimator()
+        fs = FeatureSet.from_ndarrays(x, y)
+        result = est.train(fs, batch_size=64, end_trigger=MaxIteration(3),
+                           steps_per_dispatch=2)
+        assert result["iterations"] == 4  # two groups of 2
+
     def test_evaluate_and_predict(self, ctx):
         x, y = make_regression(n=100)
         est = make_estimator(metrics=["mae", "mse"])
