@@ -35,11 +35,16 @@ class GraphModel:
     @classmethod
     def from_loss(cls, loss_fn: Callable, init_params_fn: Callable,
                   optimizer="adam", metrics: Optional[Sequence] = None,
-                  forward_fn: Optional[Callable] = None) -> "GraphModel":
+                  forward_fn: Optional[Callable] = None,
+                  per_example_loss_fn: Optional[Callable] = None
+                  ) -> "GraphModel":
         """``loss_fn(params, x, y) -> scalar``;
         ``init_params_fn(rng, sample_x) -> params``. Supply ``forward_fn``
         (``forward(params, x) -> y_pred``) to enable predict/metric
-        evaluation — the loss alone doesn't define predictions."""
+        evaluation — the loss alone doesn't define predictions. Supply
+        ``per_example_loss_fn(params, x, y) -> [batch]`` to make padded
+        multi-host evaluation exact (pad rows masked out of the sum);
+        without it, tail batches carry a documented O(pad/batch) bias."""
 
         def no_forward(p, s, x, training, rng):
             raise NotImplementedError(
@@ -55,9 +60,15 @@ class GraphModel:
         def direct(params, model_state, rng, x, y):
             return loss_fn(params, x, y), model_state
 
+        per_example = None
+        if per_example_loss_fn is not None:
+            def per_example(params, model_state, rng, x, y):
+                return per_example_loss_fn(params, x, y)
+
         est = Estimator(model=model, loss_fn=lambda y, yp: 0.0,
                         optimizer=opt_mod.get(optimizer),
-                        metrics=metrics, direct_loss_fn=direct)
+                        metrics=metrics, direct_loss_fn=direct,
+                        direct_eval_per_example_fn=per_example)
         return cls(est)
 
     @classmethod

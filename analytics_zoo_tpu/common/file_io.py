@@ -115,6 +115,36 @@ def fopen(path: str, mode: str = "r", encoding: Optional[str] = None,
     return fs.open(str(path), mode, **text_kw)
 
 
+def create_exclusive(path: str, data: bytes = b"") -> None:
+    """Create ``path`` failing with FileExistsError if it already exists —
+    the claim-marker primitive for multi-consumer queues. Atomic on posix
+    (O_EXCL). On remote stores it uses the backend's exclusive-create mode
+    when available, else an exists-check + write: atomic on stores with
+    create-preconditions (GCS), best-effort elsewhere — a second consumer
+    racing the same marker within the check-write window could both
+    'win'; callers needing hard exactly-once remotely should use a real
+    broker (RedisQueue)."""
+    if not is_remote(path):
+        fd = os.open(local_path(path), os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        try:
+            os.write(fd, data)
+        finally:
+            os.close(fd)
+        return
+    fs = _fs(path)
+    try:
+        f = fs.open(str(path), "xb")
+    except FileExistsError:
+        raise
+    except (ValueError, NotImplementedError, OSError):
+        # backend without exclusive mode: exists-check + write
+        if fs.exists(str(path)):
+            raise FileExistsError(path)
+        f = fs.open(str(path), "wb")
+    with f:
+        f.write(data)
+
+
 def exists(path: str) -> bool:
     if not is_remote(path):
         return os.path.exists(local_path(path))

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -48,6 +49,45 @@ from ..keras import metrics as metrics_mod
 from ..keras.optimizers import Optimizer
 from ..parallel.mesh import param_sharding, replicated, shard_batch
 from ..utils.tensorboard import SummaryWriter
+
+
+class _AsyncSnapshotWriter:
+    """One-in-flight background checkpoint writer with an explicit fence.
+
+    The TPU-first snapshot split: the device→host copy happens synchronously
+    at trigger time (cheap — HBM→RAM), the serialize+write happens on this
+    thread so the train loop never stalls on storage. ``wait()`` is the
+    fence: called before the next snapshot is submitted, before any restore,
+    and at train end; a failed background write surfaces there."""
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def wait(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("background checkpoint write failed") from err
+
+    def submit(self, fn) -> None:
+        self.wait()  # fence: at most one write in flight
+
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # surfaced at the next fence
+                self._error = e
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="zoo-ckpt-writer")
+        self._thread.start()
+
+    @property
+    def in_flight(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
 
 
 def _flat_losses(vals):
@@ -94,6 +134,7 @@ class Estimator:
                  mesh=None, param_sharding_rules: Optional[Sequence] = None,
                  direct_loss_fn: Optional[Callable] = None,
                  direct_eval_loss_fn: Optional[Callable] = None,
+                 direct_eval_per_example_fn: Optional[Callable] = None,
                  compute_dtype=None,
                  seed: int = 42):
         """``direct_loss_fn(params, model_state, rng, x, y) -> (loss,
@@ -102,6 +143,13 @@ class Estimator:
         hands over the whole loss graph instead of a model).
         ``direct_eval_loss_fn`` is the eval-mode variant (no dropout etc.);
         defaults to ``direct_loss_fn``.
+
+        ``direct_eval_per_example_fn(params, model_state, rng, x, y) ->
+        [batch] per-record losses`` makes padded-tail evaluation EXACT:
+        pad rows are masked out of the sum before the global weighting, so
+        multi-process eval equals the single-process result bit-for-bit in
+        expectation (without it, the batch-mean form leaves an
+        O(pad/batch) bias on tail batches, documented below).
 
         ``compute_dtype`` (e.g. ``jnp.bfloat16``) enables mixed precision:
         float inputs are cast to it before the forward pass (layers follow
@@ -112,6 +160,7 @@ class Estimator:
         self.loss_fn = loss_fn
         self.direct_loss_fn = direct_loss_fn
         self.direct_eval_loss_fn = direct_eval_loss_fn or direct_loss_fn
+        self.direct_eval_per_example_fn = direct_eval_per_example_fn
         self.optimizer = optimizer
         self.metrics = [metrics_mod.get(m) for m in (metrics or [])]
         self.compute_dtype = compute_dtype
@@ -139,10 +188,12 @@ class Estimator:
         self._eval_step = None
         self._predict_step = None
         self._direct_eval_step = None
+        self._direct_pe_step = None
         self._clip: Optional[Tuple[str, Any]] = None
         self._tb: Optional[Tuple[str, str]] = None
         self._ckpt_dir: Optional[str] = None
         self._ckpt_trigger: Optional[Trigger] = None
+        self._ckpt_writer = _AsyncSnapshotWriter()
         self._train_writer: Optional[SummaryWriter] = None
         self._val_writer: Optional[SummaryWriter] = None
 
@@ -564,6 +615,9 @@ class Estimator:
         if self._train_writer is not None:
             self._train_writer.flush()
             self._val_writer.flush()
+        # train() must not return with a checkpoint still writing (and a
+        # failed background write must surface to the caller)
+        self._ckpt_writer.wait()
         return {"loss_history": history, "iterations": self.global_step}
 
     # -- evaluate (Estimator.evaluate / InternalDistriOptimizer eval) ---------
@@ -597,6 +651,65 @@ class Estimator:
             raise ValueError("validation set produced no batches")
         return {m.name: m.compute(s) for m, s in zip(self.metrics, metric_states)}
 
+    def _evaluate_direct_exact(self, val_set: FeatureSet, batch_size: int
+                               ) -> Dict[str, float]:
+        """Per-example masked eval — ZERO tail bias on any process
+        topology: pad rows (and whole valid=0 re-fed batches on short
+        hosts) contribute nothing, the result is
+        sum(valid per-record losses) / global valid count, identical to a
+        single-process pass over the concatenated shards. One compile
+        shape total (the mask is data)."""
+        import math
+
+        pe = self.direct_eval_per_example_fn
+        multiproc = self.ctx.process_count > 1
+        ndev = self.mesh.devices.size
+        local_batch = self.ctx.local_batch(batch_size)
+        if not multiproc:
+            local_batch = min(local_batch, val_set.size)
+        local_batch = max(ndev, (local_batch // ndev) * ndev)
+        n_local = math.ceil(val_set.size / local_batch)
+        if multiproc:
+            from jax.experimental import multihost_utils as mhu
+            counts = np.asarray(mhu.process_allgather(
+                np.asarray([n_local], np.int64)))
+            if counts.min() == 0:
+                raise ValueError(
+                    "a host has an empty validation shard; every process "
+                    "needs at least one batch for the collective eval")
+            n_steps = int(counts.max())
+        else:
+            n_steps = n_local
+        sample = next(val_set.eval_iterator(local_batch, pad_remainder=True))
+        self._ensure_initialized(sample[0])
+        if self._direct_pe_step is None:
+            def step(p, s, rng, x, y, mask):
+                losses = pe(p, s, rng, x, y)
+                return (jnp.sum(losses.astype(jnp.float32) * mask),
+                        jnp.sum(mask))
+
+            self._direct_pe_step = jax.jit(step)
+        eval_rng = jax.random.PRNGKey(0)
+        it = val_set.eval_iterator(local_batch, pad_remainder=True)
+        last = None
+        total, weight = 0.0, 0.0
+        for _ in range(n_steps):
+            try:
+                x, y, valid = next(it)
+                last = (x, y)
+            except StopIteration:  # short host re-feeds with mask all-zero
+                (x, y), valid = last, 0
+            mask = (np.arange(local_batch) < valid).astype(np.float32)
+            bx, by, bm = shard_batch(self.mesh, (x, y, mask))
+            s, w = self._direct_pe_step(self.params, self.model_state,
+                                        eval_rng, bx, by, bm)
+            total += float(s)
+            weight += float(w)
+        if weight == 0:
+            raise ValueError(
+                f"validation set is empty ({val_set.size} records)")
+        return {"loss": total / weight}
+
     def _evaluate_direct(self, val_set: FeatureSet, batch_size: int
                          ) -> Dict[str, float]:
         """Record-weighted average of the captured loss (direct-loss capture
@@ -607,6 +720,8 @@ class Estimator:
         identically-shaped padded steps (batch count agreed by allgather),
         tail batches weighted by their global valid count — every record
         counts; see the inline note for the tail-pad approximation."""
+        if self.direct_eval_per_example_fn is not None:
+            return self._evaluate_direct_exact(val_set, batch_size)
         multiproc = self.ctx.process_count > 1
         ndev = self.mesh.devices.size
         local_batch = self.ctx.local_batch(batch_size)
@@ -764,35 +879,64 @@ class Estimator:
         return tree
 
     def _save_snapshot(self) -> None:
+        """Async snapshot: device→host copy NOW (the only part the train
+        loop waits for), serialize+write on the background writer thread.
+        ``submit`` fences the previous write first, so at most one snapshot
+        is in flight and ordering is preserved. Crash safety: on the
+        single-process local path, writes go to a ``.writing`` staging dir
+        published by atomic rename, so a crash between copy and write
+        leaves the previous snapshot intact; multi-process saves rely on
+        orbax's own collective commit protocol, and remote URIs upload via
+        a staging dir WITHOUT an atomic publish (object stores can't
+        rename atomically) — a torn remote snapshot is possible on crash
+        and surfaces as a structure-validation error at restore."""
         path = file_io.join(self._ckpt_dir, f"snapshot-{self.global_step}")
-        self.save_checkpoint(path)
+        tree = self._snapshot_tree()  # device fetch, synchronous
+        self._ckpt_writer.submit(lambda: self._write_snapshot(path, tree))
 
     def _latest_snapshot(self) -> Optional[str]:
         if not self._ckpt_dir or not file_io.isdir(self._ckpt_dir):
             return None
         snaps = [d for d in file_io.listdir(self._ckpt_dir)
-                 if d.startswith("snapshot-")]
+                 if d.startswith("snapshot-") and ".writing" not in d]
         if not snaps:
             return None
         newest = max(snaps, key=lambda s: int(s.split("-")[1]))
         return file_io.join(self._ckpt_dir, newest)
 
     def save_checkpoint(self, path: str) -> None:
-        """Write a snapshot. EVERY process must call this: orbax's save is a
-        collective (it barriers across ``jax.process_count()`` processes and
-        elects process 0 as the writer) — gating it to rank 0 deadlocks the
-        pod at the barrier. Remote URIs (``gs://...``) are written via a
-        local staging dir (the reference's HDFS-aware save,
-        ``common/Utils.scala:97``)."""
+        """Write a snapshot (synchronous public API; the train loop's
+        triggered snapshots go through the async writer instead). EVERY
+        process must call this: orbax's save is a collective (it barriers
+        across ``jax.process_count()`` processes and elects process 0 as
+        the writer) — gating it to rank 0 deadlocks the pod at the barrier.
+        Remote URIs (``gs://...``) are written via a local staging dir (the
+        reference's HDFS-aware save, ``common/Utils.scala:97``)."""
+        self._ckpt_writer.wait()  # order behind any in-flight async write
+        self._write_snapshot(path, self._snapshot_tree())
+
+    def _write_snapshot(self, path: str, tree) -> None:
         import orbax.checkpoint as ocp
         ckptr = ocp.PyTreeCheckpointer()
         if file_io.is_remote(path):
             with file_io.localized(path, "w") as tmp:
-                ckptr.save(os.path.join(tmp, "ckpt"),
-                           self._snapshot_tree(), force=True)
+                ckptr.save(os.path.join(tmp, "ckpt"), tree, force=True)
             return
-        ckptr.save(os.path.abspath(file_io.local_path(path)),
-                   self._snapshot_tree(), force=True)
+        final = os.path.abspath(file_io.local_path(path))
+        if self.ctx.process_count > 1:
+            # orbax's save is a collective: every process participates and
+            # orbax coordinates the write + its own commit atomicity; a
+            # per-process stage+rename would race between ranks
+            ckptr.save(final, tree, force=True)
+            return
+        staging = final + ".writing"
+        import shutil
+        if os.path.exists(staging):  # leftover from a killed writer
+            shutil.rmtree(staging)
+        ckptr.save(staging, tree, force=True)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(staging, final)  # atomic publish
 
     def load_checkpoint(self, path: str) -> None:
         """Restore a snapshot. Restores are data-only (orbax reads arrays,
@@ -800,6 +944,9 @@ class Estimator:
         reference, ``common/CheckedObjectInputStream.scala:1``, is designed
         away), but the STRUCTURE is still validated before any state is
         touched so a truncated/foreign checkpoint can't half-install."""
+        # fence: an in-flight async write may be producing the newest
+        # snapshot (or the very one being restored)
+        self._ckpt_writer.wait()
         if file_io.is_remote(path):
             with file_io.localized(path, "r") as tmp:
                 self._load_checkpoint_local(os.path.join(tmp, "ckpt"))

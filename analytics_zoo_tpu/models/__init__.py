@@ -10,4 +10,5 @@ from .seq2seq import Seq2seq  # noqa: F401
 from .textmodels import (  # noqa: F401
     IntentEntity, NER, POSTagger, SequenceTagger)
 from .image.imageclassification import ImageClassifier  # noqa: F401
-from .image.objectdetection import ObjectDetector  # noqa: F401
+from .image.objectdetection import (  # noqa: F401
+    DETECTION_CONFIGS, ObjectDetector, detection_config)

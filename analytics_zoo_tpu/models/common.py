@@ -72,23 +72,92 @@ class ZooModel:
         self._ensure_built().save_model(file_io.join(path, "weights"))
 
     @staticmethod
-    def load_model(path: str) -> "ZooModel":
-        with file_io.fopen(file_io.join(path, "zoo_model.json")) as f:
-            spec = json.loads(f.read())
-        cls = _MODEL_REGISTRY.get(spec["class"])
+    def _instantiate_and_load(cls_name: str, config: Dict[str, Any],
+                              weights_uri: str) -> "ZooModel":
+        """Registry lookup → build → compile-before-weights-load →
+        load_weights (the one place this invariant lives; both load_model
+        and load_pretrained route through it)."""
+        cls = _MODEL_REGISTRY.get(cls_name)
         if cls is None:
-            raise ValueError(f"unknown zoo model class {spec['class']}; "
+            raise ValueError(f"unknown zoo model class {cls_name}; "
                              f"registered: {sorted(_MODEL_REGISTRY)}")
-        inst = cls(**spec["config"])
+        inst = cls(**config)
         inst._ensure_built()
         # models must be compiled before weights load to own an estimator
         if not hasattr(inst.model, "loss_fn"):
             inst.default_compile()
-        inst.model.load_weights(file_io.join(path, "weights"))
+        inst.model.load_weights(weights_uri)
         return inst
+
+    @staticmethod
+    def load_model(path: str) -> "ZooModel":
+        with file_io.fopen(file_io.join(path, "zoo_model.json")) as f:
+            spec = json.loads(f.read())
+        return ZooModel._instantiate_and_load(
+            spec["class"], spec["config"], file_io.join(path, "weights"))
 
     def default_compile(self):
         self.compile(optimizer="adam", loss="mse")
+
+    # -- pretrained bundles ---------------------------------------------------
+    #
+    # The reference zoo ships loadable pretrained artifacts carrying the
+    # model weights AND their label map + per-model preprocessing config
+    # (ImageClassifier.scala:37 label maps; ObjectDetectionConfig.scala:1
+    # per-variant preproc). A bundle is ONE directory (local or scheme://):
+    #   zoo_bundle.json   format tag, class, config, labels, preproc spec
+    #   weights/          the checkpoint (same layout as save_model)
+
+    BUNDLE_FORMAT = "zoo-tpu-bundle/1"
+
+    def preprocessing_spec(self) -> Optional[List[Dict[str, Any]]]:
+        """Serializable inference preprocessing (see feature/image/spec.py);
+        None when the model has no canonical input chain."""
+        return None
+
+    def save_pretrained(self, path: str) -> None:
+        """Write a single pretrained artifact: weights + config + label map
+        + preprocessing spec, over the scheme-aware IO (gs:// works)."""
+        file_io.makedirs(path, exist_ok=True)
+        bundle = {
+            "format": self.BUNDLE_FORMAT,
+            "class": type(self).__name__,
+            "config": self.get_config(),
+            "labels": getattr(self, "labels", None),
+            "preprocessing": self.preprocessing_spec(),
+        }
+        with file_io.fopen(file_io.join(path, "zoo_bundle.json"), "w") as f:
+            f.write(json.dumps(bundle, indent=2))
+        self._ensure_built().save_model(file_io.join(path, "weights"))
+
+    @staticmethod
+    def load_pretrained(uri: str) -> "ZooModel":
+        """Load a bundle written by :meth:`save_pretrained` from a local
+        path or remote URI; the returned model predicts with labels and
+        exposes the bundled preprocessing chain via
+        :meth:`bundled_preprocessing`."""
+        with file_io.fopen(file_io.join(uri, "zoo_bundle.json")) as f:
+            bundle = json.loads(f.read())
+        fmt = bundle.get("format")
+        if fmt != ZooModel.BUNDLE_FORMAT:
+            raise ValueError(f"{uri!r} is not a zoo-tpu pretrained bundle "
+                             f"(format {fmt!r}); for bare checkpoints use "
+                             f"ZooModel.load_model")
+        inst = ZooModel._instantiate_and_load(
+            bundle["class"], bundle["config"], file_io.join(uri, "weights"))
+        if bundle.get("labels") is not None:
+            inst.labels = bundle["labels"]
+        inst._bundle_preprocessing = bundle.get("preprocessing")
+        return inst
+
+    def bundled_preprocessing(self):
+        """The preprocessing chain this model was bundled with (falls back
+        to the model's own canonical spec)."""
+        from ..feature.image.spec import build_preprocessing
+        spec = getattr(self, "_bundle_preprocessing", None)
+        if spec is None:
+            spec = self.preprocessing_spec()
+        return build_preprocessing(spec)
 
 
 class Ranker:

@@ -414,15 +414,19 @@ class ImageClassifier(ZooModel):
                      loss="sparse_categorical_crossentropy",
                      metrics=["accuracy"])
 
-    def preprocessing(self):
-        """The model's input chain (reference per-model configs)."""
-        from ...feature.image import (
-            ChannelNormalize, ImageSetToSample, Resize)
+    def preprocessing_spec(self):
+        """Serializable input chain — persisted in pretrained bundles."""
+        from ...feature.image.spec import classification_spec
         h, w, _ = self.input_shape
-        return (Resize(h, w)
-                >> ChannelNormalize(IMAGENET_MEAN.tolist(),
-                                    IMAGENET_STD.tolist())
-                >> ImageSetToSample())
+        return classification_spec(h, w, IMAGENET_MEAN.tolist(),
+                                   IMAGENET_STD.tolist())
+
+    def preprocessing(self):
+        """The model's input chain (reference per-model configs). A
+        bundle-loaded classifier uses the chain it shipped with."""
+        from ...feature.image.spec import build_preprocessing
+        spec = getattr(self, "_bundle_preprocessing", None)
+        return build_preprocessing(spec or self.preprocessing_spec())
 
     def predict_image_set(self, image_set, top_k: int = 5,
                           batch_size: int = 32):

@@ -478,6 +478,48 @@ class SSD:
 
 
 # ---------------------------------------------------------------------------
+# Detection config registry (reference ObjectDetectionConfig.scala:1 —
+# per-variant preprocessing + postprocessing parameters keyed by the
+# published model names)
+# ---------------------------------------------------------------------------
+
+# SSD Caffe-lineage preprocessing: BGR mean subtraction, no std scaling
+_SSD_MEAN = [123.0, 117.0, 104.0]
+_SSD_STD = [1.0, 1.0, 1.0]
+
+DETECTION_CONFIGS: Dict[str, Dict[str, Any]] = {
+    "ssd-vgg16-300x300": {
+        "backbone": "vgg16", "resolution": 300,
+        "preprocess": {"mean": _SSD_MEAN, "std": _SSD_STD},
+        "postprocess": {"score_threshold": 0.05, "iou_threshold": 0.45,
+                        "max_detections": 100},
+    },
+    "ssd-vgg16-512x512": {
+        "backbone": "vgg16", "resolution": 512,
+        "preprocess": {"mean": _SSD_MEAN, "std": _SSD_STD},
+        "postprocess": {"score_threshold": 0.05, "iou_threshold": 0.45,
+                        "max_detections": 200},
+    },
+    "ssd-mobilenet-300x300": {
+        "backbone": "mobilenet", "resolution": 300,
+        "preprocess": {"mean": [127.5, 127.5, 127.5],
+                       "std": [127.5, 127.5, 127.5]},
+        "postprocess": {"score_threshold": 0.05, "iou_threshold": 0.45,
+                        "max_detections": 100},
+    },
+}
+
+
+def detection_config(name: str) -> Dict[str, Any]:
+    """Variant config by published name (``ObjectDetectionConfig.scala``
+    role). Names follow the reference's ``ssd-<backbone>-<res>`` scheme."""
+    if name not in DETECTION_CONFIGS:
+        raise ValueError(f"unknown detection config {name!r}; have "
+                         f"{sorted(DETECTION_CONFIGS)}")
+    return DETECTION_CONFIGS[name]
+
+
+# ---------------------------------------------------------------------------
 # ObjectDetector ZooModel (reference ObjectDetector.scala:37 + config)
 # ---------------------------------------------------------------------------
 
@@ -501,9 +543,39 @@ class ObjectDetector(ZooModel):
         self.anchors: Optional[np.ndarray] = None
         self._decode_cache: Dict[Tuple, Any] = {}
 
+    @classmethod
+    def from_detection_config(cls, name: str, class_num: int,
+                              labels: Optional[List[str]] = None
+                              ) -> "ObjectDetector":
+        """Build a detector from the published variant registry (the
+        reference's ``ObjectDetector(model, config)`` load path)."""
+        cfg = detection_config(name)
+        det = cls(class_num, backbone=cfg["backbone"],
+                  resolution=cfg["resolution"], labels=labels)
+        det._config_name = name
+        return det
+
+    @property
+    def _variant_cfg(self) -> Dict[str, Any]:
+        name = getattr(self, "_config_name",
+                       f"ssd-{self.backbone}-{self.resolution}x"
+                       f"{self.resolution}")
+        # every SSD.BACKBONES x RESOLUTIONS combo must have a registry
+        # entry; a silent fallback would serve another variant's
+        # normalization and produce garbage detections
+        return detection_config(name)
+
     def get_config(self) -> Dict[str, Any]:
         return {"class_num": self.class_num, "backbone": self.backbone,
                 "resolution": self.resolution, "labels": self.labels}
+
+    def preprocessing_spec(self):
+        pre = self._variant_cfg["preprocess"]
+        return [{"op": "resize", "height": self.resolution,
+                 "width": self.resolution},
+                {"op": "channel_normalize", "mean": pre["mean"],
+                 "std": pre["std"]},
+                {"op": "to_sample"}]
 
     def build_model(self) -> Model:
         model, anchors = SSD(self.class_num, self.resolution, self.backbone)
@@ -545,14 +617,14 @@ class ObjectDetector(ZooModel):
 
     def predict_image_set(self, image_set, batch_size: int = 16, **kwargs):
         """Detections over an ImageSet (reference
-        ``ImageModel.predictImageSet`` path)."""
-        from ...feature.image import ChannelNormalize, ImageSetToSample, Resize
-        chain = (Resize(self.resolution, self.resolution)
-                 >> ChannelNormalize([123.0, 117.0, 104.0], [1.0, 1.0, 1.0])
-                 >> ImageSetToSample())
+        ``ImageModel.predictImageSet`` path). Preprocessing and NMS
+        defaults come from the variant's detection config."""
+        chain = self.bundled_preprocessing()
+        post = dict(self._variant_cfg["postprocess"])
+        post.update(kwargs)
         fs = image_set.transform(chain).to_featureset(shuffle=False, shard=False)
         return self.detect(np.asarray(fs.features), batch_size=batch_size,
-                           **kwargs)
+                           **post)
 
 
 class Visualizer:

@@ -5,12 +5,13 @@ consumer-group reads, results in a ``result:<uri>`` hash —
 ``ClusterServing.scala:106-140,276-307``; client ``client.py:62,131``).
 Here the backend is pluggable:
 
-- :class:`FileQueue` (default): a spool directory with atomic renames —
-  zero extra dependencies, works single-host and on a shared filesystem
-  across hosts (requests claimed by rename, results as per-uri JSON files).
-  The spool root may be a ``scheme://`` URI (e.g. ``gs://bucket/q``) via the
-  filesystem layer; remote renames are not atomic, so remote spools support
-  many producers but a SINGLE serving consumer.
+- :class:`FileQueue` (default): a spool directory — zero extra
+  dependencies, works single-host and on a shared filesystem across hosts
+  (results as per-uri JSON files). Requests are claimed by atomic rename
+  locally, and by exclusive-create claim markers on ``scheme://`` spools
+  (remote renames are copy+delete, not atomic); exactly-once on remote
+  spools is as strong as the backend's exclusive-create (see
+  ``file_io.create_exclusive``) — use RedisQueue for a hard guarantee.
 - :class:`RedisQueue`: the reference's wire contract (stream + hash), gated
   on the ``redis`` package being installed.
 """
@@ -68,6 +69,29 @@ class FileQueue(QueueBackend):
             f.write(json.dumps({"uri": uri, **payload}))
         file_io.replace(tmp, file_io.join(self.req_dir, name))  # atomic publish
 
+    def _claim_one(self, name: str) -> Optional[str]:
+        """Claim one request; returns the path to read it from, or None if
+        another consumer won. Local spools claim by atomic rename
+        (os.replace — the loser raises). Remote spools claim by an
+        EXCLUSIVE-CREATE marker in claimed/: a remote ``replace`` is
+        copy+delete, so two consumers could both 'win' a rename — the
+        marker makes the winner explicit (see file_io.create_exclusive for
+        the per-backend atomicity story)."""
+        src = file_io.join(self.req_dir, name)
+        if not file_io.is_remote(src):
+            dst = file_io.join(self.claim_dir, name)
+            try:
+                file_io.replace(src, dst)  # atomic claim; loser raises
+            except (OSError, FileNotFoundError):
+                return None
+            return dst
+        marker = file_io.join(self.claim_dir, name + ".claim")
+        try:
+            file_io.create_exclusive(marker)
+        except (FileExistsError, OSError):
+            return None
+        return src
+
     def claim_batch(self, max_items: int) -> List[Tuple[str, Dict[str, Any]]]:
         out = []
         try:
@@ -79,14 +103,11 @@ class FileQueue(QueueBackend):
         for name in names:
             if name.startswith(".") or len(out) >= max_items:
                 continue
-            src = file_io.join(self.req_dir, name)
-            dst = file_io.join(self.claim_dir, name)
-            try:
-                file_io.replace(src, dst)  # atomic claim; loser raises
-            except (OSError, FileNotFoundError):
+            path = self._claim_one(name)
+            if path is None:
                 continue
             try:
-                with file_io.fopen(dst) as f:
+                with file_io.fopen(path) as f:
                     rec = json.loads(f.read())
                 out.append((rec["uri"], rec))
             except (ValueError, KeyError, OSError):
@@ -96,10 +117,20 @@ class FileQueue(QueueBackend):
                 logging.getLogger("analytics_zoo_tpu.serving").warning(
                     "dropping malformed request file %s", name)
             finally:
-                try:
-                    file_io.remove(dst)
-                except OSError:
-                    pass
+                # request file(s) first, marker LAST: a marker removed
+                # while the request still exists would let a second
+                # consumer re-claim the record
+                cleanup = list({path, file_io.join(self.req_dir, name)})
+                if file_io.is_remote(path):
+                    # the marker must not outlive the request either:
+                    # remote spools would leak one object per record
+                    cleanup.append(file_io.join(self.claim_dir,
+                                                name + ".claim"))
+                for p in cleanup:
+                    try:
+                        file_io.remove(p)
+                    except (OSError, FileNotFoundError):
+                        pass
         return out
 
     def put_result(self, uri: str, value: Dict[str, Any]) -> None:
@@ -157,6 +188,11 @@ class RedisQueue(QueueBackend):
     def __init__(self, host: str = "localhost", port: int = 6379):
         import redis  # gated dependency
         self.db = redis.StrictRedis(host=host, port=port, db=0)
+        # unique consumer identity per server instance: XREADGROUP '>'
+        # delivers each entry to exactly one consumer in the group, which
+        # is what makes N serving servers on one stream exactly-once
+        # (ClusterServing.scala's multi-executor contract)
+        self.consumer = f"consumer-{uuid.uuid4().hex[:12]}"
         try:
             self.db.xgroup_create(self.STREAM, self.GROUP, mkstream=True)
         except Exception:
@@ -167,7 +203,7 @@ class RedisQueue(QueueBackend):
                                    "data": json.dumps(payload)})
 
     def claim_batch(self, max_items: int) -> List[Tuple[str, Dict[str, Any]]]:
-        resp = self.db.xreadgroup(self.GROUP, "consumer-0",
+        resp = self.db.xreadgroup(self.GROUP, self.consumer,
                                   {self.STREAM: ">"}, count=max_items,
                                   block=10)
         out = []

@@ -70,6 +70,61 @@ def failing_worker(_workdir: str) -> int:
     return 0
 
 
+def exact_eval_worker(workdir: str) -> int:
+    """Per-example masked eval across 2 hosts with RAGGED shards (11 vs 5
+    rows, neither divisible by the batch) must equal the single-process
+    loss over the concatenated data EXACTLY — the property the batch-mean
+    weighting could not give (O(pad/batch) bias)."""
+    import jax
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.estimator import Estimator
+    from analytics_zoo_tpu.feature import FeatureSet
+    from analytics_zoo_tpu.keras import optimizers
+
+    ctx = init_tpu_context()
+    assert ctx.process_count == 2
+
+    def direct_loss(params, state, rng, x, y):
+        pred = x @ params["w"]
+        return jnp.mean((pred[:, 0] - y) ** 2), state
+
+    def per_example(params, state, rng, x, y):
+        pred = x @ params["w"]
+        return (pred[:, 0] - y) ** 2
+
+    n = 11 if ctx.process_index == 0 else 5
+    rs = np.random.RandomState(ctx.process_index)
+    x = rs.randn(n, 3).astype(np.float32)
+    y = rs.randn(n).astype(np.float32)
+    fs = FeatureSet.from_ndarrays(x, y, shuffle=False, shard=False)
+    est = Estimator(model=None, loss_fn=None,
+                    optimizer=optimizers.SGD(0.1),
+                    direct_loss_fn=direct_loss,
+                    direct_eval_per_example_fn=per_example)
+    w = np.ones((3, 1), np.float32)
+    est.params = jax.device_put({"w": jnp.asarray(w)})
+    est.model_state = {}
+    est._state_resolved = True
+    result = est.evaluate(fs, batch_size=8)  # local_batch 4: padded tails
+
+    # ground truth: plain numpy over BOTH hosts' data (identical on each
+    # host because the seeds are the process indices)
+    ref_total, ref_n = 0.0, 0
+    for pi, nn in ((0, 11), (1, 5)):
+        rs_ref = np.random.RandomState(pi)
+        xr = rs_ref.randn(nn, 3).astype(np.float32)
+        yr = rs_ref.randn(nn).astype(np.float32)
+        ref_total += float(np.sum(((xr @ w)[:, 0] - yr) ** 2))
+        ref_n += nn
+    expect = ref_total / ref_n
+    assert abs(result["loss"] - expect) < 1e-5, (result["loss"], expect)
+    with open(os.path.join(workdir, f"exact_{ctx.process_index}.json"),
+              "w") as f:
+        json.dump({"loss": float(result["loss"]), "expect": expect}, f)
+    return 0
+
+
 def direct_eval_tail_worker(workdir: str) -> int:
     """Multi-host direct-loss eval must COUNT tail records (previously
     dropped): 2 hosts x 2 devices, per-host val shard of 11 rows with

@@ -38,6 +38,30 @@ class TestGraphModel:
         w = gm.get_weights()["w"]
         assert w.shape == (4, 1)
 
+    def test_from_loss_per_example_exact_eval(self, ctx):
+        """per_example_loss_fn makes ragged-size eval EXACT: batch 16 over
+        37 rows (2 full batches + tail 5) must equal plain numpy."""
+        from analytics_zoo_tpu.capture import GraphModel
+        rs = np.random.RandomState(3)
+        x = rs.randn(37, 4).astype(np.float32)
+        y = rs.randn(37, 1).astype(np.float32)
+
+        def init_params(rng, sample_x):
+            return {"w": jnp.ones((sample_x.shape[-1], 1))}
+
+        def loss_fn(params, bx, by):
+            return jnp.mean((bx @ params["w"] - by) ** 2)
+
+        def per_example(params, bx, by):
+            return jnp.mean((bx @ params["w"] - by) ** 2, axis=-1)
+
+        gm = GraphModel.from_loss(loss_fn, init_params,
+                                  per_example_loss_fn=per_example)
+        gm.predict  # built lazily; evaluate initializes
+        res = gm.evaluate(x, y, batch_size=16)
+        expect = float(np.mean((x @ np.ones((4, 1)) - y) ** 2))
+        assert res["loss"] == pytest.approx(expect, abs=1e-6)
+
     def test_from_forward(self, ctx):
         from analytics_zoo_tpu.capture import GraphModel
         x, y = linreg_data()

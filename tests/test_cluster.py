@@ -108,3 +108,23 @@ class TestMultiHostDirectEval:
                 losses.append(json.load(f)["loss"])
         # one logical eval: both hosts must agree on the weighted loss
         assert losses[0] == pytest.approx(losses[1])
+
+    def test_exact_eval_matches_single_process(self, tmp_path):
+        """Per-example masked eval on ragged 2-host shards equals the
+        single-process loss over the concatenated data (zero tail bias) —
+        the worker asserts the equality in-process; here we also check
+        both hosts agreed."""
+        launcher = PodLauncher(num_processes=2, devices_per_process=2,
+                               platform="cpu",
+                               log_dir=os.path.join(str(tmp_path), "logs"))
+        launcher.run("tests.pod_workers:exact_eval_worker",
+                     args=[str(tmp_path)], timeout=300)
+        import json
+        vals = []
+        for rank in range(2):
+            with open(os.path.join(str(tmp_path),
+                                   f"exact_{rank}.json")) as f:
+                vals.append(json.load(f))
+        assert vals[0]["loss"] == pytest.approx(vals[1]["loss"])
+        assert vals[0]["loss"] == pytest.approx(vals[0]["expect"],
+                                                abs=1e-5)

@@ -1,0 +1,125 @@
+"""Pretrained bundle format: one artifact = weights + config + label map +
+preprocessing spec, over the scheme-aware IO (reference ships label maps and
+per-model preproc with each pretrained artifact —
+``ImageClassifier.scala:37``, ``ObjectDetectionConfig.scala:1``)."""
+import json
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import file_io
+from analytics_zoo_tpu.models import (DETECTION_CONFIGS, ObjectDetector,
+                                      ZooModel, detection_config)
+from analytics_zoo_tpu.models.image.imageclassification import ImageClassifier
+
+
+@pytest.fixture()
+def remote_root():
+    from fsspec.implementations.memory import MemoryFileSystem
+    file_io.register_filesystem("fakegs", MemoryFileSystem())
+    import uuid
+    yield f"fakegs://bundles-{uuid.uuid4().hex[:8]}"
+    file_io.unregister_filesystem("fakegs")
+
+
+def _tiny_classifier():
+    clf = ImageClassifier("resnet18", num_classes=3,
+                          input_shape=(32, 32, 3),
+                          labels=["cat", "dog", "bird"])
+    clf._ensure_built()
+    clf.default_compile()
+    clf.predict(np.random.RandomState(0).rand(2, 32, 32, 3)
+                .astype(np.float32), batch_size=2)  # materialize params
+    return clf
+
+
+class TestBundleRoundTrip:
+    def test_remote_bundle_predicts_with_labels(self, ctx, remote_root):
+        """Save to a fake-remote URI, load back, predict with label names
+        through the bundled preprocessing — the full user journey."""
+        clf = _tiny_classifier()
+        uri = file_io.join(remote_root, "resnet18-tiny")
+        clf.save_pretrained(uri)
+        assert file_io.exists(file_io.join(uri, "zoo_bundle.json"))
+
+        loaded = ZooModel.load_pretrained(uri)
+        assert isinstance(loaded, ImageClassifier)
+        assert loaded.labels == ["cat", "dog", "bird"]
+
+        from analytics_zoo_tpu.feature.image import ImageSet
+        rs = np.random.RandomState(1)
+        imgs = [rs.randint(0, 255, (48, 40, 3)).astype(np.uint8)
+                for _ in range(3)]
+        preds = loaded.predict_image_set(ImageSet.from_arrays(imgs), top_k=2)
+        assert len(preds) == 3
+        for row in preds:
+            assert len(row) == 2
+            for label, prob in row:
+                assert label in {"cat", "dog", "bird"}
+                assert 0.0 <= prob <= 1.0
+
+    def test_bundle_predictions_bitmatch_source(self, ctx, tmp_path):
+        clf = _tiny_classifier()
+        x = np.random.RandomState(2).rand(4, 32, 32, 3).astype(np.float32)
+        want = np.asarray(clf.predict(x, batch_size=4))
+        clf.save_pretrained(str(tmp_path / "bundle"))
+        loaded = ZooModel.load_pretrained(str(tmp_path / "bundle"))
+        got = np.asarray(loaded.predict(x, batch_size=4))
+        np.testing.assert_array_equal(got, want)
+
+    def test_bundle_json_carries_preprocessing_spec(self, ctx, tmp_path):
+        clf = _tiny_classifier()
+        clf.save_pretrained(str(tmp_path / "b"))
+        bundle = json.loads((tmp_path / "b" / "zoo_bundle.json").read_text())
+        assert bundle["format"] == "zoo-tpu-bundle/1"
+        ops = [s["op"] for s in bundle["preprocessing"]]
+        assert ops == ["resize", "channel_normalize", "to_sample"]
+        assert bundle["preprocessing"][0]["height"] == 32
+        assert bundle["labels"] == ["cat", "dog", "bird"]
+
+    def test_load_pretrained_rejects_bare_checkpoint(self, ctx, tmp_path):
+        clf = _tiny_classifier()
+        clf.save_model(str(tmp_path / "plain"))
+        with pytest.raises(Exception):
+            ZooModel.load_pretrained(str(tmp_path / "plain"))
+
+
+class TestDetectionConfigRegistry:
+    def test_registry_has_published_variants(self):
+        assert {"ssd-vgg16-300x300", "ssd-vgg16-512x512",
+                "ssd-mobilenet-300x300"} <= set(DETECTION_CONFIGS)
+        cfg = detection_config("ssd-vgg16-300x300")
+        assert cfg["preprocess"]["mean"] == [123.0, 117.0, 104.0]
+        assert cfg["postprocess"]["iou_threshold"] == 0.45
+        with pytest.raises(ValueError):
+            detection_config("ssd-made-up")
+
+    def test_from_detection_config_builds_and_bundles(self, ctx, tmp_path):
+        det = ObjectDetector.from_detection_config(
+            "ssd-mobilenet-300x300", class_num=4,
+            labels=["bg", "person", "car", "dog"])
+        assert det.backbone == "mobilenet" and det.resolution == 300
+        spec = det.preprocessing_spec()
+        assert spec[1]["mean"] == [127.5, 127.5, 127.5]
+        det._ensure_built()
+        det.default_compile()
+        x = np.random.RandomState(0).rand(1, 300, 300, 3).astype(np.float32)
+        det.predict(x, batch_size=1)
+        det.save_pretrained(str(tmp_path / "ssd"))
+        loaded = ZooModel.load_pretrained(str(tmp_path / "ssd"))
+        assert loaded.labels == ["bg", "person", "car", "dog"]
+        boxes, scores, classes = loaded.detect(x, batch_size=1)
+        assert boxes.shape[0] == 1 and boxes.shape[2] == 4
+
+    def test_predict_image_set_uses_variant_postprocess(self, ctx):
+        det = ObjectDetector.from_detection_config("ssd-vgg16-300x300",
+                                                   class_num=3)
+        det._ensure_built()
+        det.default_compile()
+        from analytics_zoo_tpu.feature.image import ImageSet
+        rs = np.random.RandomState(3)
+        imgs = [rs.randint(0, 255, (320, 280, 3)).astype(np.uint8)]
+        boxes, scores, classes = det.predict_image_set(
+            ImageSet.from_arrays(imgs), max_detections=7)
+        assert boxes.shape[1] == 7 * (det.class_num - 1) or \
+            boxes.shape[1] <= 7 * det.class_num

@@ -121,6 +121,34 @@ class TestGoldenResnet18Import:
         np.testing.assert_allclose(np.asarray(clf2.predict(x)), want,
                                    atol=1e-5)
 
+    def test_golden_import_bundles_to_remote(self, ctx, imported, tmp_path):
+        # the golden torch import, shipped as ONE pretrained bundle over a
+        # fake-remote scheme, reloads with labels + torch padding geometry
+        # and reproduces the golden-validated predictions exactly
+        tm, *_ = imported
+        from fsspec.implementations.memory import MemoryFileSystem
+
+        from analytics_zoo_tpu.common import file_io
+        from analytics_zoo_tpu.models import ImageClassifier, ZooModel
+        clf = ImageClassifier("resnet18", num_classes=10,
+                              input_shape=(64, 64, 3),
+                              labels=[f"class_{i}" for i in range(10)])
+        clf.load_pretrained_torch(tm)
+        rs = np.random.RandomState(17)
+        x = rs.randn(2, 64, 64, 3).astype(np.float32)
+        want = np.asarray(clf.predict(x))
+        file_io.register_filesystem("goldfs", MemoryFileSystem())
+        try:
+            uri = "goldfs://zoo/resnet18-golden"
+            clf.save_pretrained(uri)
+            loaded = ZooModel.load_pretrained(uri)
+            assert loaded.padding_mode == "torch"
+            assert loaded.labels == [f"class_{i}" for i in range(10)]
+            np.testing.assert_allclose(np.asarray(loaded.predict(x)), want,
+                                       atol=1e-5)
+        finally:
+            file_io.unregister_filesystem("goldfs")
+
     def test_label_map_formats(self, tmp_path):
         import json
 
