@@ -663,6 +663,8 @@ class Estimator:
 
         pe = self.direct_eval_per_example_fn
         multiproc = self.ctx.process_count > 1
+        if not multiproc and val_set.size == 0:
+            raise ValueError("validation set is empty (0 records)")
         ndev = self.mesh.devices.size
         local_batch = self.ctx.local_batch(batch_size)
         if not multiproc:

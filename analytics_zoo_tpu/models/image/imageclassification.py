@@ -424,9 +424,7 @@ class ImageClassifier(ZooModel):
     def preprocessing(self):
         """The model's input chain (reference per-model configs). A
         bundle-loaded classifier uses the chain it shipped with."""
-        from ...feature.image.spec import build_preprocessing
-        spec = getattr(self, "_bundle_preprocessing", None)
-        return build_preprocessing(spec or self.preprocessing_spec())
+        return self.bundled_preprocessing()
 
     def predict_image_set(self, image_set, top_k: int = 5,
                           batch_size: int = 32):

@@ -54,11 +54,19 @@ class QueueBackend:
 
 
 class FileQueue(QueueBackend):
-    def __init__(self, root: str):
+    # a remote claim marker older than this is considered abandoned (the
+    # claiming consumer died between claim and cleanup) and is reaped so
+    # the record becomes claimable again — at-least-once past a crash, the
+    # same recovery stance as redis XAUTOCLAIM
+    CLAIM_LEASE_S = 300.0
+
+    def __init__(self, root: str, claim_lease_s: Optional[float] = None):
         self.root = root
         self.req_dir = file_io.join(root, "requests")
         self.claim_dir = file_io.join(root, "claimed")
         self.res_dir = file_io.join(root, "results")
+        self.claim_lease_s = (claim_lease_s if claim_lease_s is not None
+                              else self.CLAIM_LEASE_S)
         for d in (self.req_dir, self.claim_dir, self.res_dir):
             file_io.makedirs(d, exist_ok=True)
 
@@ -87,9 +95,26 @@ class FileQueue(QueueBackend):
             return dst
         marker = file_io.join(self.claim_dir, name + ".claim")
         try:
-            file_io.create_exclusive(marker)
+            file_io.create_exclusive(
+                marker, repr(time.time()).encode())
         except (FileExistsError, OSError):
-            return None
+            # marker held by another consumer — unless it's an expired
+            # lease from a consumer that died between claim and cleanup:
+            # reap it and retry ONCE (two reapers racing here collapse to
+            # one winner at the create_exclusive below)
+            try:
+                with file_io.fopen(marker, "rb") as f:
+                    stamp = float(f.read().decode() or 0)
+            except (OSError, ValueError, FileNotFoundError):
+                return None
+            if time.time() - stamp < self.claim_lease_s:
+                return None
+            try:
+                file_io.remove(marker)
+                file_io.create_exclusive(
+                    marker, repr(time.time()).encode())
+            except (FileExistsError, OSError):
+                return None
         return src
 
     def claim_batch(self, max_items: int) -> List[Tuple[str, Dict[str, Any]]]:

@@ -75,6 +75,17 @@ def main():
         pretty = ", ".join(f"{lbl}={p:.3f}" for lbl, p in preds)
         print(f"image {i}: {pretty}")
 
+    # ship the whole thing as ONE pretrained bundle (weights + config +
+    # label map + preprocessing spec) and reload it — works with gs:// URIs
+    # through the same call
+    from analytics_zoo_tpu.models import ZooModel
+    bundle_dir = tempfile.mkdtemp(prefix="zoo_bundle_")
+    clf.save_pretrained(bundle_dir)
+    reloaded = ZooModel.load_pretrained(bundle_dir)
+    assert reloaded.labels == clf.labels
+    print(f"bundle round-trip OK: {bundle_dir} "
+          f"({len(reloaded.labels)} labels, preproc spec included)")
+
 
 if __name__ == "__main__":
     main()

@@ -238,3 +238,30 @@ class TestRemoteSpoolClaims:
             assert q1.claim_batch(10) == [] and q2.claim_batch(10) == []
         finally:
             file_io.unregister_filesystem("spoolfs")
+
+    def test_expired_remote_claim_is_reaped(self):
+        """A consumer that died between claim and cleanup must not wedge
+        the record forever: once the lease expires another consumer
+        reclaims it (the redis XAUTOCLAIM stance)."""
+        from fsspec.implementations.memory import MemoryFileSystem
+
+        from analytics_zoo_tpu.common import file_io
+        from analytics_zoo_tpu.serving import FileQueue
+        import uuid as _uuid
+        file_io.register_filesystem("spoolfs2", MemoryFileSystem())
+        try:
+            root = f"spoolfs2://q-{_uuid.uuid4().hex[:8]}"
+            q1 = FileQueue(root, claim_lease_s=0.2)
+            q1.enqueue("u1", {"tensor": [1]})
+            # simulate a dead consumer: claim then never clean up
+            name = [n for n in file_io.listdir(
+                f"{root}/requests", refresh=True)
+                if not n.startswith(".")][0]
+            assert q1._claim_one(name) is not None
+            q2 = FileQueue(root, claim_lease_s=0.2)
+            assert q2.claim_batch(10) == []  # lease still live
+            time.sleep(0.3)
+            got = q2.claim_batch(10)  # expired: reaped + reclaimed
+            assert [u for u, _ in got] == ["u1"]
+        finally:
+            file_io.unregister_filesystem("spoolfs2")
