@@ -55,6 +55,7 @@ class Convolution2D(Layer):
                  activation=None, subsample=(1, 1), border_mode="valid",
                  init="glorot_uniform", bias: bool = True,
                  dilation=(1, 1), groups: int = 1,
+                 int8_training: bool = False,
                  name: Optional[str] = None):
         super().__init__(name)
         self.filters = nb_filter
@@ -66,6 +67,12 @@ class Convolution2D(Layer):
         self.use_bias = bias
         self.dilation = _pair(dilation)
         self.groups = groups
+        # EXPERIMENTAL: run the forward on the int8 MXU path with
+        # straight-through-estimator gradients and int8-stored residual
+        # activations (ops/int8_training.py) — the byte-cut lever past the
+        # bf16 HBM roofline. Quantization noise changes training numerics;
+        # opt-in per layer/model.
+        self.int8_training = int8_training
 
     def build(self, rng, input_shape):
         cin = input_shape[-1]
@@ -83,6 +90,10 @@ class Convolution2D(Layer):
             from ...inference.quantize import qconv_apply
             y = qconv_apply(inputs, kernel, self.strides, self.padding,
                             self.dilation, self.groups)
+        elif self.int8_training:
+            from ...ops.int8_training import int8_train_conv
+            y = int8_train_conv(inputs, kernel, self.strides, self.padding,
+                                self.dilation, self.groups)
         else:
             y = lax.conv_general_dilated(
                 inputs, kernel.astype(inputs.dtype),

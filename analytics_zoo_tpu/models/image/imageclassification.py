@@ -44,9 +44,10 @@ def _input_preprocess(x, mode: Optional[str]):
 
 
 def _conv_bn(x, filters, k, stride=1, activation="relu", name="",
-             border_mode="same"):
+             border_mode="same", int8=False):
     x = Convolution2D(filters, k, k, subsample=(stride, stride),
                       border_mode=border_mode, bias=False,
+                      int8_training=int8,
                       name=f"{name}_conv")(x)
     x = BatchNormalization(name=f"{name}_bn")(x)
     if activation:
@@ -54,23 +55,25 @@ def _conv_bn(x, filters, k, stride=1, activation="relu", name="",
     return x
 
 
-def _basic_block(x, filters, stride, name, pad3="same"):
+def _basic_block(x, filters, stride, name, pad3="same", int8=False):
     shortcut = x
-    y = _conv_bn(x, filters, 3, stride, "relu", f"{name}_a", pad3)
-    y = _conv_bn(y, filters, 3, 1, None, f"{name}_b", pad3)
+    y = _conv_bn(x, filters, 3, stride, "relu", f"{name}_a", pad3, int8)
+    y = _conv_bn(y, filters, 3, 1, None, f"{name}_b", pad3, int8)
     if stride != 1 or x.shape[-1] != filters:
-        shortcut = _conv_bn(x, filters, 1, stride, None, f"{name}_sc")
+        shortcut = _conv_bn(x, filters, 1, stride, None, f"{name}_sc",
+                            int8=int8)
     return Activation("relu", name=f"{name}_out")(
         merge([y, shortcut], mode="sum"))
 
 
-def _bottleneck_block(x, filters, stride, name, pad3="same"):
+def _bottleneck_block(x, filters, stride, name, pad3="same", int8=False):
     shortcut = x
-    y = _conv_bn(x, filters, 1, 1, "relu", f"{name}_a")
-    y = _conv_bn(y, filters, 3, stride, "relu", f"{name}_b", pad3)
-    y = _conv_bn(y, filters * 4, 1, 1, None, f"{name}_c")
+    y = _conv_bn(x, filters, 1, 1, "relu", f"{name}_a", int8=int8)
+    y = _conv_bn(y, filters, 3, stride, "relu", f"{name}_b", pad3, int8)
+    y = _conv_bn(y, filters * 4, 1, 1, None, f"{name}_c", int8=int8)
     if stride != 1 or x.shape[-1] != filters * 4:
-        shortcut = _conv_bn(x, filters * 4, 1, stride, None, f"{name}_sc")
+        shortcut = _conv_bn(x, filters * 4, 1, stride, None, f"{name}_sc",
+                            int8=int8)
     return Activation("relu", name=f"{name}_out")(
         merge([y, shortcut], mode="sum"))
 
@@ -79,7 +82,8 @@ def resnet(depth: int = 50, num_classes: int = 1000,
            input_shape: Tuple[int, int, int] = (224, 224, 3),
            include_top: bool = True,
            preprocess: Optional[str] = None,
-           padding_mode: str = "same") -> Model:
+           padding_mode: str = "same",
+           int8_training: bool = False) -> Model:
     """ResNet-v1 (18/34/50/101/152).
 
     ``padding_mode="torch"`` reproduces torch geometry exactly (symmetric
@@ -96,7 +100,8 @@ def resnet(depth: int = 50, num_classes: int = 1000,
     pad3 = 1 if torch_geo else "same"
     inp = Input(input_shape, name="image")
     x = _input_preprocess(inp, preprocess)
-    x = _conv_bn(x, 64, 7, 2, "relu", "stem", 3 if torch_geo else "same")
+    x = _conv_bn(x, 64, 7, 2, "relu", "stem", 3 if torch_geo else "same",
+                 int8=int8_training)
     x = MaxPooling2D((3, 3), strides=(2, 2),
                      border_mode=1 if torch_geo else "same",
                      name="stem_pool")(x)
@@ -105,7 +110,8 @@ def resnet(depth: int = 50, num_classes: int = 1000,
         for i in range(n):
             stride = 2 if (i == 0 and stage > 0) else 1
             x = block_fn(x, filters, stride,
-                         f"stage{stage + 1}_block{i + 1}", pad3)
+                         f"stage{stage + 1}_block{i + 1}", pad3,
+                         int8=int8_training)
         filters *= 2
     if not include_top:
         return Model(inp, x, name=f"resnet{depth}_features")

@@ -156,17 +156,35 @@ def _fed_rate(est, train_set, batch_size: int, iters: int = 24,
     samples/sec over ``iters`` post-warmup iterations — wall clock, nothing
     subtracted: this number deliberately includes host+transfer costs.
     ``steps_per_dispatch`` amortizes the tunnel's per-dispatch RPC latency
-    exactly as a production remote-attached deployment would."""
+    exactly as a production remote-attached deployment would. For the
+    measurement the DeviceFeed depth is pinned to 1 via the config
+    registry ("data.prefetch") — the tunnel rate-limits sustained
+    transfers (measured: 52 → 9 img/s raw device_put within minutes of
+    heavy traffic), so speculative prefetch beyond the measured
+    iterations actively corrupts the number."""
+    from analytics_zoo_tpu.common.config import global_config
     from analytics_zoo_tpu.common.triggers import MaxIteration
 
-    est.train(train_set, batch_size,
-              end_trigger=MaxIteration(est.global_step + warm_iters),
-              steps_per_dispatch=steps_per_dispatch)
-    start = time.perf_counter()
-    est.train(train_set, batch_size,
-              end_trigger=MaxIteration(est.global_step + iters),
-              steps_per_dispatch=steps_per_dispatch)
-    elapsed = time.perf_counter() - start
+    cfg = global_config()
+    had_override = "data.prefetch" in cfg._overrides
+    saved = cfg.get("data.prefetch")
+    cfg.set("data.prefetch", 1)
+    try:
+        est.train(train_set, batch_size,
+                  end_trigger=MaxIteration(est.global_step + warm_iters),
+                  steps_per_dispatch=steps_per_dispatch)
+        start = time.perf_counter()
+        est.train(train_set, batch_size,
+                  end_trigger=MaxIteration(est.global_step + iters),
+                  steps_per_dispatch=steps_per_dispatch)
+        elapsed = time.perf_counter() - start
+    finally:
+        # don't pin a permanent override where none existed (it would
+        # shadow later env/file config changes)
+        if had_override:
+            cfg.set("data.prefetch", saved)
+        else:
+            cfg.unset("data.prefetch")
     return batch_size * iters / elapsed
 
 
@@ -316,21 +334,31 @@ def bench_resnet50(batch_size: int = 256, steps: int = 20, warmup: int = 3):
     raw = rs.randint(0, 255, (batch_size * 8, 224, 224, 3), dtype=np.uint8)
     labels = rs.randint(0, 2, batch_size * 8).astype(np.float32)
     fed_set = FeatureSet.from_ndarrays(raw, labels, shuffle=True)
-    try:
-        fed = round(_fed_rate(fed_est, fed_set, batch_size), 1)
-        # wire floor measured in the SAME run: one batch's device_put
-        # bandwidth bounds any host-fed rate on this tunnel — fed ≈ floor
-        # means the framework machinery adds nothing on top of the wire
-        import jax as _jax
+
+    # the fed phase is bracketed by raw device_put probes: the tunnel
+    # rate-limits sustained transfers, so a floor measured minutes earlier
+    # does not bound a later fed phase — fed is judged against the floor
+    # measured in ITS OWN window (fed ≈ floor ⇒ the train loop adds no
+    # host-side overhead beyond the wire)
+    import jax as _jax
+
+    def _wire_probe():
         one = raw[:batch_size]
-        ts = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            buf = _jax.device_put(one)
-            buf.block_until_ready()
-            float(jnp.sum(buf[:1, 0, 0].astype(jnp.float32)))
-            ts.append(time.perf_counter() - t0)
-        wire_floor = round(batch_size / min(ts), 1)
+        t0 = time.perf_counter()
+        buf = _jax.device_put(one)
+        buf.block_until_ready()
+        float(jnp.sum(buf[:1, 0, 0].astype(jnp.float32)))
+        return round(batch_size / (time.perf_counter() - t0), 1)
+
+    try:
+        _wire_probe()  # untimed warmup: compile the readback, first put
+        floor_before = _wire_probe()
+        # transfer-light measurement (8 iters = ONE 8-step dispatch group):
+        # the tunnel's rate limiter punishes anything heavier
+        fed = round(_fed_rate(fed_est, fed_set, batch_size, iters=8,
+                              warm_iters=8, steps_per_dispatch=8), 1)
+        floor_after = _wire_probe()
+        wire_floor = {"before": floor_before, "after": floor_after}
     except Exception as e:  # the fed add-on must not lose the headline
         fed = {"error": repr(e)[:200]}
         wire_floor = None
@@ -348,14 +376,16 @@ def bench_resnet50(batch_size: int = 256, steps: int = 20, warmup: int = 3):
                 "fed_note": "fed = Estimator.train from host ndarrays "
                             "(shuffle+uint8 transfer+device normalize+step, "
                             "wall clock, 8 steps/dispatch); wire_floor = "
-                            "the same run's raw device_put bandwidth for "
-                            "one batch — the tunnel's hard cap on ANY "
-                            "host-fed rate. fed ≈ floor means the train "
-                            "loop adds no host-side overhead beyond the "
-                            "wire; a direct-attached chip moves the floor "
-                            "to PCIe (>8GB/s, ~50k img/s) where the "
-                            "host-shuffle rate (~29k img/s, pipeline row) "
-                            "takes over",
+                            "raw device_put bandwidth probed immediately "
+                            "before/after — the tunnel RATE-LIMITS "
+                            "sustained transfers (52→9 img/s raw within "
+                            "minutes), so fed is only meaningful against "
+                            "its own window's floor. fed ≈ floor means "
+                            "the train loop adds no host-side overhead "
+                            "beyond the wire; a direct-attached chip "
+                            "moves the floor to PCIe (>8GB/s, ~50k "
+                            "img/s) where the host-shuffle rate (~29k "
+                            "img/s, pipeline row) takes over",
                 "loop": "differenced: t(2N)-t(N) over two compiled "
                         "chained scans",
                 **_roofline_fields(flops, bytes_step, elapsed, steps),

@@ -1,0 +1,105 @@
+"""int8 training convolution (ops/int8_training.py): forward numerics vs
+the float conv, STE gradient sanity, and end-to-end convergence of an
+int8-conv network — the experimental byte-cut lever past the bf16 HBM
+roofline (new TPU-native capability; the reference's int8 is
+inference-only, ``examples/vnni/openvino/Perf.scala:1``)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.ops.int8_training import int8_train_conv
+
+
+class TestInt8TrainConv:
+    def _pair(self, seed=0, shape=(2, 8, 8, 16), cout=32, k=3):
+        rs = np.random.RandomState(seed)
+        x = jnp.asarray(rs.randn(*shape).astype(np.float32))
+        w = jnp.asarray(rs.randn(k, k, shape[-1], cout).astype(np.float32)
+                        * 0.1)
+        return x, w
+
+    def test_forward_close_to_float(self):
+        x, w = self._pair()
+        got = int8_train_conv(x, w, (1, 1), "SAME", (1, 1), 1)
+        want = jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        err = float(jnp.max(jnp.abs(got - want))
+                    / jnp.max(jnp.abs(want)))
+        # two int8 quantizations: ~1% relative error expected
+        assert err < 0.05, err
+
+    def test_ste_gradients_close_to_float(self):
+        x, w = self._pair(seed=1)
+
+        def loss_q(x, w):
+            return jnp.sum(int8_train_conv(x, w, (2, 2), "SAME",
+                                           (1, 1), 1) ** 2)
+
+        def loss_f(x, w):
+            return jnp.sum(jax.lax.conv_general_dilated(
+                x, w, (2, 2), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC")) ** 2)
+
+        gq = jax.grad(loss_q, argnums=(0, 1))(x, w)
+        gf = jax.grad(loss_f, argnums=(0, 1))(x, w)
+        for q, f in zip(gq, gf):
+            q, f = np.asarray(q, np.float32), np.asarray(f, np.float32)
+            denom = max(float(np.max(np.abs(f))), 1e-6)
+            assert float(np.max(np.abs(q - f))) / denom < 0.08
+            assert np.isfinite(q).all()
+
+    def test_grad_dtype_follows_inputs(self):
+        x, w = self._pair(seed=2)
+        xb = x.astype(jnp.bfloat16)
+
+        def loss(x_, w_):
+            return jnp.sum(int8_train_conv(x_, w_, (1, 1), "SAME",
+                                           (1, 1), 1)
+                           .astype(jnp.float32))
+
+        dx, dw = jax.grad(loss, argnums=(0, 1))(xb, w)
+        assert dx.dtype == jnp.bfloat16
+        assert dw.dtype == jnp.float32
+
+    def test_int8_network_converges(self, ctx):
+        """A small int8-conv classifier must train (loss decreasing into
+        the same ballpark as the float version)."""
+        from analytics_zoo_tpu.estimator import Estimator
+        from analytics_zoo_tpu.feature import FeatureSet
+        from analytics_zoo_tpu.keras import (Input, Model, objectives,
+                                             optimizers)
+        from analytics_zoo_tpu.keras.layers import (Convolution2D, Dense,
+                                                    GlobalAveragePooling2D)
+
+        rs = np.random.RandomState(0)
+        n = 256
+        x = rs.rand(n, 12, 12, 3).astype(np.float32)
+        # learnable rule: mean brightness of a quadrant decides the class
+        y = (x[:, :6, :6].mean(axis=(1, 2, 3)) > 0.5).astype(np.float32)
+
+        def build(int8):
+            inp = Input((12, 12, 3), name="img")
+            h = Convolution2D(16, 3, 3, activation="relu",
+                              border_mode="same", int8_training=int8,
+                              name="c1")(inp)
+            h = Convolution2D(16, 3, 3, activation="relu",
+                              border_mode="same", int8_training=int8,
+                              name="c2")(h)
+            h = GlobalAveragePooling2D(name="gap")(h)
+            out = Dense(2, activation="softmax", name="logits")(h)
+            return Model(inp, out)
+
+        losses = {}
+        for tag, int8 in (("float", False), ("int8", True)):
+            est = Estimator(
+                model=build(int8),
+                loss_fn=objectives.get("sparse_categorical_crossentropy"),
+                optimizer=optimizers.Adam(5e-3))
+            hist = est.train(FeatureSet.from_ndarrays(x, y, shuffle=False),
+                             batch_size=64, epochs=60)
+            losses[tag] = hist["loss_history"]
+        assert losses["int8"][-1] < losses["int8"][0] * 0.75
+        # tracks the float trajectory (measured: 0.492 vs 0.470 at the
+        # same step count — quantization noise, not brokenness)
+        assert losses["int8"][-1] < losses["float"][-1] * 1.15 + 0.02
