@@ -115,6 +115,9 @@ def fopen(path: str, mode: str = "r", encoding: Optional[str] = None,
     return fs.open(str(path), mode, **text_kw)
 
 
+_warned_non_exclusive: set = set()
+
+
 def create_exclusive(path: str, data: bytes = b"") -> None:
     """Create ``path`` failing with FileExistsError if it already exists —
     the claim-marker primitive for multi-consumer queues. Atomic on posix
@@ -136,8 +139,17 @@ def create_exclusive(path: str, data: bytes = b"") -> None:
         f = fs.open(str(path), "xb")
     except FileExistsError:
         raise
-    except (ValueError, NotImplementedError, OSError):
-        # backend without exclusive mode: exists-check + write
+    except (ValueError, NotImplementedError):
+        # "mode unsupported" signals only: a transient network/auth OSError
+        # must NOT silently degrade the claim to the non-atomic path — it
+        # propagates to the caller instead
+        scheme = path.split("://")[0]
+        if scheme not in _warned_non_exclusive:  # once per scheme, not
+            _warned_non_exclusive.add(scheme)    # per claim-poll
+            import logging
+            logging.getLogger(__name__).warning(
+                "backend for %s lacks exclusive-create; claim markers "
+                "degrade to a non-atomic exists-check + write", scheme)
         if fs.exists(str(path)):
             raise FileExistsError(path)
         f = fs.open(str(path), "wb")

@@ -23,6 +23,12 @@ class TrainingState:
     score: Optional[float] = None  # last validation score
     record_count: int = 0          # samples consumed in current epoch
     epoch_finished: bool = False   # set by the loop at epoch boundary
+    #: steps the loop advanced since the previous trigger check (K under
+    #: ``steps_per_dispatch=K``); interval triggers fire on BOUNDARY
+    #: CROSSINGS within that window rather than exact multiples, so
+    #: non-aligned intervals quantize to the group boundary instead of
+    #: being skipped
+    dispatch_width: int = 1
     # Zoo-state extras (sub-epoch slicing, ZooTrigger.setZooState equivalent):
     num_slices: int = 1
     slice_index: int = 0           # current sub-epoch slice
@@ -65,14 +71,27 @@ class EveryEpoch(Trigger):
 
 
 class SeveralIteration(Trigger):
+    """Fires every ``interval`` iterations (``ZooTrigger.scala`` severalIteration).
+
+    Under multi-step dispatch the counter advances ``dispatch_width`` steps
+    between checks; this fires whenever an interval boundary was crossed
+    inside that window (e.g. interval=100, width=8 fires at iteration 104),
+    which reduces to exact ``iteration % interval == 0`` at width 1.
+    """
+
     requires_loss = False
+
     def __init__(self, interval: int):
         if interval <= 0:
             raise ValueError("interval must be positive")
         self.interval = interval
 
     def __call__(self, state: TrainingState) -> bool:
-        return state.iteration > 0 and state.iteration % self.interval == 0
+        if state.iteration <= 0:
+            return False
+        width = max(1, state.dispatch_width)
+        prev = max(0, state.iteration - width)
+        return state.iteration // self.interval > prev // self.interval
 
 
 class MaxEpoch(Trigger):

@@ -396,9 +396,11 @@ class Estimator:
               steps_per_dispatch: int = 1) -> Dict[str, Any]:
         """``steps_per_dispatch > 1`` runs K train steps per device dispatch
         (host stacks K batches, the device scans over them): trigger checks,
-        per-step TB scalars and loss syncs then happen every K steps, and
-        ``MaxIteration`` end triggers may overshoot by up to K-1 steps.
-        Groups never span an epoch boundary."""
+        per-step TB scalars and loss syncs then happen every K steps —
+        interval triggers (``SeveralIteration``) fire whenever a boundary is
+        crossed inside the K-step group (quantized to the group boundary,
+        never skipped) — and ``MaxIteration`` end triggers may overshoot by
+        up to K-1 steps. Groups never span an epoch boundary."""
         cfg = global_config()
         if end_trigger is None:
             end_trigger = MaxEpoch(epochs if epochs is not None else 1)
@@ -515,6 +517,7 @@ class Estimator:
                     epoch_iter += g
                     self._epoch_offset = epoch_iter
                     state.iteration = self.global_step
+                    state.dispatch_width = g
                     pending.append(losses)
 
                     if need_loss:
@@ -587,6 +590,16 @@ class Estimator:
                     "training step failed; resuming from checkpoint "
                     "(%d retries left)", retries_left)
                 pending.clear()  # discard losses from the failed dispatch
+                try:
+                    # drain a failed BACKGROUND write separately: it must not
+                    # consume the retry or mask the step failure being
+                    # retried (snapshot writes are atomic-publish, so the
+                    # newest intact snapshot is still loadable)
+                    self._ckpt_writer.wait()
+                except RuntimeError:
+                    logger.exception(
+                        "background checkpoint write had failed; retrying "
+                        "from the newest intact snapshot anyway")
                 self.load_checkpoint(self._latest_snapshot())
                 state.epoch = self.epoch
                 state.iteration = self.global_step

@@ -14,13 +14,14 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..common import ZooModel, register_zoo_model
-from ...keras import Input, Model
+from ...keras import Input, Layer, Model
 from ...keras.layers import (
     Activation, AveragePooling2D, BatchNormalization, Convolution2D, Dense,
     Dropout, Flatten, GlobalAveragePooling2D, Lambda, MaxPooling2D, merge)
 
-_RESNET_BLOCKS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3),
-                  101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+# the ONE stage table both the bf16 builder and the int8-dataflow backbone
+# plan from (they must agree on architecture per depth)
+from ...ops.int8_dataflow import _RESNET_BLOCKS
 
 # canonical ImageNet statistics in pixel units — the ONE definition used by
 # on-device preprocess, the host ChannelNormalize chain, and bench.py
@@ -78,22 +79,69 @@ def _bottleneck_block(x, filters, stride, name, pad3="same", int8=False):
         merge([y, shortcut], mode="sum"))
 
 
+class Int8DataflowBackbone(Layer):
+    """Whole ResNet backbone with int8 tensors BETWEEN layers (delayed
+    scaling, custom whole-backbone vjp) — see ``ops/int8_dataflow.py``.
+    A single Layer because int8 graph edges carry (int8, scale) pairs the
+    generic layer graph doesn't thread."""
+
+    def __init__(self, depth: int, input_shape: Tuple[int, int, int],
+                 name: Optional[str] = None):
+        super().__init__(name)
+        from ...ops.int8_dataflow import Int8ResNetDataflow
+        self._flow = Int8ResNetDataflow(depth, input_shape)
+
+    def build(self, rng, input_shape):
+        return self._flow.init(rng)
+
+    def call(self, params, state, inputs, *, training=False, rng=None):
+        return self._flow.apply(params, state, inputs, training)
+
+    def compute_output_shape(self, input_shape):
+        h, w = input_shape[1], input_shape[2]
+        return (input_shape[0], -(-h // 32), -(-w // 32),
+                self._flow.out_channels)
+
+
 def resnet(depth: int = 50, num_classes: int = 1000,
            input_shape: Tuple[int, int, int] = (224, 224, 3),
            include_top: bool = True,
            preprocess: Optional[str] = None,
            padding_mode: str = "same",
-           int8_training: bool = False) -> Model:
+           int8_training: bool = False,
+           dataflow: Optional[str] = None) -> Model:
     """ResNet-v1 (18/34/50/101/152).
 
     ``padding_mode="torch"`` reproduces torch geometry exactly (symmetric
     explicit pads on the stride-2 convs and the stem pool, where SAME pads
     asymmetrically) so imported torchvision weights are bit-faithful — the
     golden-import test depends on it.
+
+    ``dataflow="int8"`` swaps the backbone for the quantized-dataflow int8
+    implementation (int8 inter-layer tensors, delayed scales, int8 MXU
+    convs) — the byte-cut lever past the bf16 HBM roofline; see
+    ``ops/int8_dataflow.py``.
     """
     if depth not in _RESNET_BLOCKS:
         raise ValueError(f"unsupported depth {depth}; have "
                          f"{sorted(_RESNET_BLOCKS)}")
+    if dataflow == "int8":
+        if padding_mode != "same" or int8_training:
+            raise ValueError(
+                "dataflow='int8' uses its own backbone (SAME padding, int8 "
+                "convs throughout); it composes with neither "
+                "padding_mode='torch' nor the per-layer int8_training flag")
+        inp = Input(input_shape, name="image")
+        x = _input_preprocess(inp, preprocess)
+        x = Int8DataflowBackbone(depth, input_shape,
+                                 name="int8_backbone")(x)
+        if not include_top:
+            return Model(inp, x, name=f"resnet{depth}_int8_features")
+        x = GlobalAveragePooling2D(name="avg_pool")(x)
+        out = Dense(num_classes, activation="softmax", name="logits")(x)
+        return Model(inp, out, name=f"resnet{depth}_int8")
+    elif dataflow is not None:
+        raise ValueError(f"unknown dataflow mode {dataflow!r}")
     torch_geo = padding_mode == "torch"
     blocks = _RESNET_BLOCKS[depth]
     block_fn = _basic_block if depth < 50 else _bottleneck_block

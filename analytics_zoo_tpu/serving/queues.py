@@ -99,22 +99,84 @@ class FileQueue(QueueBackend):
                 marker, repr(time.time()).encode())
         except (FileExistsError, OSError):
             # marker held by another consumer — unless it's an expired
-            # lease from a consumer that died between claim and cleanup:
-            # reap it and retry ONCE (two reapers racing here collapse to
-            # one winner at the create_exclusive below)
-            try:
-                with file_io.fopen(marker, "rb") as f:
-                    stamp = float(f.read().decode() or 0)
-            except (OSError, ValueError, FileNotFoundError):
+            # lease from a consumer that died between claim and cleanup.
+            # Reaping (remove + recreate) is NOT atomic, so two reapers
+            # interleaving could both "win" their create_exclusive (B
+            # creates fresh, C removes B's fresh marker and creates its
+            # own); a reap LOCK serializes them: only the exclusive-create
+            # winner of ``<marker>.reap`` may remove and recreate the
+            # claim marker.
+            def _read_raw(path):
+                try:
+                    with file_io.fopen(path, "rb") as f:
+                        return f.read().decode()
+                except (OSError, FileNotFoundError):
+                    return None
+
+            def _read_stamp(path):
+                raw = _read_raw(path)
+                try:
+                    # claim markers hold a bare stamp; reap locks hold
+                    # "stamp:token" — the first field is the stamp either way
+                    return float((raw or "").split(":")[0] or 0)
+                except ValueError:
+                    return None
+
+            stamp = _read_stamp(marker)
+            if stamp is None or time.time() - stamp < self.claim_lease_s:
                 return None
-            if time.time() - stamp < self.claim_lease_s:
-                return None
+            reap_lock = marker + ".reap"
+            # unique stamp doubles as an ownership token: the finally
+            # below must not delete a lock some other consumer re-acquired
+            # after OUR tenure was (legitimately) declared stale
+            lock_token = f"{time.time()!r}:{uuid.uuid4().hex}"
             try:
-                file_io.remove(marker)
-                file_io.create_exclusive(
-                    marker, repr(time.time()).encode())
+                file_io.create_exclusive(reap_lock, lock_token.encode())
             except (FileExistsError, OSError):
+                # another consumer is reaping; if the LOCK itself is stale
+                # (its holder died mid-reap), clear it so a later pass can
+                # retry. The 2x-lease margin is the standard lease-system
+                # stall bound: deleting a LIVE lock here would need the
+                # reader to stall >1 full lease between read and remove.
+                lock_stamp = _read_stamp(reap_lock)
+                if (lock_stamp is not None
+                        and time.time() - lock_stamp
+                        >= 2 * self.claim_lease_s):
+                    try:
+                        file_io.remove(reap_lock)
+                    except (OSError, FileNotFoundError):
+                        pass
                 return None
+            try:
+                # RE-VALIDATE under the lock: a previous reaper may have
+                # already reclaimed this marker between our staleness read
+                # and the lock acquisition — its fresh claim must survive
+                stamp = _read_stamp(marker)
+                if stamp is None or \
+                        time.time() - stamp < self.claim_lease_s:
+                    return None
+                try:
+                    file_io.remove(marker)
+                except (OSError, FileNotFoundError):
+                    pass
+                # a fresh (non-reaping) consumer may slip in between the
+                # remove and this create — then IT owns the claim and this
+                # create fails: exactly one winner either way
+                try:
+                    file_io.create_exclusive(
+                        marker, repr(time.time()).encode())
+                except (FileExistsError, OSError):
+                    return None
+            finally:
+                # release ONLY if we still own it: a reaper that stalled
+                # past the 2x-lease margin may find its lock legitimately
+                # cleared and re-acquired by another consumer — deleting
+                # that live lock would re-open the two-reaper race
+                if _read_raw(reap_lock) == lock_token:
+                    try:
+                        file_io.remove(reap_lock)
+                    except (OSError, FileNotFoundError):
+                        pass
         return src
 
     def claim_batch(self, max_items: int) -> List[Tuple[str, Dict[str, Any]]]:

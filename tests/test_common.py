@@ -76,6 +76,23 @@ class TestTriggers:
         fired = [i for i in range(1, 10) if t(TrainingState(iteration=i))]
         assert fired == [3, 6, 9]
 
+    def test_several_iteration_dispatch_width(self):
+        # multi-step dispatch: the counter advances by width per check;
+        # non-aligned intervals fire at the first check past the boundary
+        # (quantized, not skipped)
+        t = SeveralIteration(100)
+        checks = range(8, 1000, 8)  # iteration after each 8-step dispatch
+        fired = [i for i in checks
+                 if t(TrainingState(iteration=i, dispatch_width=8))]
+        assert fired == [104, 200, 304, 400, 504, 600, 704, 800, 904]
+        # aligned interval unchanged: every 96 with width 8
+        t2 = SeveralIteration(96)
+        fired2 = [i for i in checks
+                  if t2(TrainingState(iteration=i, dispatch_width=8))]
+        assert fired2 == [96, 192, 288, 384, 480, 576, 672, 768, 864, 960]
+        # width never makes it fire twice for one boundary
+        assert len(fired) == len(set(i // 100 for i in fired))
+
     def test_max_epoch_iteration(self):
         assert MaxEpoch(2)(TrainingState(epoch=3))
         assert not MaxEpoch(2)(TrainingState(epoch=2))

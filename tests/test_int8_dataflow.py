@@ -1,0 +1,163 @@
+"""Quantized-dataflow int8 ResNet: op-level gradient correctness, forward
+parity with the float mirror, end-to-end Estimator training descent, and
+the eval/running-stats path. (Reference parity note: the reference's int8
+is OpenVINO inference-only — ``examples/vnni/openvino/Perf.scala`` — so the
+bar here is self-consistency against this module's own float reference.)"""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+from analytics_zoo_tpu.ops import int8_dataflow as d8  # noqa: E402
+from analytics_zoo_tpu.ops.int8_dataflow import Int8ResNetDataflow  # noqa: E402
+
+
+def _cos(a, b):
+    a = np.asarray(a, np.float64).ravel()
+    b = np.asarray(b, np.float64).ravel()
+    return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+
+class TestConvBNOp:
+    def test_bwd_matches_float_vjp(self):
+        """Hand-written conv+BN+relu backward vs jax.vjp of the same float
+        math on the dequantized input (isolates op logic from input quant
+        noise); cos similarity must be ~1 for all four gradients."""
+        rs = np.random.RandomState(0)
+        N, H, W, Cin, Cout, K = 4, 16, 16, 8, 16, 3
+        x = jnp.asarray(rs.randn(N, H, W, Cin).astype(np.float32))
+        w = jnp.asarray((rs.randn(K, K, Cin, Cout) * 0.2).astype(np.float32))
+        gamma = jnp.asarray(1.0 + 0.1 * rs.randn(Cout).astype(np.float32))
+        beta = jnp.asarray(0.1 * rs.randn(Cout).astype(np.float32))
+        g_out = jnp.asarray(rs.randn(N, H, W, Cout).astype(np.float32))
+
+        sx = jnp.float32(np.abs(np.asarray(x)).max() / 127.0)
+        xq = d8._quant(x, sx)
+        mid_run = jnp.full((Cout,), 8.0, jnp.float32)
+        _, aux, _, _ = d8._conv_bn_fwd(xq, sx, w, gamma, beta, mid_run,
+                                       True, (1, 1), "SAME")
+        mid_run = jnp.maximum(0.99 * mid_run, aux[0])  # warmed delayed scale
+        y, aux, res, _ = d8._conv_bn_fwd(xq, sx, w, gamma, beta, mid_run,
+                                         True, (1, 1), "SAME")
+        s_out = d8._scale_of(jnp.asarray(np.abs(np.asarray(y)).max()))
+        yq = d8._quant(y, s_out)
+        dx, dw, dgam, dbet = d8._conv_bn_bwd(
+            res, True, (1, 1), "SAME", yq, g_out.astype(jnp.bfloat16))
+
+        x_deq = d8._deq(xq, sx, jnp.float32)
+
+        def ref(x_, w_, gam, bet):
+            f = lax.conv_general_dilated(
+                x_, w_, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            mu = jnp.mean(f, axis=(0, 1, 2))
+            var = jnp.maximum(jnp.mean(f * f, axis=(0, 1, 2)) - mu * mu, 0.0)
+            z = (f - mu) * lax.rsqrt(var + 1e-5) * gam + bet
+            return jnp.maximum(z, 0.0)
+
+        _, vjp = jax.vjp(ref, x_deq, w, gamma, beta)
+        rdx, rdw, rdgam, rdbet = vjp(g_out)
+        assert _cos(dx, rdx) > 0.97
+        assert _cos(dw, rdw) > 0.97
+        assert _cos(dgam, rdgam) > 0.97
+        assert _cos(dbet, rdbet) > 0.95
+
+    def test_maxpool_int8_matches_float(self):
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.randn(2, 8, 8, 4).astype(np.float32))
+        s = jnp.float32(np.abs(np.asarray(x)).max() / 127.0)
+        q = d8._quant(x, s)
+        pooled_q = d8._maxpool_q(q, (3, 3), (2, 2), "SAME")
+        ref = lax.reduce_window(d8._deq(q, s, jnp.float32), -jnp.inf,
+                                lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+        np.testing.assert_allclose(
+            np.asarray(d8._deq(pooled_q, s, jnp.float32)), np.asarray(ref),
+            rtol=1e-5)
+
+
+class TestBackbone:
+    @pytest.fixture(scope="class")
+    def built(self):
+        bb = Int8ResNetDataflow(18, (32, 32, 3))
+        params, state = bb.init(jax.random.PRNGKey(0))
+        rs = np.random.RandomState(3)
+        x = jnp.asarray(rs.randn(8, 32, 32, 3).astype(np.float32))
+        for _ in range(3):  # warm the delayed scales
+            _, state = bb.apply(params, state, x, training=True)
+        return bb, params, state, x
+
+    def test_forward_close_to_float_mirror(self, built):
+        bb, params, state, x = built
+        fi, _ = bb.apply(params, state, x, training=True)
+        ff = bb.apply_float(params, x)
+        mi = float(jnp.mean(jnp.abs(fi.astype(jnp.float32))))
+        mf = float(jnp.mean(jnp.abs(ff)))
+        assert abs(mi - mf) / max(mf, 1e-6) < 0.15
+        assert _cos(fi.astype(jnp.float32), ff) > 0.95
+
+    def test_grads_correlate_with_float_late_layers(self, built):
+        """STE grads vs the float mirror: late layers must match tightly;
+        early layers accumulate quantization noise through depth (expected
+        — the descent test is the training-level check)."""
+        bb, params, state, x = built
+
+        def li(p):
+            f, _ = bb.apply(p, state, x, training=True)
+            return jnp.mean(f.astype(jnp.float32) ** 2)
+
+        def lf(p):
+            return jnp.mean(bb.apply_float(p, x) ** 2)
+
+        gi = jax.jit(jax.grad(li))(params)
+        gf = jax.jit(jax.grad(lf))(params)
+        assert _cos(gi["s4b2_b"]["gamma"], gf["s4b2_b"]["gamma"]) > 0.9
+        assert _cos(gi["s4b2_b"]["beta"], gf["s4b2_b"]["beta"]) > 0.9
+        assert _cos(gi["s4b2_b"]["kernel"], gf["s4b2_b"]["kernel"]) > 0.6
+
+    def test_state_updates(self, built):
+        bb, params, state, x = built
+        _, ns = bb.apply(params, state, x, training=True)
+        assert float(ns["in_amax"]) > 0
+        # running stats move toward batch stats
+        assert not np.allclose(np.asarray(ns["stem"]["running_mean"]),
+                               np.asarray(state["stem"]["running_mean"]))
+
+    def test_eval_uses_running_stats(self, built):
+        bb, params, state, x = built
+        f1, s1 = bb.apply(params, state, x, training=False)
+        assert s1 is state  # eval mutates nothing
+        # eval on a half batch must agree with eval on the full batch
+        # (running stats — no batch-size dependence)
+        f_half, _ = bb.apply(params, state, x[:4], training=False)
+        np.testing.assert_allclose(np.asarray(f1[:4], np.float32),
+                                   np.asarray(f_half, np.float32),
+                                   rtol=0.05, atol=0.05)
+
+
+class TestEstimatorIntegration:
+    def test_train_descends_and_predicts(self):
+        from analytics_zoo_tpu.estimator import Estimator
+        from analytics_zoo_tpu.feature import FeatureSet
+        from analytics_zoo_tpu.keras import objectives, optimizers
+        from analytics_zoo_tpu.models.image.imageclassification import resnet
+
+        model = resnet(18, num_classes=2, input_shape=(32, 32, 3),
+                       dataflow="int8")
+        est = Estimator(
+            model=model,
+            loss_fn=objectives.get("sparse_categorical_crossentropy"),
+            optimizer=optimizers.SGD(0.01, momentum=0.9),
+            compute_dtype=jnp.bfloat16)
+        rs = np.random.RandomState(0)
+        x = rs.rand(32, 32, 32, 3).astype(np.float32)
+        y = (x.mean(axis=(1, 2, 3)) > 0.5).astype(np.float32)
+        x[y == 1] += 0.3
+        fs = FeatureSet.from_ndarrays(x, y)
+        r = est.train(fs, batch_size=16, epochs=8)
+        h = r["loss_history"]
+        assert np.mean(h[-4:]) < np.mean(h[:4])
+        out = np.asarray(est.predict(x[:8], batch_size=8))
+        assert out.shape == (8, 2)
+        assert np.all(np.isfinite(out))

@@ -265,3 +265,86 @@ class TestRemoteSpoolClaims:
             assert [u for u, _ in got] == ["u1"]
         finally:
             file_io.unregister_filesystem("spoolfs2")
+
+    def test_reap_lock_serializes_reapers(self):
+        """Reaping an expired claim is remove+recreate — not atomic — so it
+        is guarded by an exclusive-create reap lock: while another consumer
+        holds the lock, a racing reaper must claim NOTHING (this is the
+        interleaving where two reapers could otherwise both win); a STALE
+        lock (reaper died mid-reap) is cleared so a later pass recovers."""
+        from fsspec.implementations.memory import MemoryFileSystem
+
+        from analytics_zoo_tpu.common import file_io
+        from analytics_zoo_tpu.serving import FileQueue
+        import uuid as _uuid
+        file_io.register_filesystem("spoolfs3", MemoryFileSystem())
+        try:
+            root = f"spoolfs3://q-{_uuid.uuid4().hex[:8]}"
+            q1 = FileQueue(root, claim_lease_s=0.2)
+            q1.enqueue("u1", {"tensor": [1]})
+            name = [n for n in file_io.listdir(
+                f"{root}/requests", refresh=True)
+                if not n.startswith(".")][0]
+            assert q1._claim_one(name) is not None  # dead consumer
+            time.sleep(0.3)  # lease expires
+            # another consumer is mid-reap: fresh reap lock held
+            marker = file_io.join(f"{root}/claimed", name + ".claim")
+            file_io.create_exclusive(marker + ".reap",
+                                     repr(time.time()).encode())
+            q2 = FileQueue(root, claim_lease_s=0.2)
+            assert q2.claim_batch(10) == []  # must not double-claim
+            assert file_io.exists(marker + ".reap")  # fresh lock untouched
+            # now the lock itself goes stale (its holder died mid-reap);
+            # clearing requires the conservative 2x-lease margin: one pass
+            # clears it, the next reclaims the record
+            time.sleep(0.45)
+            assert q2.claim_batch(10) == []
+            assert not file_io.exists(marker + ".reap")
+            got = q2.claim_batch(10)
+            assert [u for u, _ in got] == ["u1"]
+        finally:
+            file_io.unregister_filesystem("spoolfs3")
+
+    def test_reap_revalidates_marker_under_lock(self, monkeypatch):
+        """Two reapers that both read the same expired stamp must not both
+        reclaim: the second one re-reads the marker AFTER winning the reap
+        lock and must back off when it finds a fresh claim (simulated here
+        by serving it a fresh stamp on the re-validation read)."""
+        import io
+
+        from fsspec.implementations.memory import MemoryFileSystem
+
+        from analytics_zoo_tpu.common import file_io
+        from analytics_zoo_tpu.serving import FileQueue
+        import uuid as _uuid
+        file_io.register_filesystem("spoolfs4", MemoryFileSystem())
+        try:
+            root = f"spoolfs4://q-{_uuid.uuid4().hex[:8]}"
+            q1 = FileQueue(root, claim_lease_s=0.2)
+            q1.enqueue("u1", {"tensor": [1]})
+            name = [n for n in file_io.listdir(
+                f"{root}/requests", refresh=True)
+                if not n.startswith(".")][0]
+            assert q1._claim_one(name) is not None  # dead consumer
+            time.sleep(0.3)  # lease expires
+            marker = file_io.join(f"{root}/claimed", name + ".claim")
+            orig_fopen = file_io.fopen
+            marker_reads = []
+
+            def fake_fopen(path, mode="r", **kw):
+                if path == marker and "r" in str(mode):
+                    marker_reads.append(1)
+                    if len(marker_reads) == 2:
+                        # re-validation read: another reaper reclaimed it
+                        # a moment ago — the stamp is fresh now
+                        return io.BytesIO(repr(time.time()).encode())
+                return orig_fopen(path, mode, **kw)
+
+            monkeypatch.setattr(file_io, "fopen", fake_fopen)
+            q2 = FileQueue(root, claim_lease_s=0.2)
+            assert q2._claim_one(name) is None  # backed off under the lock
+            assert len(marker_reads) == 2
+            assert file_io.exists(marker)  # the fresh claim survived
+            assert not file_io.exists(marker + ".reap")  # lock released
+        finally:
+            file_io.unregister_filesystem("spoolfs4")
