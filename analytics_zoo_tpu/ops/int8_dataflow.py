@@ -115,7 +115,6 @@ def _conv_bn_fwd(xq, sx, w, gamma, beta, s_mid_run, relu, strides, padding):
     wq, sw = _quantize_weight_pc(w)
     acc = _int8_conv(xq, wq, strides, padding)
     f = acc.astype(jnp.float32) * (sx * sw)  # true conv output, per-channel
-    n = f.shape[0] * f.shape[1] * f.shape[2]
     mean = jnp.mean(f, axis=(0, 1, 2))
     var = jnp.maximum(jnp.mean(f * f, axis=(0, 1, 2)) - mean * mean, 0.0)
     amax_mid = _amax(f, per_channel=True)
@@ -129,7 +128,7 @@ def _conv_bn_fwd(xq, sx, w, gamma, beta, s_mid_run, relu, strides, padding):
     amax_out = jnp.max(jnp.abs(y))
     residuals = (xq, sx, w, gamma, q_mid, s_mid, mean, inv)
     aux = (amax_mid, amax_out, mean, var)
-    return y, aux, residuals, n
+    return y, aux, residuals
 
 
 def _conv_bn_bwd(residuals, relu, strides, padding, yq, dy):
@@ -301,11 +300,10 @@ class Int8ResNetDataflow:
         p = params[spec.name]
         st = state_in[spec.name]
         if training:
-            y, aux, res, n = _conv_bn_fwd(
+            y, aux, res = _conv_bn_fwd(
                 xq, sx, p["kernel"], p["gamma"], p["beta"], st["mid_amax"],
                 spec.relu, spec.strides, spec.padding)
             amax_mid, amax_out, mean, var = aux
-            del n
             s_out = _scale_of(st["out_amax"])
             yq = _quant(y, s_out)
             if tape is not None:

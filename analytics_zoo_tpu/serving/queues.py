@@ -115,10 +115,12 @@ class FileQueue(QueueBackend):
 
             def _read_stamp(path):
                 raw = _read_raw(path)
+                if raw is None:  # vanished = claim completed, NOT stale
+                    return None
                 try:
                     # claim markers hold a bare stamp; reap locks hold
                     # "stamp:token" — the first field is the stamp either way
-                    return float((raw or "").split(":")[0] or 0)
+                    return float(raw.split(":")[0] or 0)
                 except ValueError:
                     return None
 

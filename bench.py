@@ -1199,7 +1199,7 @@ def _compact_row(name, r):
     return row
 
 
-def _emit_final(results, platform, num_devices, partial=False):
+def _emit_final(results, platform, num_devices, partial=False, note=None):
     """Write the full detail to BENCH_DETAIL.json + a full-detail stdout
     line, then a COMPACT final line (< ~1800 chars — the driver's tail
     capture is 2000 chars and truncation loses the headline, as happened
@@ -1233,6 +1233,7 @@ def _emit_final(results, platform, num_devices, partial=False):
             "hbm_gbps_assumed": _HBM_GBPS,
             "full_detail": "BENCH_DETAIL.json",
             **({"partial": True} if partial else {}),
+            **({"preflight": note} if note else {}),
             "workloads": {n: _compact_row(n, r) for n, r in results.items()},
         },
     }
@@ -1262,25 +1263,54 @@ def main():
         ctx = init_tpu_context()
     results = {}
     platform, num_devices = "unknown", None
+    preflight_note = None
+    per_cap = _PER_WORKLOAD_S
 
     def _finish(partial):
         if not results:
             results["none"] = _BenchResult(metric="no_workload_completed",
                                            value=None, unit="", mfu=None,
                                            detail={})
-        _emit_final(results, platform, num_devices, partial=partial)
+        _emit_final(results, platform, num_devices, partial=partial,
+                    note=preflight_note)
         sys.stdout.flush()
         os._exit(0)
 
     import signal
     for sig in (signal.SIGTERM, signal.SIGINT):
-        # the driver kills on ITS deadline with SIGTERM: publish whatever
-        # is already measured instead of dying with an empty tail
+        # installed BEFORE the preflight: the driver's deadline kill must
+        # produce a diagnostic final line even if it lands during the
+        # (up-to-240s) preflight probe
         signal.signal(sig, lambda *_: _finish(partial=True))
+
+    if isolate:
+        # backend preflight in a THROWAWAY child: when the TPU tunnel is
+        # down, jax backend init hangs indefinitely (observed >300s) — one
+        # cheap probe here turns nine 700s futile child timeouts into a
+        # fast sweep with a clear diagnostic in the final line
+        import subprocess
+        _log("preflight: probing device backend in a child")
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].device_kind)"],
+                capture_output=True, text=True, timeout=240)
+            ok = proc.returncode == 0
+            tailtxt = (proc.stdout + proc.stderr).strip()[-200:]
+        except Exception as e:
+            ok, tailtxt = False, repr(e)[:200]
+        if ok:
+            _log(f"preflight ok: {tailtxt.splitlines()[-1] if tailtxt else '?'}")
+        else:
+            preflight_note = (f"device backend preflight FAILED "
+                              f"({tailtxt}); attempting workloads with "
+                              f"shortened timeouts")
+            _log(preflight_note)
+            per_cap = 300.0
 
     for name in names:
         remaining = _BUDGET_S - (time.perf_counter() - _T0)
-        if isolate and remaining < 150:
+        if isolate and remaining < 150 and results:  # always try the first
             _log(f"budget exhausted ({remaining:.0f}s left): skipping {name}")
             results[name] = _BenchResult(
                 metric=f"{name}_skipped", value=None, unit="", mfu=None,
@@ -1295,7 +1325,7 @@ def main():
             if attempt > 0 and remaining < 150:
                 _log(f"budget exhausted mid-retry of {name}")
                 break
-            per = min(_PER_WORKLOAD_S, max(remaining - 60, 120))
+            per = min(per_cap, max(remaining - 60, 120))
             _log(f"running {name} (attempt {attempt + 1}, "
                  f"timeout {per:.0f}s)")
             try:

@@ -1,0 +1,56 @@
+"""Quantized-dataflow int8 ResNet training (new TPU-native capability; the
+reference's int8 story is OpenVINO inference-only,
+``zoo/examples/vnni/openvino/Perf.scala``).
+
+``resnet(dataflow="int8")`` swaps the backbone for the whole-backbone int8
+implementation (``ops/int8_dataflow.py``): int8 tensors flow BETWEEN
+layers under delayed (FP8-style) scaling, convs run on the int8 MXU path,
+and the saved activations are the int8 tensors themselves — the byte-cut
+lever past the bf16 step's HBM roofline (see docs/training.md).
+
+Usage:
+    python int8_dataflow_train.py                # ResNet-50 at 224px
+    python int8_dataflow_train.py --smoke        # ResNet-18 at 32px, CPU-ok
+"""
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu.estimator import Estimator
+from analytics_zoo_tpu.feature import FeatureSet
+from analytics_zoo_tpu.keras import objectives, optimizers
+from analytics_zoo_tpu.models.image.imageclassification import resnet
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=None)
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+    depth, size, n = (18, 32, 64) if args.smoke else (50, 224, 2048)
+    batch = args.batch_size or (16 if args.smoke else 256)
+    model = resnet(depth, num_classes=2, input_shape=(size, size, 3),
+                   dataflow="int8")
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, size, size, 3).astype(np.float32)
+    labels = (x.mean(axis=(1, 2, 3)) > 0.5).astype(np.float32)
+    x[labels == 1] += 0.3  # separable signal so the loss visibly descends
+    fs = FeatureSet.from_ndarrays(x, labels)
+
+    est = Estimator(model=model,
+                    loss_fn=objectives.get("sparse_categorical_crossentropy"),
+                    optimizer=optimizers.SGD(0.01, momentum=0.9),
+                    compute_dtype=jnp.bfloat16)
+    result = est.train(fs, batch_size=batch, epochs=args.epochs)
+    print(f"int8-dataflow train loss: {result['loss_history'][-1]:.4f} "
+          f"({result['iterations']} steps)")
+    probs = np.asarray(est.predict(x[:8], batch_size=8))
+    print(f"eval-path predictions (running stats): {probs.argmax(1)}")
+
+
+if __name__ == "__main__":
+    main()

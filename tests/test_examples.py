@@ -14,6 +14,7 @@ EXAMPLES = [
     "examples/recommendation/wide_and_deep_example.py",
     "examples/imageclassification/resnet_transfer.py",
     "examples/imageclassification/pretrained_import.py",
+    "examples/imageclassification/int8_dataflow_train.py",
     "examples/textclassification/bert_classifier_example.py",
     "examples/tfrecord/tfrecord_train.py",
     "examples/serving/serving_example.py",

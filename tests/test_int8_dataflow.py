@@ -36,10 +36,10 @@ class TestConvBNOp:
         sx = jnp.float32(np.abs(np.asarray(x)).max() / 127.0)
         xq = d8._quant(x, sx)
         mid_run = jnp.full((Cout,), 8.0, jnp.float32)
-        _, aux, _, _ = d8._conv_bn_fwd(xq, sx, w, gamma, beta, mid_run,
+        _, aux, _ = d8._conv_bn_fwd(xq, sx, w, gamma, beta, mid_run,
                                        True, (1, 1), "SAME")
         mid_run = jnp.maximum(0.99 * mid_run, aux[0])  # warmed delayed scale
-        y, aux, res, _ = d8._conv_bn_fwd(xq, sx, w, gamma, beta, mid_run,
+        y, aux, res = d8._conv_bn_fwd(xq, sx, w, gamma, beta, mid_run,
                                          True, (1, 1), "SAME")
         s_out = d8._scale_of(jnp.asarray(np.abs(np.asarray(y)).max()))
         yq = d8._quant(y, s_out)
@@ -134,6 +134,71 @@ class TestBackbone:
         np.testing.assert_allclose(np.asarray(f1[:4], np.float32),
                                    np.asarray(f_half, np.float32),
                                    rtol=0.05, atol=0.05)
+
+
+class TestConvergenceParity:
+    def test_tracks_float_mirror_training(self):
+        """Train the SAME architecture from the SAME init on the SAME data
+        twice — once through the int8 dataflow, once through the float
+        mirror (jax autodiff) — and require the int8 loss trajectory to
+        track the float one: quantization noise may slow it, but it must
+        descend to a comparable level (the int8_training op's 'float twin'
+        convergence stance, applied to the whole backbone)."""
+        import optax
+
+        bb = Int8ResNetDataflow(18, (24, 24, 3))
+        params0, state0 = bb.init(jax.random.PRNGKey(1))
+        rs = np.random.RandomState(7)
+        x = rs.rand(32, 24, 24, 3).astype(np.float32)
+        y = (x.mean(axis=(1, 2, 3)) > 0.5).astype(np.int32)
+        x[y == 1] += 0.4
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        head0 = jnp.asarray(rs.randn(512, 2).astype(np.float32) * 0.05)
+
+        def run(loss_fn, n_steps=10):
+            opt = optax.sgd(0.02, momentum=0.9)
+            carrier = {"p": params0, "h": head0, "s": state0}
+            opt_state = opt.init({"p": carrier["p"], "h": carrier["h"]})
+
+            @jax.jit
+            def step(carrier, opt_state):
+                def wrapped(tp):
+                    l, ns = loss_fn(tp["p"], tp["h"], carrier["s"])
+                    return l, ns
+                (l, ns), g = jax.value_and_grad(wrapped, has_aux=True)(
+                    {"p": carrier["p"], "h": carrier["h"]})
+                up, opt_state = opt.update(g, opt_state)
+                new = optax.apply_updates(
+                    {"p": carrier["p"], "h": carrier["h"]}, up)
+                return {"p": new["p"], "h": new["h"], "s": ns}, opt_state, l
+            losses = []
+            for _ in range(n_steps):
+                carrier, opt_state, l = step(carrier, opt_state)
+                losses.append(float(l))
+            return losses
+
+        def head_loss(feats, head):
+            logits = feats.reshape(feats.shape[0], -1).astype(
+                jnp.float32) @ head
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+
+        def int8_loss(p, h, s):
+            feats, ns = bb.apply(p, s, x, training=True)
+            return head_loss(feats, h), ns
+
+        def float_loss(p, h, s):
+            return head_loss(bb.apply_float(p, x), h), s
+
+        li = run(int8_loss)
+        lf = run(float_loss)
+        # both descend; int8 ends within 2x-ish of float's progress
+        assert li[-1] < li[0], li
+        assert lf[-1] < lf[0], lf
+        drop_i = li[0] - min(li)
+        drop_f = lf[0] - min(lf)
+        assert drop_i > 0.4 * drop_f, (li, lf)
 
 
 class TestEstimatorIntegration:
