@@ -20,8 +20,11 @@ from ...keras.layers import (
     Dropout, Flatten, GlobalAveragePooling2D, Lambda, MaxPooling2D, merge)
 
 # the ONE stage table both the bf16 builder and the int8-dataflow backbone
-# plan from (they must agree on architecture per depth)
-from ...ops.int8_dataflow import _RESNET_BLOCKS
+# plan from (ops/int8_dataflow imports it lazily; they must agree on
+# architecture per depth)
+RESNET_BLOCKS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3),
+                 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+_RESNET_BLOCKS = RESNET_BLOCKS
 
 # canonical ImageNet statistics in pixel units — the ONE definition used by
 # on-device preprocess, the host ChannelNormalize chain, and bench.py

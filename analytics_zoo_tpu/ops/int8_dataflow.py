@@ -187,8 +187,8 @@ def _maxpool_bwd(q, s, window, strides, padding, dy):
 # backbone builder
 # ---------------------------------------------------------------------------
 
-_RESNET_BLOCKS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3),
-                  101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+# canonical stage table lives with the model zoo; imported lazily so this
+# op module never imports the models package at load time
 
 
 class _ConvSpec:
@@ -203,9 +203,10 @@ class _ConvSpec:
 def _resnet_plan(depth: int, in_channels: int = 3):
     """Static op plan: list of ('conv', spec) / ('pool',) / ('block', ...)
     entries the tape walker follows. Returns (plan, out_channels)."""
-    if depth not in _RESNET_BLOCKS:
+    from ..models.image.imageclassification import RESNET_BLOCKS
+    if depth not in RESNET_BLOCKS:
         raise ValueError(f"unsupported depth {depth}")
-    blocks = _RESNET_BLOCKS[depth]
+    blocks = RESNET_BLOCKS[depth]
     bottleneck = depth >= 50
     plan: List[Tuple] = [("conv", _ConvSpec("stem", 7, in_channels, 64, 2,
                                             True)),

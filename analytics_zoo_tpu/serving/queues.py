@@ -110,7 +110,10 @@ class FileQueue(QueueBackend):
                 try:
                     with file_io.fopen(path, "rb") as f:
                         return f.read().decode()
-                except (OSError, FileNotFoundError):
+                except (OSError, FileNotFoundError, ValueError):
+                    # ValueError covers UnicodeDecodeError from a corrupt
+                    # or foreign marker: treat as unreadable, not fatal —
+                    # the poll loop must survive junk in the spool
                     return None
 
             def _read_stamp(path):

@@ -47,8 +47,20 @@ def test_example_smoke(script):
     # examples assume `pip install analytics-zoo-tpu`; in-tree CI runs them
     # against the checkout instead
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # force the CPU backend the way conftest does — via jax.config, BEFORE
+    # the script runs. The env-var route (JAX_PLATFORMS=cpu) is NOT enough:
+    # a sitecustomize-registered hardware plugin overrides it at interpreter
+    # start, so example children would initialize the remote-TPU backend —
+    # and hang for their full timeout whenever that tunnel is unhealthy
+    # (observed: the "CPU smoke" examples were in fact running over the
+    # tunnel whenever it was up)
+    path = os.path.join(REPO, script)
+    boot = ("import jax, runpy, sys; "
+            "jax.config.update('jax_platforms', 'cpu'); "
+            f"sys.argv = [{path!r}, '--smoke']; "
+            f"runpy.run_path({path!r}, run_name='__main__')")
     proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, script), "--smoke"],
+        [sys.executable, "-c", boot],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, (
         f"{script} failed:\n--- stdout ---\n{proc.stdout[-2000:]}\n"
