@@ -166,6 +166,21 @@ class TestCheckpoint:
         snaps = [d for d in os.listdir(tmp_path) if d.startswith("snapshot-")]
         assert len(snaps) == 2  # at iterations 2 and 4
 
+    def test_periodic_snapshots_multi_step_dispatch(self, ctx, tmp_path):
+        """Non-aligned interval under steps_per_dispatch: boundary
+        crossings quantize to the group boundary instead of being skipped
+        (interval 3, width 2 over 8 steps: boundary 3 fires at check 4,
+        boundary 6 at check 6; boundary 9 is past the epoch)."""
+        x, y = make_regression(n=512)
+        est = make_estimator()
+        est.set_checkpoint(str(tmp_path), SeveralIteration(3))
+        fs = FeatureSet.from_ndarrays(x, y)
+        est.train(fs, batch_size=64, epochs=1, steps_per_dispatch=2)
+        snaps = sorted(
+            int(d.split("-")[1]) for d in os.listdir(tmp_path)
+            if d.startswith("snapshot-"))
+        assert snaps == [4, 6], snaps
+
 
 class TestKerasFacade:
     def test_compile_fit_evaluate(self, ctx):
