@@ -119,6 +119,20 @@ _global_config.register("version_check", False,
                         "Warn on jax/libtpu version mismatches at context init "
                         "(reference: spark.analytics.zoo.versionCheck).")
 _global_config.register("data.prefetch", 2, "Device-feed prefetch depth.")
+_global_config.register("eval.async", True,
+                        "Pipeline evaluate()/predict() through the "
+                        "DeviceFeed with on-device accumulation (one host "
+                        "sync per pass). False falls back to the "
+                        "synchronous per-batch loops (parity reference / "
+                        "A-B benchmarking).")
+_global_config.register("eval.predict_window", 2,
+                        "Max in-flight predict dispatches before results "
+                        "are fetched behind the dispatch frontier.")
+_global_config.register("compile.cache_dir", "",
+                        "Directory for JAX's persistent compilation cache "
+                        "('' = disabled). Warm processes skip XLA "
+                        "recompiles of programs compiled by ANY earlier "
+                        "process pointed at the same dir.")
 _global_config.register("mesh.data_axis", "data", "Default data-parallel mesh axis name.")
 _global_config.register("mesh.model_axis", "model", "Default model-parallel mesh axis name.")
 _global_config.register("rng.impl", "",

@@ -58,6 +58,35 @@ class ZooTpuContext:
 
 _context_lock = threading.Lock()
 _context: Optional[ZooTpuContext] = None
+_cache_wired: bool = False
+
+
+def wire_compilation_cache() -> bool:
+    """Point JAX's persistent compilation cache at ``compile.cache_dir``.
+
+    Idempotent; returns whether a cache dir is active. Called from context
+    init (training) and ``InferenceModel`` construction (serving — which
+    may never init a mesh context): a process restart then deserializes
+    yesterday's XLA programs from disk instead of recompiling, which turns
+    a multi-second serving cold-start into a file read. The min-size/
+    min-compile-time thresholds drop to zero so small serving programs are
+    cached too (JAX's defaults only persist big, slow compiles)."""
+    global _cache_wired
+    cache_dir = global_config().get("compile.cache_dir")
+    if not cache_dir:
+        return False
+    if _cache_wired:
+        return True
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    for flag, val in (("jax_persistent_cache_min_entry_size_bytes", 0),
+                      ("jax_persistent_cache_min_compile_time_secs", 0.0)):
+        try:
+            jax.config.update(flag, val)
+        except AttributeError:  # older jax: threshold flags absent
+            pass
+    _cache_wired = True
+    logger.info("persistent compilation cache: %s", cache_dir)
+    return True
 
 
 def _build_mesh(devices: Sequence[jax.Device],
@@ -115,6 +144,7 @@ def init_tpu_context(mesh_shape: Optional[Tuple[int, ...]] = None,
         if conf:
             for k, v in conf.items():
                 cfg.set(k, v)
+        wire_compilation_cache()
         devices = jax.devices()
         mesh = _build_mesh(devices, mesh_shape, axis_names)
         ctx = ZooTpuContext(

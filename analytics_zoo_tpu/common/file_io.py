@@ -143,7 +143,10 @@ def create_exclusive(path: str, data: bytes = b"") -> None:
         # "mode unsupported" signals only: a transient network/auth OSError
         # must NOT silently degrade the claim to the non-atomic path — it
         # propagates to the caller instead
-        scheme = path.split("://")[0]
+        # scheme-only dedup key: a schemeless local path has no "://" and
+        # split()[0] would return the WHOLE path, growing the warn-once set
+        # by one entry per polled marker
+        scheme = path.split("://")[0] if "://" in path else "local"
         if scheme not in _warned_non_exclusive:  # once per scheme, not
             _warned_non_exclusive.add(scheme)    # per claim-poll
             import logging

@@ -44,7 +44,8 @@ from ..common.context import get_context
 from ..common.triggers import EveryEpoch, MaxEpoch, TrainingState, Trigger
 from ..common.utils import time_it
 from ..feature.featureset import FeatureSet
-from ..feature.device_feed import DeviceFeed
+from ..feature.device_feed import (DeviceFeed, masked_eval_batches,
+                                   shard_payload)
 from ..keras import metrics as metrics_mod
 from ..keras.optimizers import Optimizer
 from ..parallel.mesh import param_sharding, replicated, shard_batch
@@ -97,6 +98,31 @@ def _flat_losses(vals):
     for leaf in vals:
         out.extend(float(v) for v in np.atleast_1d(np.asarray(leaf)))
     return out
+
+
+def _drain_sum_pairs(pending):
+    """Drain a pass worth of per-batch ``(sum, weight)`` device scalar
+    pairs: ONE ``device_get`` for the whole list, then the same f64 host
+    accumulation the synchronous loop performed per batch — bit-identical
+    totals, one sync instead of 2·n."""
+    host = jax.device_get(pending)
+    total, weight = 0.0, 0.0
+    for s, w in host:
+        total += float(s)
+        weight += float(w)
+    return total, weight
+
+
+def _drain_weighted_losses(pending):
+    """Drain per-batch ``(loss_device_scalar, weight_int)`` pairs: ONE
+    ``device_get`` over the loss scalars, then f64 ``loss * weight`` host
+    accumulation (the record-weighted contract sync_eval defines)."""
+    host = jax.device_get([loss for loss, _ in pending])
+    total, weight = 0.0, 0
+    for loss, (_, w) in zip(host, pending):
+        total += float(loss) * w
+        weight += w
+    return total, weight
 
 
 def _group_host_batches(it, first_epoch_remaining, per_epoch, k):
@@ -636,6 +662,12 @@ class Estimator:
     # -- evaluate (Estimator.evaluate / InternalDistriOptimizer eval) ---------
 
     def evaluate(self, val_set: FeatureSet, batch_size: int) -> Dict[str, float]:
+        """Pipelined evaluation: host gather/shard for batch N+1 runs on the
+        DeviceFeed producer thread while the device computes batch N, and
+        metric accumulation stays ON DEVICE (the eval step folds each batch
+        into the metric-state carry) — the whole pass syncs to host exactly
+        once, in :func:`metrics.compute_all`. ``eval.async = False`` falls
+        back to the synchronous per-batch loop (``sync_eval``)."""
         if self.direct_loss_fn is not None and not self.metrics:
             return self._evaluate_direct(val_set, batch_size)
         if not self.metrics:
@@ -643,26 +675,33 @@ class Estimator:
         local_batch = min(self.ctx.local_batch(batch_size), val_set.size)
         ndev = self.mesh.devices.size
         local_batch = max(ndev, (local_batch // ndev) * ndev)
+        if not global_config().get("eval.async"):
+            from . import sync_eval
+            return sync_eval.evaluate_sync(self, val_set, batch_size,
+                                           local_batch)
         # ONE iterator pass: streaming sets restart their generator per
         # eval_iterator call, so peeking with a second iterator would decode
-        # the first batch twice on every evaluation
+        # the first batch twice on every evaluation — the first batch is
+        # consumed here for initialization and chained back into the feed
+        import itertools
         it = val_set.eval_iterator(local_batch, pad_remainder=True)
-        metric_states = None
-        for x, y, valid in it:
-            if metric_states is None:
-                self._ensure_initialized(x)
-                if self._eval_step is None:
-                    self._eval_step = self._build_eval_step()
-                metric_states = [
-                    jax.device_put(m.init_state(), replicated(self.mesh))
-                    for m in self.metrics]
-            mask = (np.arange(local_batch) < valid).astype(np.float32)
-            batch = shard_batch(self.mesh, (x, y, mask))
-            metric_states = self._eval_step(self.params, self.model_state,
-                                            metric_states, *batch)
-        if metric_states is None:
-            raise ValueError("validation set produced no batches")
-        return {m.name: m.compute(s) for m, s in zip(self.metrics, metric_states)}
+        try:
+            first = next(it)
+        except StopIteration:
+            raise ValueError("validation set produced no batches") from None
+        self._ensure_initialized(first[0])
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        metric_states = [
+            jax.device_put(m.init_state(), replicated(self.mesh))
+            for m in self.metrics]
+        host_it = masked_eval_batches(itertools.chain([first], it),
+                                      local_batch)
+        with DeviceFeed(host_it, self.mesh, shard_fn=shard_payload) as feed:
+            for (bx, by, bm), _ in feed:
+                metric_states = self._eval_step(self.params, self.model_state,
+                                                metric_states, bx, by, bm)
+        return metrics_mod.compute_all(self.metrics, metric_states)
 
     def _evaluate_direct_exact(self, val_set: FeatureSet, batch_size: int
                                ) -> Dict[str, float]:
@@ -704,22 +743,32 @@ class Estimator:
                         jnp.sum(mask))
 
             self._direct_pe_step = jax.jit(step)
+        if not global_config().get("eval.async"):
+            from . import sync_eval
+            return sync_eval.evaluate_direct_exact_sync(
+                self, val_set, local_batch, n_steps)
         eval_rng = jax.random.PRNGKey(0)
-        it = val_set.eval_iterator(local_batch, pad_remainder=True)
-        last = None
-        total, weight = 0.0, 0.0
-        for _ in range(n_steps):
-            try:
-                x, y, valid = next(it)
-                last = (x, y)
-            except StopIteration:  # short host re-feeds with mask all-zero
-                (x, y), valid = last, 0
-            mask = (np.arange(local_batch) < valid).astype(np.float32)
-            bx, by, bm = shard_batch(self.mesh, (x, y, mask))
-            s, w = self._direct_pe_step(self.params, self.model_state,
-                                        eval_rng, bx, by, bm)
-            total += float(s)
-            weight += float(w)
+
+        def host_batches():
+            it = val_set.eval_iterator(local_batch, pad_remainder=True)
+            last = None
+            for _ in range(n_steps):
+                try:
+                    x, y, valid = next(it)
+                    last = (x, y)
+                except StopIteration:  # short host re-feeds mask all-zero
+                    (x, y), valid = last, 0
+                mask = (np.arange(local_batch) < valid).astype(np.float32)
+                yield x, y, mask
+
+        # per-batch (loss-sum, valid-count) scalars stay on device; the
+        # dispatch loop never blocks — ONE device_get drains the pass
+        pending: List[Any] = []
+        with DeviceFeed(host_batches(), self.mesh) as feed:
+            for bx, by, bm in feed:
+                pending.append(self._direct_pe_step(
+                    self.params, self.model_state, eval_rng, bx, by, bm))
+        total, weight = _drain_sum_pairs(pending)
         if weight == 0:
             raise ValueError(
                 f"validation set is empty ({val_set.size} records)")
@@ -786,21 +835,30 @@ class Estimator:
                 direct = self.direct_eval_loss_fn
                 self._direct_eval_step = jax.jit(
                     lambda p, s, rng, x, y: direct(p, s, rng, x, y)[0])
+            if not global_config().get("eval.async"):
+                from . import sync_eval
+                return sync_eval.evaluate_direct_multiproc_sync(
+                    self, val_set, local_batch, n_global, v_globals)
             eval_rng = jax.random.PRNGKey(0)
-            it = val_set.eval_iterator(local_batch, pad_remainder=True)
-            last = None
-            total, weight = 0.0, 0
-            for t in range(n_global):
-                try:
-                    x, y, _ = next(it)
-                    last = (x, y)
-                except StopIteration:
-                    x, y = last
-                xs, ys = shard_batch(self.mesh, (x, y))
-                loss = float(self._direct_eval_step(
-                    self.params, self.model_state, eval_rng, xs, ys))
-                total += loss * int(v_globals[t])
-                weight += int(v_globals[t])
+
+            def host_batches():
+                it = val_set.eval_iterator(local_batch, pad_remainder=True)
+                last = None
+                for t in range(n_global):
+                    try:
+                        x, y, _ = next(it)
+                        last = (x, y)
+                    except StopIteration:
+                        x, y = last
+                    yield (x, y), int(v_globals[t])
+
+            pending: List[Any] = []
+            with DeviceFeed(host_batches(), self.mesh,
+                            shard_fn=shard_payload) as feed:
+                for (xs, ys), w in feed:
+                    pending.append((self._direct_eval_step(
+                        self.params, self.model_state, eval_rng, xs, ys), w))
+            total, weight = _drain_weighted_losses(pending)
             return {"loss": total / weight}
         sample = next(val_set.eval_iterator(local_batch, pad_remainder=True))
         self._ensure_initialized(sample[0])
@@ -808,18 +866,33 @@ class Estimator:
             direct = self.direct_eval_loss_fn
             self._direct_eval_step = jax.jit(
                 lambda p, s, rng, x, y: direct(p, s, rng, x, y)[0])
+        if not global_config().get("eval.async"):
+            from . import sync_eval
+            return sync_eval.evaluate_direct_single_sync(
+                self, val_set, local_batch)
         eval_rng = jax.random.PRNGKey(0)
-        total, weight = 0.0, 0
-        for x, y, valid in val_set.eval_iterator(local_batch,
-                                                 pad_remainder=False):
+
+        def shard_full(mesh, item):
+            # single-process: full batches shard over the data axis; the
+            # tail evaluates exactly via a replicated-batch compile at its
+            # true size (host arrays pass straight into the jitted step)
+            (x, y), valid = item
             if valid == local_batch:
-                x, y = shard_batch(self.mesh, (x, y))
-            # single-process: the tail evaluates exactly via a
-            # replicated-batch compile at its true size
-            loss = float(self._direct_eval_step(
-                self.params, self.model_state, eval_rng, x, y))
-            total += loss * valid
-            weight += valid
+                return shard_batch(mesh, (x, y)), valid
+            return (x, y), valid
+
+        def host_batches():
+            for x, y, valid in val_set.eval_iterator(local_batch,
+                                                     pad_remainder=False):
+                yield (x, y), valid
+
+        pending: List[Any] = []
+        with DeviceFeed(host_batches(), self.mesh,
+                        shard_fn=shard_full) as feed:
+            for (x, y), valid in feed:
+                pending.append((self._direct_eval_step(
+                    self.params, self.model_state, eval_rng, x, y), valid))
+        total, weight = _drain_weighted_losses(pending)
         if weight == 0:
             raise ValueError(
                 f"validation set is empty ({val_set.size} records)")
@@ -828,6 +901,13 @@ class Estimator:
     # -- predict (TFNet/Predictable equivalent) -------------------------------
 
     def predict(self, x, batch_size: int = 32):
+        """Pipelined prediction: batches stream through the DeviceFeed and a
+        bounded window of ``eval.predict_window`` dispatches stays in
+        flight — results are fetched (trimmed to their valid rows) BEHIND
+        the dispatch frontier, so the host→device upload of batch N+K, the
+        device compute of N+1..N+K-1, and the device→host download of batch
+        N all overlap. ``eval.async = False`` falls back to the synchronous
+        fetch-per-batch loop."""
         if not isinstance(x, FeatureSet):
             x = FeatureSet.from_ndarrays(x, None, shuffle=False, shard=False)
         local_batch = min(self.ctx.local_batch(batch_size), x.size)
@@ -837,12 +917,36 @@ class Estimator:
         self._ensure_initialized(sample[0])
         if self._predict_step is None:
             self._predict_step = self._build_predict_step()
+        cfg = global_config()
+        if not cfg.get("eval.async"):
+            from . import sync_eval
+            return sync_eval.predict_sync(self, x, local_batch)
+        window = max(1, int(cfg.get("eval.predict_window")))
+
+        def host_batches():
+            for bx, _, valid in x.eval_iterator(local_batch,
+                                                pad_remainder=True):
+                yield bx, valid
+
+        def fetch(y, valid):
+            # device→host download of a batch K dispatches behind the
+            # frontier — the one place predict touches host memory
+            return jax.tree_util.tree_map(
+                lambda t: np.asarray(t)[:valid], y)
+
+        from collections import deque
         outs = []
-        for bx, _, valid in x.eval_iterator(local_batch, pad_remainder=True):
-            bx = shard_batch(self.mesh, bx)
-            y = self._predict_step(self.params, self.model_state, bx)
-            outs.append(jax.tree_util.tree_map(
-                lambda t: np.asarray(t)[:valid], y))
+        inflight: "deque" = deque()
+        with DeviceFeed(host_batches(), self.mesh,
+                        shard_fn=shard_payload) as feed:
+            for bx, valid in feed:
+                inflight.append(
+                    (self._predict_step(self.params, self.model_state, bx),
+                     valid))
+                if len(inflight) > window:
+                    outs.append(fetch(*inflight.popleft()))
+        while inflight:
+            outs.append(fetch(*inflight.popleft()))
         if isinstance(outs[0], (list, tuple)):
             return type(outs[0])(
                 np.concatenate([o[i] for o in outs]) for i in range(len(outs[0])))

@@ -1,0 +1,144 @@
+"""Synchronous (pre-pipelining) evaluate/predict loops.
+
+These are the per-batch host-round-trip forms the async paths in
+``estimator.py`` replaced: host-side ``shard_batch`` with zero prefetch and
+a blocking ``float(...)`` / ``np.asarray(...)`` device sync per batch. They
+are kept — behind ``eval.async = False`` — for two jobs:
+
+1. **parity reference**: the async paths must reproduce these results
+   bit-for-bit (``tests/test_eval_async.py``); the numerics contract
+   (f32 per-batch losses, f64 host accumulation, record weighting) is
+   defined HERE.
+2. **A/B benchmarking**: ``bench.py eval`` measures async vs. this
+   fallback on the same FeatureSet, so the pipelining win is a number,
+   not a claim.
+
+Deliberately not exported; every entry takes the estimator as first
+argument and mirrors the exact code the async methods grew out of. New
+behavior goes in ``estimator.py`` — this module only changes if the
+numerics contract itself changes.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import numpy as np
+
+from ..feature.featureset import FeatureSet
+from ..keras import metrics as metrics_mod
+from ..parallel.mesh import replicated, shard_batch
+
+
+def evaluate_sync(est, val_set: FeatureSet, batch_size: int,
+                  local_batch: int) -> Dict[str, float]:
+    """Metric-path eval: synchronous shard per batch, metric states carried
+    on device (this path never had a per-batch sync), host finalize."""
+    # ONE iterator pass: streaming sets restart their generator per
+    # eval_iterator call, so peeking with a second iterator would decode
+    # the first batch twice on every evaluation
+    it = val_set.eval_iterator(local_batch, pad_remainder=True)
+    metric_states = None
+    for x, y, valid in it:
+        if metric_states is None:
+            est._ensure_initialized(x)
+            if est._eval_step is None:
+                est._eval_step = est._build_eval_step()
+            metric_states = [
+                jax.device_put(m.init_state(), replicated(est.mesh))
+                for m in est.metrics]
+        mask = (np.arange(local_batch) < valid).astype(np.float32)
+        batch = shard_batch(est.mesh, (x, y, mask))
+        metric_states = est._eval_step(est.params, est.model_state,
+                                       metric_states, *batch)
+    if metric_states is None:
+        raise ValueError("validation set produced no batches")
+    return metrics_mod.compute_all(est.metrics, metric_states)
+
+
+def evaluate_direct_exact_sync(est, val_set: FeatureSet, local_batch: int,
+                               n_steps: int) -> Dict[str, float]:
+    """Per-example masked direct eval with a blocking float() pair per
+    batch. ``n_steps``/``local_batch`` come from the caller (the collective
+    batch-count agreement is shared with the async path)."""
+    eval_rng = jax.random.PRNGKey(0)
+    it = val_set.eval_iterator(local_batch, pad_remainder=True)
+    last = None
+    total, weight = 0.0, 0.0
+    for _ in range(n_steps):
+        try:
+            x, y, valid = next(it)
+            last = (x, y)
+        except StopIteration:  # short host re-feeds with mask all-zero
+            (x, y), valid = last, 0
+        mask = (np.arange(local_batch) < valid).astype(np.float32)
+        bx, by, bm = shard_batch(est.mesh, (x, y, mask))
+        s, w = est._direct_pe_step(est.params, est.model_state,
+                                   eval_rng, bx, by, bm)
+        total += float(s)
+        weight += float(w)
+    if weight == 0:
+        raise ValueError(
+            f"validation set is empty ({val_set.size} records)")
+    return {"loss": total / weight}
+
+
+def evaluate_direct_multiproc_sync(est, val_set: FeatureSet,
+                                   local_batch: int, n_global: int,
+                                   v_globals) -> Dict[str, float]:
+    """Multi-process batch-mean direct eval: every host runs ``n_global``
+    identically-shaped padded steps, blocking float() per batch, tail
+    batches weighted by their GLOBAL valid count."""
+    eval_rng = jax.random.PRNGKey(0)
+    it = val_set.eval_iterator(local_batch, pad_remainder=True)
+    last = None
+    total, weight = 0.0, 0
+    for t in range(n_global):
+        try:
+            x, y, _ = next(it)
+            last = (x, y)
+        except StopIteration:
+            x, y = last
+        xs, ys = shard_batch(est.mesh, (x, y))
+        loss = float(est._direct_eval_step(
+            est.params, est.model_state, eval_rng, xs, ys))
+        total += loss * int(v_globals[t])
+        weight += int(v_globals[t])
+    return {"loss": total / weight}
+
+
+def evaluate_direct_single_sync(est, val_set: FeatureSet,
+                                local_batch: int) -> Dict[str, float]:
+    """Single-process batch-mean direct eval: full batches sharded, the
+    tail runs UNPADDED through the same jitted step (one extra compile at
+    the tail shape), blocking float() per batch."""
+    eval_rng = jax.random.PRNGKey(0)
+    total, weight = 0.0, 0
+    for x, y, valid in val_set.eval_iterator(local_batch,
+                                             pad_remainder=False):
+        if valid == local_batch:
+            x, y = shard_batch(est.mesh, (x, y))
+        # single-process: the tail evaluates exactly via a
+        # replicated-batch compile at its true size
+        loss = float(est._direct_eval_step(
+            est.params, est.model_state, eval_rng, x, y))
+        total += loss * valid
+        weight += valid
+    if weight == 0:
+        raise ValueError(
+            f"validation set is empty ({val_set.size} records)")
+    return {"loss": total / weight}
+
+
+def predict_sync(est, x: FeatureSet, local_batch: int):
+    """Synchronous predict: blocking np.asarray fetch per batch."""
+    outs = []
+    for bx, _, valid in x.eval_iterator(local_batch, pad_remainder=True):
+        bx = shard_batch(est.mesh, bx)
+        y = est._predict_step(est.params, est.model_state, bx)
+        outs.append(jax.tree_util.tree_map(
+            lambda t: np.asarray(t)[:valid], y))
+    if isinstance(outs[0], (list, tuple)):
+        return type(outs[0])(
+            np.concatenate([o[i] for o in outs]) for i in range(len(outs[0])))
+    return np.concatenate(outs)

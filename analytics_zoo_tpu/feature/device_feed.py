@@ -7,6 +7,15 @@ previous step's compute. ``DeviceFeed`` runs a background producer thread
 that keeps ``prefetch`` batches in flight, each already sharded over the
 mesh's data axis, so the TPU never waits on the host (SURVEY.md §7 hard
 part (c)).
+
+The feed is shape-agnostic: the host iterator may be endless (train) or
+finite (eval/predict — the sentinel becomes ``StopIteration``), and
+``shard_fn`` decides what of each item lands on device. Two helpers below
+cover the evaluation contract: :func:`masked_eval_batches` turns
+``FeatureSet.eval_iterator``'s ``(x, y, valid)`` stream into
+``((x, y, mask), meta...)`` items with a host-computed float mask, and
+:func:`shard_payload` shards only the leading payload of such an item while
+per-batch metadata (valid counts) rides along host-side.
 """
 from __future__ import annotations
 
@@ -14,12 +23,38 @@ import queue
 import threading
 from typing import Any, Iterator, List, Optional
 
+import numpy as np
 from jax.sharding import Mesh
 
 from ..common.config import global_config
 from ..parallel.mesh import shard_batch
 
 _SENTINEL = object()
+
+
+def masked_eval_batches(it: Iterator[Any], batch_size: int,
+                        with_labels: bool = True) -> Iterator[Any]:
+    """Adapt an ``eval_iterator`` stream (``(x, y, valid)``) to feed items.
+
+    Yields ``((x, y, mask), valid)`` (or ``((x, mask), valid)`` without
+    labels): the payload a jitted masked eval step consumes plus the valid
+    count as host-side metadata. The mask marks the real rows of padded
+    tail batches, so pad rows contribute nothing on device.
+    """
+    for x, y, valid in it:
+        mask = (np.arange(batch_size) < valid).astype(np.float32)
+        if with_labels:
+            yield (x, y, mask), valid
+        else:
+            yield (x, mask), valid
+
+
+def shard_payload(mesh: Mesh, item: Any) -> Any:
+    """Shard function for ``(payload, meta...)`` feed items: the payload
+    pytree is sharded over the mesh's data axis, everything after it stays
+    host-side untouched (per-batch valid counts, record ids, ...)."""
+    payload, *meta = item
+    return (shard_batch(mesh, payload), *meta)
 
 
 def _put_until_stopped(q: "queue.Queue", stop: threading.Event,
@@ -78,6 +113,15 @@ class DeviceFeed:
 
     def __iter__(self):
         return self
+
+    def __enter__(self) -> "DeviceFeed":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        # eval/predict passes routinely abandon a feed mid-stream (early
+        # break, consumer exception): the context form guarantees the
+        # producer thread stops and prefetched device buffers release
+        self.close()
 
     def __next__(self):
         if self._stop.is_set():  # already exhausted or closed

@@ -19,11 +19,13 @@ re-design:
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Dict, List, Optional, Sequence
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
+from ..common.context import wire_compilation_cache
 from .quantize import dequantize_params, quantize_params
 
 _BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -103,6 +105,14 @@ class InferenceModel:
         self._params: Any = None
         self._jit: Optional[Callable] = None  # jit caches per shape itself
         self._host_predict: Optional[Callable] = None  # non-XLA backends
+        # compile-warmth layer: AOT-compiled executables keyed by exact
+        # input signature, with per-bucket compile counters so "did the
+        # first request compile?" is an assertion, not a latency guess
+        self._compiled: Dict[Tuple, Any] = {}
+        self._compile_lock = threading.Lock()
+        self.compile_counts: Dict[int, int] = {}
+        self.compile_seconds: Dict[int, float] = {}
+        wire_compilation_cache()  # compile.cache_dir, if configured
 
     def _set_forward(self, forward: Callable) -> None:
         """Install the forward fn and its jit wrapper eagerly — one wrapper
@@ -110,11 +120,97 @@ class InferenceModel:
         instead of racing to build separate wrappers."""
         self._forward = forward
         self._jit = jax.jit(forward)
+        self._reset_compile_cache()
         # loader-specific side channels die with the forward they belong
         # to — a reused InferenceModel must not export a PREVIOUS model
         self._savedmodel_ir = None
         self._keras_model = None
         self._keras_state = None
+
+    def _reset_compile_cache(self) -> None:
+        """A new forward (or new params tree) invalidates every compiled
+        executable AND the warmth accounting."""
+        with self._compile_lock:
+            self._compiled = {}
+            self.compile_counts = {}
+            self.compile_seconds = {}
+
+    def _ensure_compiled(self, xs: List[np.ndarray], is_multi: bool,
+                         bucket: int):
+        """Fetch (or AOT-compile) the executable for this exact padded
+        input signature. ``jit.lower().compile()`` bypasses jit's implicit
+        per-call cache, so the memo here is the ONLY cache — which is what
+        makes the per-bucket counters truthful."""
+        key = (is_multi, tuple((a.shape, a.dtype.str) for a in xs))
+        exe = self._compiled.get(key)
+        if exe is not None:
+            return exe
+        with self._compile_lock:
+            exe = self._compiled.get(key)
+            if exe is None:
+                t0 = time.perf_counter()
+                exe = self._jit.lower(
+                    self._params, list(xs) if is_multi else xs[0]).compile()
+                self._compiled[key] = exe
+                self.compile_counts[bucket] = \
+                    self.compile_counts.get(bucket, 0) + 1
+                self.compile_seconds[bucket] = \
+                    self.compile_seconds.get(bucket, 0.0) \
+                    + (time.perf_counter() - t0)
+        return exe
+
+    def prewarm(self, example,
+                buckets: Optional[Sequence[int]] = None) -> "InferenceModel":
+        """Compile the expected shape buckets BEFORE traffic arrives.
+
+        ``example``: one input batch (any batch size) fixing dtypes and
+        feature shapes — the same convention as :meth:`export_compiled`.
+        ``buckets``: request batch sizes to warm (each resolves through the
+        same bucket selection ``predict`` uses); defaults to the bucket the
+        example's own batch size pads to. A production server calls this at
+        load time so no client eats the multi-second first-hit XLA compile
+        mid-traffic-ramp; with ``compile.cache_dir`` set the warmup itself
+        is usually a disk read. Host-side backends (TorchScript) have
+        nothing to warm. Compiles are recorded in ``compile_counts`` /
+        ``compile_seconds`` per bucket."""
+        if self._host_predict is not None:
+            return self
+        aot = getattr(self, "_aot", None)
+        if self._forward is None and aot is None:
+            raise RuntimeError("load a model first")
+        is_multi = isinstance(example, (list, tuple))
+        xs = [np.asarray(a) for a in (example if is_multi else [example])]
+        n = xs[0].shape[0]
+        sizes = [n] if buckets is None else [int(b) for b in buckets]
+        resolved = set()
+        for size in sizes:
+            if aot is not None:
+                b = next((bb for bb in sorted(aot) if max(size, 1) <= bb),
+                         None)
+                if b is None:  # larger than every exported bucket: predict
+                    continue   # would chunk to the biggest, already covered
+            else:
+                b = _bucket(size)
+            resolved.add(b)
+        for b in sorted(resolved):
+            shaped = [np.repeat(a[:1], b, axis=0) if n
+                      else np.zeros((b,) + a.shape[1:], a.dtype) for a in xs]
+            if aot is not None:
+                art = aot[b]
+                if isinstance(art, _TextArtifact):
+                    t0 = time.perf_counter()
+                    with art._lock:
+                        if art._exe is None:
+                            art._exe = art._compile()
+                            self.compile_counts[b] = \
+                                self.compile_counts.get(b, 0) + 1
+                            self.compile_seconds[b] = \
+                                self.compile_seconds.get(b, 0.0) \
+                                + (time.perf_counter() - t0)
+                # serialized jax.export artifacts load pre-compiled
+            else:
+                self._ensure_compiled(shaped, is_multi, b)
+        return self
 
     @staticmethod
     def _device(tree):
@@ -287,8 +383,10 @@ class InferenceModel:
                                       act_scales=act_scales)
             self._act_scales = act_scales
             # layers consume their quantized kernels natively — the base
-            # forward runs unchanged on the mixed tree
+            # forward runs unchanged on the mixed tree; the param AVALs
+            # changed, so every compiled executable is stale
             self._params = self._device(qparams)
+            self._reset_compile_cache()
             return self
         qparams = quantize_params(self._params, dtype)
 
@@ -470,12 +568,17 @@ class InferenceModel:
                        np.zeros((1,) + a.shape[1:], a.dtype))
             xs = [np.concatenate(
                 [a, np.repeat(pad_row(a), bucket - n, axis=0)]) for a in xs]
+        if aot is None:
+            # resolve (or compile) the executable BEFORE taking a pool
+            # slot: a cold bucket must not hold a dispatch slot hostage
+            # for the length of an XLA compile
+            exe = self._ensure_compiled(xs, is_multi, bucket)
         args = jax.device_put(xs)  # explicit transfer (see _device)
         with self._slots:
             if aot is not None:
                 y = aot[bucket].call(*args)
             else:
-                y = self._jit(self._params, args if is_multi else args[0])
+                y = exe(self._params, args if is_multi else args[0])
         def fetch():
             trim = lambda t: np.asarray(t)[:n]
             if isinstance(y, dict):

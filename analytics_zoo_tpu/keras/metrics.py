@@ -6,13 +6,25 @@ zeros pytree, ``update(state, y_true, y_pred, mask)`` folds one (possibly
 padded) batch in on-device, ``compute(state)`` finalizes on host. This lets the
 Estimator run evaluation as one jitted scan over sharded batches with no
 host sync per batch; ``mask`` marks the valid rows of padded tail batches.
+``compute`` implementations use NUMPY ops on purpose: after
+:func:`compute_all`'s single ``device_get`` the finalize is pure host
+arithmetic — no follow-up device dispatches, no second sync.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, Union
+from typing import Callable, Dict, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def compute_all(metrics: Sequence["Metric"], states) -> Dict[str, float]:
+    """Finalize a whole eval pass with ONE host sync: every metric's
+    device-resident state is fetched in a single ``jax.device_get``, then
+    each ``compute`` runs on the host numpy arrays."""
+    host_states = jax.device_get(list(states))
+    return {m.name: m.compute(s) for m, s in zip(metrics, host_states)}
 
 
 def _masked_mean_update(state, per_example, mask):
@@ -31,7 +43,8 @@ class Metric:
         raise NotImplementedError
 
     def compute(self, state):
-        return float(state["sum"] / jnp.maximum(state["count"], 1))
+        return float(np.asarray(state["sum"])
+                     / np.maximum(np.asarray(state["count"]), 1))
 
 
 class Accuracy(Metric):
@@ -128,10 +141,11 @@ class AUC(Metric):
         }
 
     def compute(self, state):
-        tpr = state["tp"] / jnp.maximum(state["tp"] + state["fn"], 1e-7)
-        fpr = state["fp"] / jnp.maximum(state["fp"] + state["tn"], 1e-7)
+        state = {k: np.asarray(v) for k, v in state.items()}
+        tpr = state["tp"] / np.maximum(state["tp"] + state["fn"], 1e-7)
+        fpr = state["fp"] / np.maximum(state["fp"] + state["tn"], 1e-7)
         # trapezoidal area over decreasing fpr
-        return float(jnp.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) / 2.0))
+        return float(np.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) / 2.0))
 
 
 class _PRF(Metric):
@@ -177,23 +191,26 @@ class Precision(_PRF):
     name = "precision"
 
     def compute(self, state):
-        return float(state["tp"] / jnp.maximum(state["tp"] + state["fp"], 1))
+        tp, fp = np.asarray(state["tp"]), np.asarray(state["fp"])
+        return float(tp / np.maximum(tp + fp, 1))
 
 
 class Recall(_PRF):
     name = "recall"
 
     def compute(self, state):
-        return float(state["tp"] / jnp.maximum(state["tp"] + state["fn"], 1))
+        tp, fn = np.asarray(state["tp"]), np.asarray(state["fn"])
+        return float(tp / np.maximum(tp + fn, 1))
 
 
 class F1(_PRF):
     name = "f1"
 
     def compute(self, state):
-        p = state["tp"] / jnp.maximum(state["tp"] + state["fp"], 1)
-        r = state["tp"] / jnp.maximum(state["tp"] + state["fn"], 1)
-        return float(2 * p * r / jnp.maximum(p + r, 1e-12))
+        tp = np.asarray(state["tp"])
+        p = tp / np.maximum(tp + np.asarray(state["fp"]), 1)
+        r = tp / np.maximum(tp + np.asarray(state["fn"]), 1)
+        return float(2 * p * r / np.maximum(p + r, 1e-12))
 
 
 _REGISTRY: Dict[str, Callable[[], Metric]] = {

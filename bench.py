@@ -10,7 +10,9 @@ Every workload reports MFU (achieved matmul FLOP/s divided by chip peak) from
 XLA's compiled cost analysis. The reference publishes no absolute numbers
 (`published: {}`), so ``vs_baseline`` is null.
 
-Usage: ``python bench.py [all|resnet50|ncf|widedeep|bert]`` (default all).
+Usage: ``python bench.py [all|resnet50|ncf|widedeep|bert|...]`` (default
+all; the full workload list is ``_WORKLOADS`` below, incl. the ``eval``
+async-vs-sync eval/predict pipeline A/B).
 """
 import json
 import os
@@ -1054,6 +1056,98 @@ def bench_longseq(batch_size: int = 4, heads: int = 8, seq: int = 4096,
                 * head_dim})
 
 
+def bench_eval(n_records: int = 32768, batch_size: int = 1024,
+               d: int = 256, reps: int = 3):
+    """Eval/predict pipeline throughput (records/s) over a fixed
+    FeatureSet: the async path (DeviceFeed prefetch + on-device
+    accumulation, ONE host sync per pass) vs the ``eval.async=False``
+    synchronous fallback (per-batch shard + blocking float()/np.asarray()
+    round-trips — the pre-change loops, kept in estimator/sync_eval.py).
+    The async/sync RATIO is the headline of the pipelining redesign; on a
+    tunneled chip the sync path pays a full RPC round-trip per batch, so
+    the gap there is the remote-attached worst case. Results are
+    parity-checked in-process before any number is published."""
+    from analytics_zoo_tpu.common.config import global_config
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.estimator import Estimator
+    from analytics_zoo_tpu.feature import FeatureSet
+    from analytics_zoo_tpu.keras import Sequential, objectives, optimizers
+    from analytics_zoo_tpu.keras.layers import Dense
+
+    ctx = init_tpu_context()
+    batch_size = max(ctx.num_devices,
+                     (batch_size // ctx.num_devices) * ctx.num_devices)
+    model = Sequential([Dense(512, activation="relu"),
+                        Dense(256, activation="relu"), Dense(2)])
+    est = Estimator(model=model,
+                    loss_fn=objectives.get("sparse_categorical_crossentropy"),
+                    optimizer=optimizers.SGD(0.1), metrics=["accuracy"])
+    rs = np.random.RandomState(0)
+    n = n_records + 7  # ragged tail: the padded-tail path is in the loop
+    x = rs.rand(n, d).astype(np.float32)
+    y = (x.sum(1) > d / 2).astype(np.float32)
+    fs = FeatureSet.from_ndarrays(x, y, shuffle=False)
+    cfg = global_config()
+
+    def with_flag(async_flag, fn):
+        had = "eval.async" in cfg._overrides
+        saved = cfg.get("eval.async")
+        cfg.set("eval.async", async_flag)
+        try:
+            return fn()
+        finally:
+            if had:
+                cfg.set("eval.async", saved)
+            else:
+                cfg.unset("eval.async")
+
+    def timed_eval():
+        est.evaluate(fs, batch_size)  # warm: compiles + first-pass costs
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            scores = est.evaluate(fs, batch_size)
+        return n * reps / (time.perf_counter() - t0), scores
+
+    def timed_predict():
+        est.predict(fs, batch_size)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            preds = est.predict(fs, batch_size)
+        return n * reps / (time.perf_counter() - t0), preds
+
+    sync_eval_rate, sync_scores = with_flag(False, timed_eval)
+    async_eval_rate, async_scores = with_flag(True, timed_eval)
+    sync_pred_rate, sync_preds = with_flag(False, timed_predict)
+    async_pred_rate, async_preds = with_flag(True, timed_predict)
+    parity = (sync_scores == async_scores
+              and bool(np.array_equal(np.asarray(sync_preds),
+                                      np.asarray(async_preds))))
+    if not parity:
+        raise RuntimeError(
+            f"async/sync eval parity FAILED: {sync_scores} vs "
+            f"{async_scores}")
+    return _BenchResult(
+        metric="eval_records_per_sec",
+        value=round(async_eval_rate, 1),
+        unit="records/s", mfu=None,
+        detail={"records": n, "batch_size": batch_size,
+                "model": f"mlp {d}-512-256-2", "reps": reps,
+                "async_eval_records_per_sec": round(async_eval_rate, 1),
+                "sync_eval_records_per_sec": round(sync_eval_rate, 1),
+                "eval_speedup": round(async_eval_rate / sync_eval_rate, 2),
+                "async_predict_records_per_sec": round(async_pred_rate, 1),
+                "sync_predict_records_per_sec": round(sync_pred_rate, 1),
+                "predict_speedup": round(async_pred_rate / sync_pred_rate,
+                                         2),
+                "parity_ok": parity,
+                "includes": "host gather/shard + device forward + "
+                            "metric/result handling, wall clock",
+                "note": "sync = pre-change per-batch blocking loops "
+                        "(eval.async=False fallback); async = DeviceFeed "
+                        "prefetch, on-device accumulation, one host sync "
+                        "per pass"})
+
+
 def bench_quantized(batch_size: int = 32, steps: int = 30, warmup: int = 3):
     """ResNet-18 inference latency across precisions: fp32 vs bf16 vs
     calibrated int8 (activation observers + static grid — the reference's
@@ -1132,6 +1226,7 @@ _WORKLOADS = {
     "bert": bench_bert,
     "widedeep": bench_widedeep,
     "longseq": bench_longseq,
+    "eval": bench_eval,
     "serving": bench_serving,
     "quantized": bench_quantized,
     "pipeline": bench_input_pipeline,
@@ -1184,6 +1279,8 @@ _COMPACT_KEYS = {
     "longseq": ("numerics_ok",),
     "ncf": ("hbm_roofline_fraction",),
     "widedeep": ("hbm_roofline_fraction",),
+    "eval": ("sync_eval_records_per_sec", "eval_speedup",
+             "predict_speedup"),
     "quantized": ("fp32_images_per_sec",),
     "serving": ("bert_records_per_sec", "device_records_per_sec"),
     "pipeline": (),
@@ -1270,7 +1367,7 @@ def main():
     preflight_note = None
     per_cap = _PER_WORKLOAD_S
 
-    def _finish(partial):
+    def _finish(partial, code=0):
         if not results:
             results["none"] = _BenchResult(metric="no_workload_completed",
                                            value=None, unit="", mfu=None,
@@ -1278,14 +1375,19 @@ def main():
         _emit_final(results, platform, num_devices, partial=partial,
                     note=preflight_note)
         sys.stdout.flush()
-        os._exit(0)
+        os._exit(code)
 
     import signal
     for sig in (signal.SIGTERM, signal.SIGINT):
         # installed BEFORE the preflight: the driver's deadline kill must
         # produce a diagnostic final line even if it lands during the
-        # (up-to-240s) preflight probe
-        signal.signal(sig, lambda *_: _finish(partial=True))
+        # (up-to-240s) preflight probe. Exit NONZERO (128+signum, the
+        # shell convention) so anything keying on the return code records
+        # a killed sweep as killed — the JSON contract (partial: true)
+        # is unchanged
+        signal.signal(sig,
+                      lambda signum, _frame: _finish(partial=True,
+                                                     code=128 + signum))
 
     if isolate:
         # backend preflight in a THROWAWAY child: when the TPU tunnel is

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Tier-1 lint: no blocking host↔device sync inside the per-batch loop
+bodies of Estimator's evaluate*/predict hot paths.
+
+The async eval/predict redesign moved every per-batch ``float(...)`` /
+``np.asarray(...)`` sync out of ``estimator.py``'s dispatch loops: batches
+stream through the DeviceFeed, accumulation stays on device, and the pass
+drains with one ``jax.device_get`` AFTER the loop (module-level ``_drain*``
+helpers / ``metrics.compute_all``). A regression that reintroduces a
+per-batch sync re-serializes host decode with device compute — the exact
+stall this PR removed — and nothing functional breaks, so only a BENCH
+round would notice. This check fails the test run at collection time
+instead (``tests/test_hot_path_lint.py``).
+
+Scope: the loop bodies of ``Estimator.evaluate``, ``_evaluate_direct``,
+``_evaluate_direct_exact`` and ``predict`` in
+``analytics_zoo_tpu/estimator/estimator.py``. The synchronous fallbacks in
+``estimator/sync_eval.py`` are deliberately NOT policed — they exist to be
+the per-batch-sync parity reference.
+
+Banned inside those loop bodies: ``float(...)``, ``np.asarray(...)`` /
+``numpy.asarray(...)``, ``jax.device_get(...)``, ``.block_until_ready()``.
+Post-loop drains and helpers called FROM the loop (``fetch`` behind the
+predict window) are fine — the lint looks at the literal loop body, which
+is also the honest boundary: a helper fetching K dispatches behind the
+frontier is pipelining, an inline sync is a stall.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+HOT_FUNCS = ("evaluate", "_evaluate_direct", "_evaluate_direct_exact",
+             "predict")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ESTIMATOR_PY = os.path.join(_REPO, "analytics_zoo_tpu", "estimator",
+                            "estimator.py")
+
+
+def _banned_call(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name) and f.id == "float":
+        return "float()"
+    if isinstance(f, ast.Attribute):
+        base = f.value
+        if (f.attr == "asarray" and isinstance(base, ast.Name)
+                and base.id in ("np", "numpy")):
+            return f"{base.id}.asarray()"
+        if (f.attr == "device_get" and isinstance(base, ast.Name)
+                and base.id == "jax"):
+            return "jax.device_get()"
+        if f.attr == "block_until_ready":
+            return ".block_until_ready()"
+    return ""
+
+
+def check(path: str = ESTIMATOR_PY) -> List[Tuple[str, int, str]]:
+    """Return (function, line, what) violations; empty means clean."""
+    with open(path) as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    violations: List[Tuple[str, int, str]] = []
+    for cls in ast.walk(tree):
+        if not (isinstance(cls, ast.ClassDef) and cls.name == "Estimator"):
+            continue
+        for fn in cls.body:
+            if not (isinstance(fn, ast.FunctionDef)
+                    and fn.name in HOT_FUNCS):
+                continue
+            for loop in ast.walk(fn):
+                if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+                    continue
+                for stmt in loop.body + loop.orelse:
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Call):
+                            what = _banned_call(sub)
+                            if what:
+                                violations.append(
+                                    (fn.name, sub.lineno, what))
+    return violations
+
+
+def main() -> int:
+    violations = check()
+    if not violations:
+        print("hot-path sync lint: clean")
+        return 0
+    for fn, line, what in violations:
+        print(f"{ESTIMATOR_PY}:{line}: blocking {what} inside the per-batch "
+              f"loop body of Estimator.{fn} — route the sync behind the "
+              f"dispatch frontier or drain after the loop", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,216 @@
+"""Async eval/predict pipeline tests.
+
+Parity contract: with ``eval.async`` on (DeviceFeed prefetch, on-device
+accumulation, one host sync per pass) every evaluate/predict path must
+reproduce the synchronous per-batch loops (``estimator/sync_eval.py``)
+BIT-FOR-BIT — same f32 per-batch values, same f64 host accumulation order —
+on multi-batch and padded/ragged-tail cases, including the
+``direct_eval_per_example_fn`` exact path. Plus DeviceFeed lifecycle:
+finite-iterator drain, close() mid-stream, producer-exception surfacing.
+"""
+from contextlib import contextmanager
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.config import global_config
+from analytics_zoo_tpu.estimator import Estimator
+from analytics_zoo_tpu.feature import DeviceFeed, FeatureSet
+from analytics_zoo_tpu.feature.device_feed import (masked_eval_batches,
+                                                   shard_payload)
+from analytics_zoo_tpu.keras import Sequential, objectives, optimizers
+from analytics_zoo_tpu.keras.layers import Dense
+
+
+@contextmanager
+def flag(name, value):
+    cfg = global_config()
+    had = name in cfg._overrides
+    saved = cfg.get(name)
+    cfg.set(name, value)
+    try:
+        yield
+    finally:
+        if had:
+            cfg.set(name, saved)
+        else:
+            cfg.unset(name)
+
+
+def make_regression(n=100, d=4, seed=0):
+    rs = np.random.RandomState(seed)
+    w = rs.randn(d, 1).astype(np.float32)
+    x = rs.randn(n, d).astype(np.float32)
+    y = x @ w + 0.01 * rs.randn(n, 1).astype(np.float32)
+    return x, y
+
+
+def make_direct_estimator(with_per_example=False):
+    """Capture-style estimator: loss sees the raw batch, params installed
+    by hand (the pod_workers.py convention)."""
+    def direct_loss(params, state, rng, x, y):
+        pred = x @ params["w"]
+        return jnp.mean((pred[:, 0] - y) ** 2), state
+
+    def per_example(params, state, rng, x, y):
+        pred = x @ params["w"]
+        return (pred[:, 0] - y) ** 2
+
+    est = Estimator(
+        model=None, loss_fn=None, optimizer=optimizers.SGD(0.1),
+        direct_loss_fn=direct_loss,
+        direct_eval_per_example_fn=per_example if with_per_example else None)
+    est.params = jax.device_put({"w": jnp.asarray(np.ones((3, 1), np.float32))})
+    est.model_state = {}
+    est._state_resolved = True
+    return est
+
+
+class TestEvalParity:
+    def test_metrics_eval_bit_identical(self, ctx):
+        """Metric-path evaluate: multi-batch + padded tail (100 % 32 != 0),
+        async == sync exactly."""
+        x, y = make_regression(n=100)
+        model = Sequential([Dense(8, activation="tanh"), Dense(1)])
+        est = Estimator(model=model, loss_fn=objectives.get("mse"),
+                        optimizer=optimizers.Adam(1e-2),
+                        metrics=["mae", "mse"])
+        fs = FeatureSet.from_ndarrays(x, y, shuffle=False)
+        est.train(FeatureSet.from_ndarrays(x, y, seed=1), batch_size=32,
+                  epochs=2)
+        with flag("eval.async", False):
+            sync_scores = est.evaluate(fs, batch_size=32)
+        with flag("eval.async", True):
+            async_scores = est.evaluate(fs, batch_size=32)
+        assert set(sync_scores) == {"mae", "mse"}
+        assert sync_scores == async_scores  # bit-identical floats
+
+    def test_direct_eval_bit_identical(self, ctx):
+        """Batch-mean capture path: full batches sharded + UNPADDED tail
+        (11 % 8 != 0) through its true-size compile."""
+        rs = np.random.RandomState(3)
+        x = rs.randn(11, 3).astype(np.float32)
+        y = rs.randn(11).astype(np.float32)
+        fs = FeatureSet.from_ndarrays(x, y, shuffle=False, shard=False)
+        est = make_direct_estimator()
+        with flag("eval.async", False):
+            sync_res = est.evaluate(fs, batch_size=8)
+        with flag("eval.async", True):
+            async_res = est.evaluate(fs, batch_size=8)
+        assert sync_res == async_res
+        expect = float(np.sum(((x @ np.ones((3, 1)))[:, 0] - y) ** 2)) / 11
+        assert async_res["loss"] == pytest.approx(expect, rel=1e-5)
+
+    def test_direct_exact_eval_bit_identical(self, ctx):
+        """Per-example exact path: padded tails masked out on device, one
+        device_get drains the pass; async == sync exactly."""
+        rs = np.random.RandomState(4)
+        x = rs.randn(19, 3).astype(np.float32)
+        y = rs.randn(19).astype(np.float32)
+        fs = FeatureSet.from_ndarrays(x, y, shuffle=False, shard=False)
+        est = make_direct_estimator(with_per_example=True)
+        with flag("eval.async", False):
+            sync_res = est.evaluate(fs, batch_size=8)
+        with flag("eval.async", True):
+            async_res = est.evaluate(fs, batch_size=8)
+        assert sync_res == async_res
+        expect = float(np.sum(((x @ np.ones((3, 1)))[:, 0] - y) ** 2)) / 19
+        assert async_res["loss"] == pytest.approx(expect, rel=1e-5)
+
+    def test_empty_validation_set_still_raises(self, ctx):
+        model = Sequential([Dense(4), Dense(1)])
+        est = Estimator(model=model, loss_fn=objectives.get("mse"),
+                        optimizer=optimizers.Adam(1e-2), metrics=["mae"])
+        x, y = make_regression(n=8)
+        est.train(FeatureSet.from_ndarrays(x, y), batch_size=8, epochs=1)
+        empty = FeatureSet.from_ndarrays(np.zeros((0, 4), np.float32),
+                                         np.zeros((0, 1), np.float32),
+                                         shuffle=False)
+        with pytest.raises(ValueError, match="no batches"):
+            est.evaluate(empty, batch_size=8)
+
+
+class TestPredictParity:
+    def _trained(self, ctx, n=100):
+        x, y = make_regression(n=n)
+        model = Sequential([Dense(8, activation="tanh"), Dense(1)])
+        est = Estimator(model=model, loss_fn=objectives.get("mse"),
+                        optimizer=optimizers.Adam(1e-2))
+        est.train(FeatureSet.from_ndarrays(x, y, seed=1), batch_size=32,
+                  epochs=1)
+        return est, x
+
+    def test_predict_bit_identical_with_ragged_tail(self, ctx):
+        est, x = self._trained(ctx)
+        with flag("eval.async", False):
+            sync_preds = est.predict(x, batch_size=32)
+        with flag("eval.async", True):
+            async_preds = est.predict(x, batch_size=32)
+        assert np.asarray(async_preds).shape == (100, 1)
+        np.testing.assert_array_equal(np.asarray(sync_preds),
+                                      np.asarray(async_preds))
+
+    def test_predict_window_sizes_agree(self, ctx):
+        """The in-flight window K only changes WHEN results are fetched,
+        never what they are."""
+        est, x = self._trained(ctx)
+        with flag("eval.predict_window", 1):
+            w1 = est.predict(x, batch_size=16)
+        with flag("eval.predict_window", 8):
+            w8 = est.predict(x, batch_size=16)
+        np.testing.assert_array_equal(np.asarray(w1), np.asarray(w8))
+
+
+class TestDeviceFeedLifecycle:
+    def _fs(self, n=64):
+        x = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+        y = np.arange(n, dtype=np.float32)
+        return FeatureSet.from_ndarrays(x, y, shuffle=False)
+
+    def test_finite_iterator_full_drain(self, ctx):
+        """A finite masked eval feed drains every batch then StopIterates;
+        metadata (valid counts) rides along host-side untouched."""
+        fs = self._fs(20)
+        host_it = masked_eval_batches(
+            fs.eval_iterator(8, pad_remainder=True), 8)
+        with DeviceFeed(host_it, ctx.mesh, shard_fn=shard_payload) as feed:
+            items = list(feed)
+        assert [v for _, v in items] == [8, 8, 4]
+        (x, y, mask), valid = items[-1]
+        assert isinstance(valid, int)
+        assert x.shape == (8, 4)  # padded static shape, sharded
+        np.testing.assert_array_equal(
+            np.asarray(mask), [1, 1, 1, 1, 0, 0, 0, 0])
+        with pytest.raises(StopIteration):
+            next(feed)
+
+    def test_close_mid_epoch_stops_producer(self, ctx):
+        fs = self._fs(64)
+        feed = DeviceFeed(fs.train_iterator(16), ctx.mesh, prefetch=2)
+        next(feed)
+        next(feed)
+        feed.close()
+        feed.close()  # idempotent
+        assert not feed._thread.is_alive()
+        with pytest.raises(StopIteration):
+            next(feed)
+
+    def test_context_manager_closes_on_break(self, ctx):
+        fs = self._fs(64)
+        with DeviceFeed(fs.train_iterator(16), ctx.mesh) as feed:
+            next(feed)
+        feed._thread.join(timeout=5)
+        assert not feed._thread.is_alive()
+
+    def test_producer_exception_surfaces(self, ctx):
+        def bad_batches():
+            yield np.ones((8, 4), np.float32), np.ones(8, np.float32)
+            raise RuntimeError("decode failed mid-stream")
+
+        with DeviceFeed(bad_batches(), ctx.mesh) as feed:
+            next(feed)  # first batch is fine
+            with pytest.raises(RuntimeError, match="decode failed"):
+                while True:
+                    next(feed)

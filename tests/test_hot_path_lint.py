@@ -1,0 +1,41 @@
+"""Tier-1 collection-time guard: the eval/predict hot paths must stay free
+of per-batch host↔device syncs (``scripts/check_hot_path_syncs.py``).
+
+The lint runs at IMPORT (= pytest collection) so a reintroduced
+``float(...)``/``np.asarray(...)`` inside an ``evaluate*``/``predict``
+dispatch loop fails the suite even if no behavioral test notices the
+restored stall."""
+import importlib.util
+import os
+
+_script = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts", "check_hot_path_syncs.py")
+_spec = importlib.util.spec_from_file_location("check_hot_path_syncs",
+                                               _script)
+_lint = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_lint)
+
+_violations = _lint.check()
+if _violations:  # collection-time failure, with the offending lines
+    raise AssertionError(
+        "per-batch host sync reintroduced in estimator hot paths: "
+        + "; ".join(f"{fn}:{line} {what}" for fn, line, what in _violations))
+
+
+def test_hot_paths_have_no_per_batch_syncs():
+    assert _lint.check() == []
+
+
+def test_lint_catches_a_seeded_sync(tmp_path):
+    """The checker itself must detect a seeded violation (guards against
+    the lint rotting into a silent always-pass)."""
+    bad = tmp_path / "estimator.py"
+    bad.write_text(
+        "class Estimator:\n"
+        "    def predict(self, x):\n"
+        "        for b in x:\n"
+        "            v = float(self._step(b))\n"
+        "            a = np.asarray(v)\n"
+        "        return a\n")
+    found = _lint.check(str(bad))
+    assert {w for _, _, w in found} == {"float()", "np.asarray()"}

@@ -73,6 +73,74 @@ class TestPostProcessing:
         assert out[1]["class"] == 2
 
 
+class TestCompileWarmth:
+    def test_prewarm_compiles_once_per_bucket(self, ctx):
+        from analytics_zoo_tpu.inference import InferenceModel
+        im = InferenceModel().load_jax(
+            lambda p, x: x @ p["w"], {"w": np.eye(4, 3, dtype=np.float32)})
+        im.prewarm(np.zeros((3, 4), np.float32))  # batch 3 → bucket 4
+        assert im.compile_counts == {4: 1}
+        assert im.compile_seconds[4] > 0
+        out = im.predict(np.ones((3, 4), np.float32))
+        assert out.shape == (3, 3)
+        # first request hit the prewarmed executable: NO new compile
+        assert im.compile_counts == {4: 1}
+        im.predict(np.ones((5, 4), np.float32))  # bucket 8: cold, compiles
+        assert im.compile_counts == {4: 1, 8: 1}
+        im.predict(np.ones((7, 4), np.float32))  # bucket 8 again: warm
+        assert im.compile_counts == {4: 1, 8: 1}
+
+    def test_prewarm_multiple_buckets(self, ctx):
+        from analytics_zoo_tpu.inference import InferenceModel
+        im = InferenceModel().load_jax(lambda p, x: x * 2.0, {})
+        im.prewarm(np.zeros((1, 2), np.float32), buckets=(1, 4, 30))
+        assert im.compile_counts == {1: 1, 4: 1, 32: 1}
+
+    def test_cluster_serving_startup_prewarm(self, ctx, tmp_path):
+        """The server compiles its configured batch bucket at construction;
+        the first claimed full batch runs with zero new compiles."""
+        from analytics_zoo_tpu.inference import InferenceModel
+        from analytics_zoo_tpu.serving import (
+            ClusterServing, InputQueue, OutputQueue, ServingConfig)
+        im = InferenceModel().load_jax(
+            lambda p, x: x.reshape(x.shape[0], -1).mean(1, keepdims=True), {})
+        src = f"dir://{tmp_path}"
+        cfg = ServingConfig(data_src=src, image_shape=(4, 4, 3),
+                            batch_size=4, batch_wait_ms=5)
+        serving = ClusterServing(cfg, model=im)
+        assert serving.prewarmed
+        assert im.compile_counts == {4: 1}
+        inq = InputQueue(src)
+        rs = np.random.RandomState(0)
+        for i in range(4):
+            inq.enqueue_image(
+                f"w{i}", rs.randint(0, 255, (4, 4, 3)).astype(np.uint8))
+        served = 0
+        for _ in range(10):
+            served += serving.serve_once()
+            if served >= 4:
+                break
+        assert served >= 4
+        assert OutputQueue(src).query("w3", timeout_s=5.0) is not None
+        assert im.compile_counts == {4: 1}  # first traffic: still warm
+
+    def test_compile_cache_dir_wiring(self, ctx, tmp_path):
+        import jax
+        from analytics_zoo_tpu.common import context as ctx_mod
+        from analytics_zoo_tpu.common.config import global_config
+        from analytics_zoo_tpu.inference import InferenceModel
+        cfg = global_config()
+        cfg.set("compile.cache_dir", str(tmp_path / "xla-cache"))
+        try:
+            InferenceModel()  # construction wires the persistent cache
+            assert jax.config.jax_compilation_cache_dir == \
+                str(tmp_path / "xla-cache")
+        finally:
+            cfg.unset("compile.cache_dir")
+            ctx_mod._cache_wired = False
+            jax.config.update("jax_compilation_cache_dir", None)
+
+
 class TestEndToEnd:
     def test_serve_loop_tensor_records(self, ctx, tmp_path):
         import jax.numpy as jnp
