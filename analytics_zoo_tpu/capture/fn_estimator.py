@@ -47,7 +47,8 @@ class FnEstimator:
 
     def _featureset(self, input_fn: Callable, mode: str) -> FeatureSet:
         data = input_fn(mode)
-        if isinstance(data, FeatureSet):
+        from ..feature.featureset import HostDataset
+        if isinstance(data, HostDataset):
             return data
         if mode == ModeKeys.PREDICT:
             # contract: PREDICT input_fn returns features only — a LIST for

@@ -111,7 +111,8 @@ class GANEstimator:
 
     def train(self, x, batch_size: int = 32, steps: int = 100
               ) -> Dict[str, Any]:
-        fs = x if isinstance(x, FeatureSet) else \
+        from ..feature.featureset import HostDataset
+        fs = x if isinstance(x, HostDataset) else \
             FeatureSet.from_ndarrays(np.asarray(x, np.float32))
         local_batch = self.ctx.local_batch(batch_size)
         it = fs.train_iterator(local_batch)

@@ -107,8 +107,9 @@ class GraphModel:
             **kwargs):
         if featureset is None:
             featureset = FeatureSet.from_ndarrays(x, y)
+        from ..feature.featureset import HostDataset
         if validation_data is not None and not isinstance(validation_data,
-                                                          FeatureSet):
+                                                          HostDataset):
             validation_data = FeatureSet.from_ndarrays(*validation_data)
         return self.estimator.train(featureset, batch_size=batch_size,
                                     epochs=epochs,

@@ -119,6 +119,32 @@ _global_config.register("version_check", False,
                         "Warn on jax/libtpu version mismatches at context init "
                         "(reference: spark.analytics.zoo.versionCheck).")
 _global_config.register("data.prefetch", 2, "Device-feed prefetch depth.")
+_global_config.register("data.num_workers", 0,
+                        "Default worker count for FeatureSet transforms "
+                        "(0 = serial loop; >1 enables the parallel tiers).")
+_global_config.register("data.transform_mode", "auto",
+                        "Per-record transform engine: auto|mp|thread|loop. "
+                        "'auto' picks forked shared-memory workers (mp) "
+                        "when num_workers > 1 — the only tier that beats "
+                        "the GIL for pure-Python transforms — falling back "
+                        "to a thread pool where fork is unavailable.")
+_global_config.register("data.shm_slots", 4,
+                        "Shared-memory batch slabs per transform worker "
+                        "pool — the mp data plane's pipeline depth. A "
+                        "yielded zero-copy batch view stays valid until "
+                        "shm_slots-1 further batches are drawn; keep this "
+                        "above data.prefetch + 2.")
+_global_config.register("data.cache_dir", "",
+                        "Directory for one-shot lazy-transform memmap "
+                        "replay caches ('' = a fresh temp dir per set).")
+_global_config.register("data.staging_slots", 0,
+                        "Train-iterator staging ring depth for zero-alloc "
+                        "batch gathers (np.take(..., out=...) into reused "
+                        "buffers). 0 = fresh arrays per batch (safe "
+                        "default: a yielded batch is overwritten after "
+                        "staging_slots further batches, which breaks "
+                        "consumers that buffer batches or alias host "
+                        "memory into device arrays).")
 _global_config.register("eval.async", True,
                         "Pipeline evaluate()/predict() through the "
                         "DeviceFeed with on-device accumulation (one host "

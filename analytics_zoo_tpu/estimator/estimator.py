@@ -43,7 +43,7 @@ from ..common.config import global_config
 from ..common.context import get_context
 from ..common.triggers import EveryEpoch, MaxEpoch, TrainingState, Trigger
 from ..common.utils import time_it
-from ..feature.featureset import FeatureSet
+from ..feature.featureset import FeatureSet, HostDataset
 from ..feature.device_feed import (DeviceFeed, masked_eval_batches,
                                    shard_payload)
 from ..keras import metrics as metrics_mod
@@ -150,6 +150,15 @@ def _group_host_batches(it, first_epoch_remaining, per_epoch, k):
         if len(batches) < g:
             return
         remaining -= g
+
+def _prepare_dataset(dataset, local_batch: int) -> None:
+    """Duck-typed warm-up hook: lazy/mp data planes fork their worker
+    pools, map shared-memory slabs and create replay caches here — one-time
+    setup that must not land inside the overlapped dispatch loop."""
+    prepare = getattr(dataset, "prepare", None)
+    if prepare is not None:
+        prepare(local_batch)
+
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
@@ -445,6 +454,7 @@ class Estimator:
                 f"per-host batch {local_batch} must be divisible by this "
                 f"host's {local_dp} data-axis devices; use batch_size={good}")
 
+        _prepare_dataset(train_set, local_batch)
         sample = next(train_set.train_iterator(local_batch))
         self._ensure_initialized(sample[0])
         # freeze()/unfreeze() may have changed since the step was compiled —
@@ -684,6 +694,7 @@ class Estimator:
         # the first batch twice on every evaluation — the first batch is
         # consumed here for initialization and chained back into the feed
         import itertools
+        _prepare_dataset(val_set, local_batch)
         it = val_set.eval_iterator(local_batch, pad_remainder=True)
         try:
             first = next(it)
@@ -722,6 +733,7 @@ class Estimator:
         if not multiproc:
             local_batch = min(local_batch, val_set.size)
         local_batch = max(ndev, (local_batch // ndev) * ndev)
+        _prepare_dataset(val_set, local_batch)
         n_local = math.ceil(val_set.size / local_batch)
         if multiproc:
             from jax.experimental import multihost_utils as mhu
@@ -795,6 +807,7 @@ class Estimator:
             # compiles the same global shape regardless of its shard size
             local_batch = min(local_batch, val_set.size)
         local_batch = max(ndev, (local_batch // ndev) * ndev)
+        _prepare_dataset(val_set, local_batch)
         if multiproc:
             # all-hosts-agree padded-tail eval: every host runs the SAME
             # number of identically-shaped sharded steps (the black-box
@@ -908,11 +921,12 @@ class Estimator:
         device compute of N+1..N+K-1, and the device→host download of batch
         N all overlap. ``eval.async = False`` falls back to the synchronous
         fetch-per-batch loop."""
-        if not isinstance(x, FeatureSet):
+        if not isinstance(x, HostDataset):
             x = FeatureSet.from_ndarrays(x, None, shuffle=False, shard=False)
         local_batch = min(self.ctx.local_batch(batch_size), x.size)
         ndev = self.mesh.devices.size
         local_batch = max(ndev, (local_batch // ndev) * ndev)
+        _prepare_dataset(x, local_batch)
         sample = next(x.eval_iterator(local_batch, pad_remainder=True))
         self._ensure_initialized(sample[0])
         if self._predict_step is None:

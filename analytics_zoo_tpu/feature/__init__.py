@@ -1,5 +1,9 @@
-from .featureset import FeatureSet, MemoryType  # noqa: F401
+from .featureset import (  # noqa: F401
+    FeatureSet, HostDataset, LazyTransformFeatureSet, MemoryType,
+    StreamingFeatureSet)
 from .device_feed import DeviceFeed  # noqa: F401
 from .preprocessing import (  # noqa: F401
-    ArrayToTensor, ChainedPreprocessing, FeatureLabelPreprocessing, Lambda,
-    Preprocessing, stack_records)
+    ArrayToTensor, BatchLambda, BatchPreprocessing, ChainedPreprocessing,
+    FeatureLabelPreprocessing, Lambda, Preprocessing, stack_records)
+from .worker_pool import (  # noqa: F401
+    TransformWorkerError, TransformWorkerPool)

@@ -41,8 +41,17 @@ def masked_eval_batches(it: Iterator[Any], batch_size: int,
     count as host-side metadata. The mask marks the real rows of padded
     tail batches, so pad rows contribute nothing on device.
     """
+    # masks are content-constant per valid count: the arange is built once
+    # and each distinct mask is cached, so the common full-batch case reuses
+    # ONE array for the whole pass instead of allocating arange+mask per
+    # batch (tail batches add at most a few distinct entries)
+    positions = np.arange(batch_size)
+    masks: dict = {batch_size: np.ones(batch_size, np.float32)}
     for x, y, valid in it:
-        mask = (np.arange(batch_size) < valid).astype(np.float32)
+        mask = masks.get(valid)
+        if mask is None:
+            mask = (positions < valid).astype(np.float32)
+            masks[valid] = mask
         if with_labels:
             yield (x, y, mask), valid
         else:

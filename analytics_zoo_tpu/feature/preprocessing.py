@@ -118,12 +118,33 @@ class FeatureLabelPreprocessing(Preprocessing):
         return self.feature.apply(x), self.label.apply(y)
 
 
-def stack_records(records: Sequence[Any]) -> Any:
+def stack_records(records: Sequence[Any], out: Any = None) -> Any:
     """Stack a list of records (arrays, or tuples/dicts of arrays) into one
-    batched record — the ``SampleToMiniBatch`` role."""
+    batched record — the ``SampleToMiniBatch`` role.
+
+    With ``out`` (a same-structured tree of ``[len(records), ...]``
+    buffers) rows are written in place and ``out`` is returned: callers
+    filling a preallocated output tree chunk by chunk avoid ever holding a
+    full per-record Python list next to its stacked copy."""
     first = records[0]
+    if out is None:
+        if isinstance(first, tuple):
+            return tuple(np.stack([r[i] for r in records])
+                         for i in range(len(first)))
+        if isinstance(first, dict):
+            return {k: np.stack([r[k] for r in records]) for k in first}
+        return np.stack(records)
     if isinstance(first, tuple):
-        return tuple(np.stack([r[i] for r in records]) for i in range(len(first)))
-    if isinstance(first, dict):
-        return {k: np.stack([r[k] for r in records]) for k in first}
-    return np.stack(records)
+        for j in range(len(first)):
+            buf = out[j]
+            for i, r in enumerate(records):
+                buf[i] = r[j]
+    elif isinstance(first, dict):
+        for k in first:
+            buf = out[k]
+            for i, r in enumerate(records):
+                buf[i] = r[k]
+    else:
+        for i, r in enumerate(records):
+            out[i] = r
+    return out

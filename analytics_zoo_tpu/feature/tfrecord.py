@@ -309,6 +309,21 @@ def open_tfrecord(path: str, verify_crc: bool = True):
     return _PythonReader(path, verify_crc)
 
 
+def count_records(paths: Union[str, Sequence[str]],
+                  verify_crc: bool = True) -> int:
+    """Total record count across one or more files — one indexed pass
+    through the reader (mmap-cheap on the native path), no payload
+    decode. Used by ``FeatureSet.from_tfrecord`` to size its ingest."""
+    total = 0
+    for path in ([paths] if isinstance(paths, str) else paths):
+        reader = open_tfrecord(path, verify_crc)
+        try:
+            total += len(reader)
+        finally:
+            reader.close()
+    return total
+
+
 def iter_tfrecords(paths: Union[str, Sequence[str]],
                    verify_crc: bool = True) -> Iterator[bytes]:
     """Iterate raw records across one or more files."""

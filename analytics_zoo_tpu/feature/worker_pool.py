@@ -1,0 +1,460 @@
+"""Multiprocess shared-memory transform workers — the host data plane's
+answer to the GIL.
+
+The reference keeps ingest ahead of the engine with cached-RDD iterators and
+per-core replica threads (``FeatureSet.scala:230``); a JVM thread pool
+parallelizes *Scala* transforms for free. The Python equivalent does not
+exist: a ``ThreadPoolExecutor`` only helps transforms that release the GIL
+(PIL, numpy decoders) — a pure-Python ``Preprocessing`` chain serializes on
+the interpreter lock no matter how many threads it is given. This module is
+the way past it:
+
+- workers are **forked** processes, so the source feature arrays and the
+  (arbitrary, closure-capturing, unpicklable) transform chain are inherited
+  by address-space copy — nothing is pickled per task but a small index
+  array;
+- each worker applies the chain to its record range and writes the stacked
+  result straight into a preallocated ``multiprocessing.shared_memory``
+  slab (``MAP_SHARED`` pages created BEFORE the fork, so parent and child
+  numpy views address the same physical memory);
+- the consumer gets **zero-copy numpy views** into the slab — results never
+  transit a pipe.
+
+Slot ownership contract: a view yielded by :meth:`TransformWorkerPool.
+map_index_batches` is valid until ``slots - 1`` further batches have been
+drawn (the slot is then handed back to a worker). Consumers that forward
+batches into a DeviceFeed satisfy this by construction as long as
+``data.shm_slots`` exceeds the feed's prefetch depth + 2.
+
+Workers must not touch jax — they are forked from a process with a live
+XLA runtime and only ever run numpy/pure-Python transform code.
+"""
+from __future__ import annotations
+
+import atexit
+import itertools
+import multiprocessing as mp
+import os
+import queue as queue_mod
+import threading
+import traceback
+import warnings
+from multiprocessing import shared_memory
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_ALIGN = 128  # slab leaf alignment (cache-line / vector friendly)
+
+
+class TransformWorkerError(RuntimeError):
+    """A transform raised inside a worker process; carries the worker-side
+    traceback so the failure reads as if it happened in the consumer."""
+
+
+def fork_available() -> bool:
+    return "fork" in mp.get_all_start_methods()
+
+
+def default_workers() -> int:
+    cfg = int(os.environ.get("ZOO_TPU_DATA_NUM_WORKERS", "0") or 0)
+    if cfg > 0:
+        return cfg
+    return max(1, min(4, os.cpu_count() or 1))
+
+
+# -- record-tree plumbing (mirrors featureset's ArrayTree convention) --------
+
+
+def _index_tree(tree, i: int):
+    if isinstance(tree, tuple):
+        return tuple(t[i] for t in tree)
+    if isinstance(tree, dict):
+        return {k: v[i] for k, v in tree.items()}
+    return tree[i]
+
+
+def _record_leaves(record) -> List[np.ndarray]:
+    if isinstance(record, tuple):
+        return [np.asarray(r) for r in record]
+    if isinstance(record, dict):
+        return [np.asarray(record[k]) for k in record]
+    return [np.asarray(record)]
+
+
+class TreeSpec:
+    """Shape/dtype/structure of one transformed record: the slab layout."""
+
+    def __init__(self, record):
+        if isinstance(record, tuple):
+            self.kind, self.keys = "tuple", len(record)
+        elif isinstance(record, dict):
+            self.kind, self.keys = "dict", list(record)
+        else:
+            self.kind, self.keys = "array", None
+        leaves = _record_leaves(record)
+        self.shapes = [tuple(l.shape) for l in leaves]
+        self.dtypes = [l.dtype for l in leaves]
+        for dt in self.dtypes:
+            if dt.hasobject:
+                raise ValueError(
+                    "shared-memory transform workers need numeric record "
+                    "leaves; an object-dtype output cannot live in a slab "
+                    "(use transform_mode='thread' or 'loop')")
+
+    def _leaf_blocks(self, rows: int):
+        """Leaf-major slab layout: per leaf one contiguous ``rows × record``
+        block, block starts aligned to ``_ALIGN``."""
+        offset = 0
+        for shape, dtype in zip(self.shapes, self.dtypes):
+            offset = (offset + _ALIGN - 1) // _ALIGN * _ALIGN
+            nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+            yield offset, shape, dtype
+            offset += nbytes * rows
+        yield offset, None, None  # total size sentinel
+
+    def slab_bytes(self, rows: int) -> int:
+        return max(1, list(self._leaf_blocks(rows))[-1][0])
+
+    def slab_views(self, shm, rows: int) -> List[np.ndarray]:
+        """Numpy views over one slab: one ``[rows, *leaf_shape]`` array per
+        leaf at its aligned block offset."""
+        return [np.ndarray((rows,) + shape, dtype=dtype, buffer=shm.buf,
+                           offset=offset)
+                for offset, shape, dtype in self._leaf_blocks(rows)
+                if shape is not None]
+
+    def tree(self, views: Sequence[np.ndarray]):
+        if self.kind == "tuple":
+            return tuple(views)
+        if self.kind == "dict":
+            return {k: v for k, v in zip(self.keys, views)}
+        return views[0]
+
+    def slice(self, views: Sequence[np.ndarray], n: int):
+        return self.tree([v[:n] for v in views])
+
+
+def _write_record(views: Sequence[np.ndarray], row: int, record) -> None:
+    for view, leaf in zip(views, _record_leaves(record)):
+        view[row] = leaf
+
+
+# -- worker side -------------------------------------------------------------
+
+
+def _worker_main(features, transform, slot_views, task_q, result_q) -> None:
+    """Forked worker loop. Everything in ``args`` arrived by fork
+    inheritance (no pickling): the source feature tree, the transform
+    chain, and numpy views over the MAP_SHARED slabs."""
+    while True:
+        task = task_q.get()
+        if task is None:
+            return
+        task_id, slot, row0, idx = task
+        try:
+            views = slot_views[slot]
+            for j, i in enumerate(idx):
+                rec = transform.apply(_index_tree(features, int(i)))
+                _write_record(views, row0 + j, rec)
+            result_q.put((task_id, len(idx), None))
+        except BaseException:
+            result_q.put((task_id, 0, traceback.format_exc()))
+
+
+# -- parent side -------------------------------------------------------------
+
+
+class TransformWorkerPool:
+    """Fixed fleet of forked transform workers over shared-memory slabs.
+
+    ``rows`` is the slab height (max records per task — the batch size for
+    streaming use, the dataset size for one-shot :func:`transform_all`
+    use); ``slots`` is how many independent slabs cycle through the
+    workers (the pipeline depth).
+    """
+
+    _live: "Dict[int, TransformWorkerPool]" = {}
+
+    def __init__(self, features, transform, rows: int,
+                 slots: int = 4, num_workers: Optional[int] = None,
+                 sample_record=None):
+        if not fork_available():
+            raise RuntimeError(
+                "TransformWorkerPool requires the fork start method "
+                "(POSIX); use the thread transform mode instead")
+        if sample_record is None:
+            sample_record = transform.apply(_index_tree(features, 0))
+        self.spec = TreeSpec(sample_record)
+        self.rows = int(rows)
+        self.slots = max(1, int(slots))
+        self.num_workers = (int(num_workers) if num_workers
+                            else default_workers())
+        slab_bytes = self.spec.slab_bytes(self.rows)
+        self._shms: List[shared_memory.SharedMemory] = []
+        self._slot_views: List[List[np.ndarray]] = []
+        for _ in range(self.slots):
+            shm = shared_memory.SharedMemory(create=True, size=slab_bytes)
+            self._shms.append(shm)
+            self._slot_views.append(self.spec.slab_views(shm, self.rows))
+        ctx = mp.get_context("fork")
+        self._task_q = ctx.SimpleQueue()
+        self._result_q = ctx.Queue()
+        self._procs: List[mp.Process] = []
+        with warnings.catch_warnings():
+            # jax warns on fork of its multithreaded parent; the children
+            # never touch jax (numpy-only transform loops), so the warning
+            # is noise here
+            warnings.simplefilter("ignore")
+            for _ in range(self.num_workers):
+                p = ctx.Process(
+                    target=_worker_main,
+                    args=(features, transform, self._slot_views,
+                          self._task_q, self._result_q),
+                    daemon=True, name="zoo-transform-worker")
+                p.start()
+                self._procs.append(p)
+        self._task_counter = itertools.count()
+        self._outstanding: set = set()
+        self._results: Dict[int, Tuple[int, Optional[str]]] = {}
+        self._closed = False
+        self._lock = threading.Lock()
+        TransformWorkerPool._live[id(self)] = self
+
+    # -- task plumbing -------------------------------------------------------
+
+    def _submit(self, slot: int, row0: int, idx: np.ndarray) -> int:
+        tid = next(self._task_counter)
+        self._outstanding.add(tid)
+        self._task_q.put((tid, slot, row0,
+                          np.ascontiguousarray(idx, dtype=np.int64)))
+        return tid
+
+    def _collect(self, tid: int, timeout: float = 300.0) -> int:
+        """Block until task ``tid`` finished; returns rows written."""
+        while tid not in self._results:
+            try:
+                got_tid, n, err = self._result_q.get(timeout=1.0)
+            except queue_mod.Empty:
+                dead = [p for p in self._procs
+                        if not p.is_alive() and p.exitcode not in (0, None)]
+                if dead:
+                    raise TransformWorkerError(
+                        f"transform worker died with exit code "
+                        f"{dead[0].exitcode} (killed? OOM?)") from None
+                timeout -= 1.0
+                if timeout <= 0:
+                    raise TransformWorkerError(
+                        "timed out waiting for a transform worker") from None
+                continue
+            self._outstanding.discard(got_tid)
+            self._results[got_tid] = (n, err)
+        n, err = self._results.pop(tid)
+        if err is not None:
+            raise TransformWorkerError(
+                "transform raised inside a worker process:\n" + err)
+        return n
+
+    def _drain_outstanding(self) -> None:
+        """Wait out tasks abandoned by a closed consumer generator, so
+        their slots are genuinely free before new tasks reuse them."""
+        for tid in sorted(self._outstanding):
+            try:
+                self._collect(tid)
+            except TransformWorkerError:
+                pass  # an abandoned task's error has no consumer left
+
+    # -- high-level consumers ------------------------------------------------
+
+    def map_index_batches(self, idx_iter: Iterator[np.ndarray]
+                          ) -> Iterator[Tuple[np.ndarray, Any]]:
+        """Order-preserving pipelined map: yields ``(idx, view_tree)`` per
+        input index batch, keeping up to ``slots`` batches in flight.
+        The yielded tree is a zero-copy slab view valid until ``slots - 1``
+        further batches are drawn."""
+        if not self._lock.acquire(blocking=False):
+            # a blocking wait here would DEADLOCK when the owner is a
+            # suspended generator on this same thread (train iterator
+            # paused mid-validation) — refuse loudly instead; callers that
+            # need concurrent streams use one pool per stream
+            raise RuntimeError(
+                "TransformWorkerPool is already streaming another batch "
+                "sequence; use a separate pool per concurrent iterator")
+        try:
+            self._drain_outstanding()
+            it = iter(idx_iter)
+            inflight: Dict[int, Tuple[int, np.ndarray]] = {}
+            next_seq = 0
+
+            def submit_one():
+                nonlocal next_seq
+                idx = next(it)  # propagates StopIteration to the caller
+                if len(idx) > self.rows:
+                    raise ValueError(
+                        f"index batch of {len(idx)} exceeds the pool's "
+                        f"slab height {self.rows}")
+                seq = next_seq
+                tid = self._submit(seq % self.slots, 0, idx)
+                inflight[seq] = (tid, idx)
+                next_seq += 1
+
+            for _ in range(self.slots):
+                try:
+                    submit_one()
+                except StopIteration:
+                    break
+            yield_seq = 0
+            while yield_seq < next_seq:
+                tid, idx = inflight.pop(yield_seq)
+                n = self._collect(tid)
+                yield idx, self.spec.slice(
+                    self._slot_views[yield_seq % self.slots], n)
+                # resumed: the consumer released the oldest view — its slot
+                # may take the next task
+                try:
+                    submit_one()
+                except StopIteration:
+                    pass
+                yield_seq += 1
+        finally:
+            self._lock.release()
+
+    def transform_rows(self, indices: np.ndarray, slot: int = 0,
+                       chunk: Optional[int] = None) -> int:
+        """One-shot scatter: transform ``indices`` into slab ``slot`` rows
+        ``0..len(indices)`` using every worker (range-chunked). Blocks
+        until complete; returns rows written."""
+        if not self._lock.acquire(blocking=False):
+            raise RuntimeError(
+                "TransformWorkerPool is already streaming another batch "
+                "sequence; use a separate pool per concurrent consumer")
+        try:
+            self._drain_outstanding()
+            n = len(indices)
+            if n > self.rows:
+                raise ValueError(f"{n} rows exceed slab height {self.rows}")
+            if chunk is None:
+                chunk = max(1, -(-n // (self.num_workers * 4)))
+            tids = [self._submit(slot, r0, indices[r0:r0 + chunk])
+                    for r0 in range(0, n, chunk)]
+            for tid in tids:
+                self._collect(tid)
+            return n
+        finally:
+            self._lock.release()
+
+    def slot_tree(self, slot: int = 0, n: Optional[int] = None):
+        return self.spec.slice(self._slot_views[slot],
+                               self.rows if n is None else n)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self, unlink: bool = True) -> None:
+        """Stop workers and release slabs. Safe to call repeatedly. With
+        ``unlink=False`` the shared segments stay mapped (a caller keeping
+        zero-copy views alive unlinks later via :func:`release_slabs`)."""
+        if self._closed:
+            return
+        self._closed = True
+        TransformWorkerPool._live.pop(id(self), None)
+        try:
+            for _ in self._procs:
+                self._task_q.put(None)
+        except Exception:
+            pass
+        for p in self._procs:
+            p.join(timeout=2)
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            if p.is_alive():
+                p.join(timeout=2)
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=2)
+        self._result_q.close()
+        self._result_q.cancel_join_thread()
+        if unlink:
+            self.release_slabs()
+
+    def release_slabs(self) -> None:
+        self._slot_views = []
+        for shm in self._shms:
+            try:
+                shm.close()
+            except BufferError:
+                pass  # a consumer still holds views; the unlink below
+                # still frees the NAME — memory goes when the views do
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+        self._shms = []
+
+    def detach_slabs(self) -> List[shared_memory.SharedMemory]:
+        """Hand slab ownership to the caller (used by transform_all to keep
+        zero-copy result arrays alive past the pool)."""
+        shms, self._shms = self._shms, []
+        return shms
+
+    def __enter__(self) -> "TransformWorkerPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+@atexit.register
+def _close_live_pools() -> None:
+    # interpreter exit must not strand worker processes or /dev/shm segments
+    for pool in list(TransformWorkerPool._live.values()):
+        try:
+            pool.close()
+        except Exception:
+            pass
+
+
+class SlabKeepAlive:
+    """Owns unlinked shared-memory mappings backing zero-copy result
+    arrays: the segments' names are already gone from /dev/shm (crash-safe
+    — no leak even on SIGKILL), the pages free when the last view dies."""
+
+    def __init__(self, shms: List[shared_memory.SharedMemory]):
+        self._shms = shms
+        for shm in shms:
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+
+    def __del__(self):
+        for shm in self._shms:
+            try:
+                shm.close()
+            except Exception:
+                pass  # views may still be exported; pages free with them
+
+
+def transform_all(features, size: int, transform,
+                  num_workers: Optional[int] = None
+                  ) -> Tuple[Any, SlabKeepAlive]:
+    """Eagerly transform ``size`` records across forked workers into ONE
+    full-dataset shared slab; returns ``(stacked_tree, keepalive)`` where
+    the tree's arrays are zero-copy views into the slab (peak memory = one
+    transformed copy, not records-list + stacked copy)."""
+    pool = TransformWorkerPool(features, transform, rows=size, slots=1,
+                               num_workers=num_workers)
+    try:
+        pool.transform_rows(np.arange(size, dtype=np.int64))
+        tree = pool.slot_tree(0, size)
+        keepalive = SlabKeepAlive(pool.detach_slabs())
+    finally:
+        pool.close()
+    return tree, keepalive

@@ -355,12 +355,12 @@ class _TrainableMixin:
                 getattr(est, setter)(*getattr(self, attr)) if attr != "_clip" \
                     else est.set_gradient_clipping(getattr(self, attr))
         from ..feature import FeatureSet
-        from ..feature.featureset import StreamingFeatureSet
+        from ..feature.featureset import HostDataset
         if featureset is None:
-            featureset = x if isinstance(x, (FeatureSet, StreamingFeatureSet)) \
+            featureset = x if isinstance(x, HostDataset) \
                 else FeatureSet.from_ndarrays(x, y)
         if validation_data is not None and not isinstance(
-                validation_data, (FeatureSet, StreamingFeatureSet)):
+                validation_data, HostDataset):
             validation_data = FeatureSet.from_ndarrays(*validation_data)
         return est.train(featureset, batch_size=batch_size, epochs=nb_epoch,
                          validation_set=validation_data, **kwargs)
@@ -368,9 +368,9 @@ class _TrainableMixin:
     def evaluate(self, x, y=None, batch_size=32, featureset=None):
         est = self.get_estimator()
         from ..feature import FeatureSet
-        from ..feature.featureset import StreamingFeatureSet
+        from ..feature.featureset import HostDataset
         if featureset is None:
-            featureset = x if isinstance(x, (FeatureSet, StreamingFeatureSet)) \
+            featureset = x if isinstance(x, HostDataset) \
                 else FeatureSet.from_ndarrays(x, y)
         return est.evaluate(featureset, batch_size=batch_size)
 

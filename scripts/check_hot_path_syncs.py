@@ -1,54 +1,78 @@
 #!/usr/bin/env python
-"""Tier-1 lint: no blocking host↔device sync inside the per-batch loop
-bodies of Estimator's evaluate*/predict hot paths.
+"""Tier-1 lint: the data-plane and eval/predict hot paths must stay free of
+per-batch host↔device syncs and per-batch/per-record Python regressions.
 
-The async eval/predict redesign moved every per-batch ``float(...)`` /
-``np.asarray(...)`` sync out of ``estimator.py``'s dispatch loops: batches
-stream through the DeviceFeed, accumulation stays on device, and the pass
-drains with one ``jax.device_get`` AFTER the loop (module-level ``_drain*``
-helpers / ``metrics.compute_all``). A regression that reintroduces a
-per-batch sync re-serializes host decode with device compute — the exact
-stall this PR removed — and nothing functional breaks, so only a BENCH
-round would notice. This check fails the test run at collection time
-instead (``tests/test_hot_path_lint.py``).
+Three families of policed regressions, each of which re-serializes work the
+async redesigns deliberately overlapped — nothing functional breaks when
+they creep back in, so only a BENCH round would notice. This check fails
+the test run at collection time instead (``tests/test_hot_path_lint.py``).
 
-Scope: the loop bodies of ``Estimator.evaluate``, ``_evaluate_direct``,
-``_evaluate_direct_exact`` and ``predict`` in
-``analytics_zoo_tpu/estimator/estimator.py``. The synchronous fallbacks in
-``estimator/sync_eval.py`` are deliberately NOT policed — they exist to be
-the per-batch-sync parity reference.
+1. **Estimator dispatch loops** (``analytics_zoo_tpu/estimator/
+   estimator.py``: ``evaluate``/``_evaluate_direct``/
+   ``_evaluate_direct_exact``/``predict`` loop bodies): no blocking
+   ``float(...)``, ``np.asarray(...)``, ``jax.device_get(...)``,
+   ``.block_until_ready()`` — batches stream through the DeviceFeed,
+   accumulation stays on device, the pass drains once after the loop.
+   The synchronous fallbacks in ``estimator/sync_eval.py`` are
+   deliberately NOT policed — they exist to be the parity reference.
 
-Banned inside those loop bodies: ``float(...)``, ``np.asarray(...)`` /
-``numpy.asarray(...)``, ``jax.device_get(...)``, ``.block_until_ready()``.
-Post-loop drains and helpers called FROM the loop (``fetch`` behind the
-predict window) are fine — the lint looks at the literal loop body, which
-is also the honest boundary: a helper fetching K dispatches behind the
-frontier is pipelining, an inline sync is a stall.
+2. **FeatureSet batch staging** (``feature/featureset.py``):
+   ``FeatureSet._gather`` is the innermost per-batch hot function — no
+   device syncs, no per-record Python loops (it must stay a pure tree-map
+   of vectorized ``np.take`` gathers), and no ``np.asarray`` copies (the
+   zero-alloc redesign routes copies through ``np.take(..., out=...)``).
+   The lazy data plane's iterator cores are policed for device syncs too.
+
+3. **DeviceFeed eval adaptation** (``feature/device_feed.py``):
+   ``masked_eval_batches`` must not rebuild its ``np.arange`` mask per
+   batch (cached-mask fix), and the ``_produce`` producer loop must never
+   sync.
 """
 from __future__ import annotations
 
 import ast
 import os
 import sys
-from typing import List, Tuple
-
-HOT_FUNCS = ("evaluate", "_evaluate_direct", "_evaluate_direct_exact",
-             "predict")
+from typing import Dict, List, Optional, Sequence, Tuple
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ESTIMATOR_PY = os.path.join(_REPO, "analytics_zoo_tpu", "estimator",
                             "estimator.py")
+FEATURESET_PY = os.path.join(_REPO, "analytics_zoo_tpu", "feature",
+                             "featureset.py")
+DEVICE_FEED_PY = os.path.join(_REPO, "analytics_zoo_tpu", "feature",
+                              "device_feed.py")
+
+HOT_FUNCS = ("evaluate", "_evaluate_direct", "_evaluate_direct_exact",
+             "predict")
+
+#: policy rows: (path, class name or None for module level, function names,
+#: extra banned np.<attr> calls, ban per-record loops?, scope)
+#: scope "loops" = only loop bodies inside the function are policed;
+#: scope "body"  = the whole function body is policed (innermost hot funcs)
+_CHECKS: List[Tuple[str, Optional[str], Sequence[str], Sequence[str],
+                    bool, str]] = [
+    (ESTIMATOR_PY, "Estimator", HOT_FUNCS, (), False, "loops"),
+    (FEATURESET_PY, "FeatureSet", ("_gather",), ("asarray",), True, "body"),
+    (FEATURESET_PY, "LazyTransformFeatureSet",
+     ("train_iterator", "eval_iterator", "_transformed_batches",
+      "_cached_batches"), (), False, "loops"),
+    (DEVICE_FEED_PY, None, ("masked_eval_batches",), ("arange",), False,
+     "loops"),
+    (DEVICE_FEED_PY, None, ("_produce",), (), False, "loops"),
+]
 
 
-def _banned_call(node: ast.Call) -> str:
+def _banned_call(node: ast.Call, np_attrs: Sequence[str] = ("asarray",)
+                 ) -> str:
     f = node.func
     if isinstance(f, ast.Name) and f.id == "float":
         return "float()"
     if isinstance(f, ast.Attribute):
         base = f.value
-        if (f.attr == "asarray" and isinstance(base, ast.Name)
+        if (f.attr in np_attrs and isinstance(base, ast.Name)
                 and base.id in ("np", "numpy")):
-            return f"{base.id}.asarray()"
+            return f"{base.id}.{f.attr}()"
         if (f.attr == "device_get" and isinstance(base, ast.Name)
                 and base.id == "jax"):
             return "jax.device_get()"
@@ -57,29 +81,69 @@ def _banned_call(node: ast.Call) -> str:
     return ""
 
 
-def check(path: str = ESTIMATOR_PY) -> List[Tuple[str, int, str]]:
-    """Return (function, line, what) violations; empty means clean."""
+def _iter_functions(tree: ast.Module, cls: Optional[str],
+                    names: Sequence[str]):
+    if cls is None:
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef) and node.name in names:
+                yield node
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            for fn in node.body:
+                if isinstance(fn, ast.FunctionDef) and fn.name in names:
+                    yield fn
+
+
+def _scan_stmts(stmts, np_attrs, out, fn_name):
+    for stmt in stmts:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call):
+                what = _banned_call(sub, np_attrs)
+                if what:
+                    out.append((fn_name, sub.lineno, what))
+
+
+def _check_file(path: str, cls: Optional[str], names: Sequence[str],
+                extra_np: Sequence[str], ban_loops: bool, scope: str
+                ) -> List[Tuple[str, int, str]]:
     with open(path) as fh:
         tree = ast.parse(fh.read(), filename=path)
+    np_attrs = ("asarray",) + tuple(extra_np)
     violations: List[Tuple[str, int, str]] = []
-    for cls in ast.walk(tree):
-        if not (isinstance(cls, ast.ClassDef) and cls.name == "Estimator"):
+    for fn in _iter_functions(tree, cls, names):
+        if scope == "body":
+            _scan_stmts(fn.body, np_attrs, violations, fn.name)
+            if ban_loops:
+                for sub in ast.walk(fn):
+                    if isinstance(sub, (ast.For, ast.While, ast.AsyncFor,
+                                        ast.ListComp, ast.SetComp,
+                                        ast.DictComp, ast.GeneratorExp)):
+                        violations.append(
+                            (fn.name, sub.lineno, "per-record Python loop"))
             continue
-        for fn in cls.body:
-            if not (isinstance(fn, ast.FunctionDef)
-                    and fn.name in HOT_FUNCS):
+        for loop in ast.walk(fn):
+            if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
                 continue
-            for loop in ast.walk(fn):
-                if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
-                    continue
-                for stmt in loop.body + loop.orelse:
-                    for sub in ast.walk(stmt):
-                        if isinstance(sub, ast.Call):
-                            what = _banned_call(sub)
-                            if what:
-                                violations.append(
-                                    (fn.name, sub.lineno, what))
+            _scan_stmts(loop.body + loop.orelse, np_attrs, violations,
+                        fn.name)
     return violations
+
+
+def check(path: Optional[str] = None
+          ) -> List[Tuple[str, str, int, str]]:
+    """Return ``(file, function, line, what)`` violations; empty = clean.
+    With an explicit ``path`` only the Estimator dispatch-loop policy runs
+    against that file (self-test hook)."""
+    if path is not None:
+        return [(path, fn, line, what) for fn, line, what in
+                _check_file(path, "Estimator", HOT_FUNCS, (), False,
+                            "loops")]
+    out: List[Tuple[str, str, int, str]] = []
+    for (p, cls, names, extra_np, ban_loops, scope) in _CHECKS:
+        out.extend((p, fn, line, what) for fn, line, what in
+                   _check_file(p, cls, names, extra_np, ban_loops, scope))
+    return out
 
 
 def main() -> int:
@@ -87,10 +151,11 @@ def main() -> int:
     if not violations:
         print("hot-path sync lint: clean")
         return 0
-    for fn, line, what in violations:
-        print(f"{ESTIMATOR_PY}:{line}: blocking {what} inside the per-batch "
-              f"loop body of Estimator.{fn} — route the sync behind the "
-              f"dispatch frontier or drain after the loop", file=sys.stderr)
+    for path, fn, line, what in violations:
+        print(f"{path}:{line}: {what} inside the hot path of {fn} — "
+              f"route syncs behind the dispatch frontier / drain after "
+              f"the loop, and keep per-batch staging vectorized",
+              file=sys.stderr)
     return 1
 
 

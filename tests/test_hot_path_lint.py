@@ -1,9 +1,13 @@
-"""Tier-1 collection-time guard: the eval/predict hot paths must stay free
-of per-batch host↔device syncs (``scripts/check_hot_path_syncs.py``).
+"""Tier-1 collection-time guard: the estimator eval/predict dispatch loops
+AND the data-plane hot paths (``FeatureSet._gather``, the lazy-transform
+iterator cores, ``masked_eval_batches``, the DeviceFeed producer) must stay
+free of per-batch host↔device syncs, per-record Python, and per-batch mask
+re-allocation (``scripts/check_hot_path_syncs.py``).
 
 The lint runs at IMPORT (= pytest collection) so a reintroduced
-``float(...)``/``np.asarray(...)`` inside an ``evaluate*``/``predict``
-dispatch loop fails the suite even if no behavioral test notices the
+``float(...)``/``np.asarray(...)`` inside a dispatch loop — or a
+``np.arange`` rebuilt per eval batch, or a per-record loop inside the
+batch gather — fails the suite even if no behavioral test notices the
 restored stall."""
 import importlib.util
 import os
@@ -18,12 +22,23 @@ _spec.loader.exec_module(_lint)
 _violations = _lint.check()
 if _violations:  # collection-time failure, with the offending lines
     raise AssertionError(
-        "per-batch host sync reintroduced in estimator hot paths: "
-        + "; ".join(f"{fn}:{line} {what}" for fn, line, what in _violations))
+        "hot-path regression reintroduced: "
+        + "; ".join(f"{os.path.basename(f)}:{fn}:{line} {what}"
+                    for f, fn, line, what in _violations))
 
 
 def test_hot_paths_have_no_per_batch_syncs():
     assert _lint.check() == []
+
+
+def test_lint_covers_data_plane_files():
+    """The policy table must keep policing the data-plane files — a
+    refactor that drops them would silently shrink coverage."""
+    files = {os.path.basename(row[0]) for row in _lint._CHECKS}
+    assert {"estimator.py", "featureset.py", "device_feed.py"} <= files
+    funcs = {fn for row in _lint._CHECKS for fn in row[2]}
+    assert {"_gather", "masked_eval_batches", "_produce",
+            "evaluate", "predict"} <= funcs
 
 
 def test_lint_catches_a_seeded_sync(tmp_path):
@@ -38,4 +53,31 @@ def test_lint_catches_a_seeded_sync(tmp_path):
         "            a = np.asarray(v)\n"
         "        return a\n")
     found = _lint.check(str(bad))
-    assert {w for _, _, w in found} == {"float()", "np.asarray()"}
+    assert {w for _, _, _, w in found} == {"float()", "np.asarray()"}
+
+
+def test_lint_catches_seeded_data_plane_regressions(tmp_path):
+    """Seeded _gather per-record loop + per-batch arange must trip the new
+    data-plane rules."""
+    bad_fs = tmp_path / "featureset.py"
+    bad_fs.write_text(
+        "class FeatureSet:\n"
+        "    def _gather(self, idx):\n"
+        "        x = np.asarray(self.features[idx])\n"
+        "        rows = [self.features[i] for i in idx]\n"
+        "        return x, rows\n")
+    found = _lint._check_file(str(bad_fs), "FeatureSet", ("_gather",),
+                              ("asarray",), True, "body")
+    whats = {w for _, _, w in found}
+    assert "np.asarray()" in whats
+    assert "per-record Python loop" in whats
+
+    bad_df = tmp_path / "device_feed.py"
+    bad_df.write_text(
+        "def masked_eval_batches(it, batch_size):\n"
+        "    for x, y, valid in it:\n"
+        "        mask = (np.arange(batch_size) < valid)\n"
+        "        yield (x, y, mask), valid\n")
+    found = _lint._check_file(str(bad_df), None, ("masked_eval_batches",),
+                              ("arange",), False, "loops")
+    assert {w for _, _, w in found} == {"np.arange()"}

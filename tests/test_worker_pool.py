@@ -1,0 +1,377 @@
+"""Parallel host data plane: multiprocess shared-memory transform workers,
+lazy/streaming FeatureSet.transform, the one-shot memmap replay cache, and
+zero-alloc batch staging.
+
+The contract under test everywhere: every new execution tier (lazy loop /
+thread / mp, cached replay, staging rings) is BIT-IDENTICAL to the eager
+per-record loop — the parity reference — including padded eval tails; and
+the worker pool's lifecycle is airtight (errors surface in the consumer,
+shutdown leaves no live children and no leaked /dev/shm segments).
+"""
+import multiprocessing
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.config import global_config
+from analytics_zoo_tpu.feature import (
+    FeatureSet, HostDataset, Lambda, LazyTransformFeatureSet,
+    TransformWorkerError, TransformWorkerPool)
+from analytics_zoo_tpu.feature.preprocessing import BatchLambda
+
+
+def double_plus_head(r):
+    # shape-changing deterministic record transform: [d] -> [d + 1]
+    return np.concatenate([r * 2, r[:1] + 1]).astype(np.float32)
+
+
+def make_fs(n=20, d=4, shuffle=False, seed=0):
+    x = np.arange(n * d, dtype=np.float32).reshape(n, d)
+    y = np.arange(n, dtype=np.float32)
+    return FeatureSet.from_ndarrays(x, y, shuffle=shuffle, seed=seed)
+
+
+def batches_equal(a, b):
+    ax, bx = a[0], b[0]
+    if isinstance(ax, tuple):
+        if not all(np.array_equal(p, q) for p, q in zip(ax, bx)):
+            return False
+    elif not np.array_equal(np.asarray(ax), np.asarray(bx)):
+        return False
+    if (a[1] is None) != (b[1] is None):
+        return False
+    if a[1] is not None and not np.array_equal(np.asarray(a[1]),
+                                               np.asarray(b[1])):
+        return False
+    return list(a[2:]) == list(b[2:])
+
+
+class TestEagerTiers:
+    """transform(): loop (parity reference) vs thread vs mp vs batched."""
+
+    def test_thread_and_mp_match_loop(self, ctx):
+        p = Lambda(double_plus_head)
+        ref = make_fs().transform(p, mode="loop")
+        thr = make_fs().transform(p, num_workers=3, mode="thread")
+        mp_ = make_fs().transform(p, num_workers=2, mode="mp")
+        np.testing.assert_array_equal(np.asarray(ref.features),
+                                      np.asarray(thr.features))
+        np.testing.assert_array_equal(np.asarray(ref.features),
+                                      np.asarray(mp_.features))
+        assert np.asarray(ref.features).shape == (20, 5)
+
+    def test_mp_tuple_records(self, ctx):
+        x = (np.arange(16, dtype=np.float32).reshape(8, 2),
+             np.ones((8, 3), np.float32))
+        p = Lambda(lambda r: (r[0] * 2, r[1] + r[0][:1]))
+        ref = FeatureSet.from_ndarrays(x, shuffle=False).transform(
+            p, mode="loop")
+        mp_ = FeatureSet.from_ndarrays(x, shuffle=False).transform(
+            p, num_workers=2, mode="mp")
+        for a, b in zip(ref.features, mp_.features):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_eager_chunked_loop_still_reference(self, ctx):
+        # the chunked fill-into-preallocated-tree rewrite must equal a
+        # naive stack of per-record applications
+        p = Lambda(double_plus_head)
+        fs = make_fs(n=1030)  # > chunk size: exercises multiple chunks
+        got = np.asarray(fs.transform(p, mode="loop").features)
+        want = np.stack([double_plus_head(r) for r in
+                         np.arange(1030 * 4, dtype=np.float32
+                                   ).reshape(1030, 4)])
+        np.testing.assert_array_equal(got, want)
+
+    def test_mp_rejects_object_outputs(self, ctx):
+        fs = make_fs(n=4)
+        obj = Lambda(lambda r: np.asarray([None, r], dtype=object))
+        with pytest.raises(ValueError, match="numeric"):
+            fs.transform(obj, num_workers=2, mode="mp")
+
+
+class TestLazyParity:
+    """lazy=True engines vs the eager loop, train + padded eval tails."""
+
+    @pytest.mark.parametrize("mode,nw", [("loop", 0), ("thread", 3),
+                                         ("mp", 2)])
+    def test_eval_iterator_parity_with_padded_tail(self, ctx, mode, nw):
+        p = Lambda(double_plus_head)
+        ref = make_fs().transform(p, mode="loop")
+        lz = make_fs().transform(p, num_workers=nw, mode=mode, lazy=True)
+        assert isinstance(lz, LazyTransformFeatureSet)
+        assert isinstance(lz, HostDataset)
+        try:
+            for pad in (False, True):
+                got = [(np.asarray(x).copy(), None if y is None
+                        else np.asarray(y).copy(), v)
+                       for x, y, v in lz.eval_iterator(8, pad_remainder=pad)]
+                want = list(ref.eval_iterator(8, pad_remainder=pad))
+                assert len(got) == len(want)
+                assert all(batches_equal(g, w)
+                           for g, w in zip(got, want))
+        finally:
+            lz.close()
+
+    @pytest.mark.parametrize("mode,nw", [("loop", 0), ("mp", 2)])
+    def test_train_iterator_parity_same_rng_stream(self, ctx, mode, nw):
+        p = Lambda(double_plus_head)
+        ref = make_fs(shuffle=True, seed=7).transform(p, mode="loop")
+        lz = make_fs(shuffle=True, seed=7).transform(
+            p, num_workers=nw, mode=mode, lazy=True)
+        try:
+            ri, li = ref.train_iterator(8), lz.train_iterator(8)
+            for _ in range(5):  # crosses an epoch boundary (2 batches/epoch)
+                (rx, ry), (lx, ly) = next(ri), next(li)
+                np.testing.assert_array_equal(rx, np.asarray(lx))
+                np.testing.assert_array_equal(ry, np.asarray(ly))
+        finally:
+            lz.close()
+
+    def test_batched_transform_lazy_parity(self, ctx):
+        p = BatchLambda(lambda b: b * 3 + 1)
+        ref = make_fs().transform(p)
+        lz = make_fs().transform(p, lazy=True)
+        got = list(lz.eval_iterator(8, pad_remainder=True))
+        want = list(ref.eval_iterator(8, pad_remainder=True))
+        assert all(batches_equal(g, w) for g, w in zip(got, want))
+        assert lz.stats["engine"] == "batched"
+
+    def test_data_state_roundtrip_delegates(self, ctx):
+        lz = make_fs(shuffle=True, seed=3).transform(
+            Lambda(double_plus_head), mode="loop", lazy=True)
+        state = lz.data_state()
+        it = lz.train_iterator(8)
+        first = np.asarray(next(it)[0]).copy()
+        lz.set_data_state(state)  # rewind the shuffle RNG
+        it2 = lz.train_iterator(8)
+        np.testing.assert_array_equal(first, np.asarray(next(it2)[0]))
+
+
+class TestReplayCache:
+    def test_second_epoch_skips_transform(self, ctx, tmp_path):
+        calls = []
+
+        def counting(r):
+            calls.append(1)
+            return r * 3
+
+        lz = make_fs().transform(Lambda(counting), mode="loop", lazy=True,
+                                 cache=True, cache_dir=str(tmp_path))
+        first = [np.asarray(b[0]).copy() for b in lz.eval_iterator(8)]
+        after_first = len(calls)
+        assert after_first >= 20  # every record transformed once (+ probe)
+        second = [np.asarray(b[0]).copy() for b in lz.eval_iterator(8)]
+        assert len(calls) == after_first  # pure memmap replay
+        for a, b in zip(first, second):
+            np.testing.assert_array_equal(a, b)
+        # files actually live in the requested cache dir
+        assert any(f.endswith(".mmap") for f in os.listdir(tmp_path))
+
+    def test_cached_mp_parity(self, ctx, tmp_path):
+        p = Lambda(double_plus_head)
+        ref = make_fs().transform(p, mode="loop")
+        lz = make_fs().transform(p, num_workers=2, mode="mp", lazy=True,
+                                 cache=True, cache_dir=str(tmp_path))
+        try:
+            for _ in range(2):  # first pass fills, second replays
+                got = list(lz.eval_iterator(8, pad_remainder=True))
+                want = list(ref.eval_iterator(8, pad_remainder=True))
+                assert all(batches_equal(g, w)
+                           for g, w in zip(got, want))
+        finally:
+            lz.close()
+
+    def test_shuffled_train_fills_cache_incrementally(self, ctx):
+        p = Lambda(double_plus_head)
+        ref = make_fs(shuffle=True, seed=5).transform(p, mode="loop")
+        lz = make_fs(shuffle=True, seed=5).transform(p, mode="loop",
+                                                     lazy=True, cache=True)
+        ri, li = ref.train_iterator(5), lz.train_iterator(5)
+        for _ in range(9):  # > 2 epochs: replay epochs must stay identical
+            (rx, ry), (lx, ly) = next(ri), next(li)
+            np.testing.assert_array_equal(rx, np.asarray(lx))
+            np.testing.assert_array_equal(ry, np.asarray(ly))
+        assert lz._all_covered  # a full epoch covers every record
+
+
+class TestWorkerPoolLifecycle:
+    def test_error_in_worker_surfaces_in_consumer(self, ctx):
+        def explode_late(r):
+            if r[0] >= 40:  # record 10 of 20 — probe (record 0) succeeds
+                raise ValueError("transform exploded mid-stream")
+            return r * 2
+
+        lz = make_fs().transform(Lambda(explode_late), num_workers=2,
+                                 mode="mp", lazy=True)
+        try:
+            with pytest.raises(TransformWorkerError,
+                               match="exploded mid-stream"):
+                for _ in lz.eval_iterator(4):
+                    pass
+        finally:
+            lz.close()
+
+    def test_shutdown_leaves_no_children_or_shm(self, ctx):
+        from multiprocessing import shared_memory
+        lz = make_fs().transform(Lambda(double_plus_head), num_workers=2,
+                                 mode="mp", lazy=True)
+        it = lz.train_iterator(4)
+        next(it)
+        (pool,) = lz._all_pools
+        procs, names = list(pool._procs), [s.name for s in pool._shms]
+        assert any(p.is_alive() for p in procs)
+        it.close()  # interrupt mid-stream with tasks in flight
+        lz.close()
+        assert not any(p.is_alive() for p in procs)
+        for name in names:  # segment names must be gone from /dev/shm
+            with pytest.raises(FileNotFoundError):
+                shared_memory.SharedMemory(name=name)
+        ours = [p for p in multiprocessing.active_children()
+                if p.name == "zoo-transform-worker"]
+        assert ours == []
+
+    def test_concurrent_train_and_eval_streams_same_set(self, ctx):
+        """A train iterator suspended mid-epoch must not deadlock a
+        validation pass streaming the SAME lazy set (the mid-epoch
+        validation_trigger shape): the busy pool gets a forked sibling."""
+        p = Lambda(double_plus_head)
+        lz = make_fs().transform(p, num_workers=2, mode="mp", lazy=True)
+        try:
+            ti = lz.train_iterator(4)
+            t1 = np.asarray(next(ti)[0]).copy()  # stream 1 active, suspended
+            evals = [np.asarray(b[0]).copy()
+                     for b in lz.eval_iterator(4)]  # stream 2, same size
+            t2 = np.asarray(next(ti)[0]).copy()  # stream 1 resumes
+            want = [np.asarray(b[0]) for b in
+                    make_fs().transform(p, mode="loop").eval_iterator(4)]
+            for a, b in zip(evals, want):
+                np.testing.assert_array_equal(a, b)
+            np.testing.assert_array_equal(t1, want[0])  # shuffle=False
+            np.testing.assert_array_equal(t2, want[1])
+            assert len(lz._all_pools) == 2  # busy pool ⇒ fresh sibling
+        finally:
+            lz.close()
+
+    def test_pool_reusable_after_abandoned_iterator(self, ctx):
+        lz = make_fs().transform(Lambda(lambda r: r + 1), num_workers=2,
+                                 mode="mp", lazy=True)
+        try:
+            it = lz.train_iterator(4)
+            next(it)
+            it.close()  # slots still in flight
+            x, _, _ = next(lz.eval_iterator(4))  # drains, then reuses slots
+            np.testing.assert_array_equal(
+                np.asarray(x), np.arange(16, dtype=np.float32
+                                         ).reshape(4, 4) + 1)
+        finally:
+            lz.close()
+
+    def test_eager_transform_all_leaves_nothing_behind(self, ctx):
+        fs = make_fs().transform(Lambda(double_plus_head), num_workers=2,
+                                 mode="mp")
+        np.testing.assert_array_equal(
+            np.asarray(fs.features)[0],
+            double_plus_head(np.arange(4, dtype=np.float32)))
+        ours = [p for p in multiprocessing.active_children()
+                if p.name == "zoo-transform-worker"]
+        assert ours == []
+
+
+class TestZeroAllocStaging:
+    def test_gather_out_buffers_are_reused_and_correct(self, ctx):
+        cfg = global_config()
+        cfg.set("data.staging_slots", 4)
+        try:
+            fs = make_fs(n=40, shuffle=False)
+            it = fs.train_iterator(5)
+            seen = [next(it) for _ in range(4)]
+            ids = [id(x) for x, _ in seen]
+            assert len(set(ids)) == 4  # distinct ring entries...
+            x5, _ = next(it)
+            assert id(x5) == ids[0]  # ...then the ring wraps
+            np.testing.assert_array_equal(
+                x5, np.arange(80, 100, dtype=np.float32).reshape(5, 4))
+        finally:
+            cfg.unset("data.staging_slots")
+
+    def test_staging_parity_with_fresh_alloc(self, ctx):
+        cfg = global_config()
+        fs1 = make_fs(n=40, shuffle=True, seed=11)
+        plain = [np.asarray(x).copy() for (x, _), _ in
+                 zip(fs1.train_iterator(8), range(10))]
+        cfg.set("data.staging_slots", 4)
+        try:
+            fs2 = make_fs(n=40, shuffle=True, seed=11)
+            ring = [np.asarray(x).copy() for (x, _), _ in
+                    zip(fs2.train_iterator(8), range(10))]
+        finally:
+            cfg.unset("data.staging_slots")
+        for a, b in zip(plain, ring):
+            np.testing.assert_array_equal(a, b)
+
+    def test_masked_eval_batches_reuses_full_mask(self, ctx):
+        from analytics_zoo_tpu.feature.device_feed import masked_eval_batches
+        fs = make_fs(n=20, shuffle=False)
+        items = list(masked_eval_batches(
+            fs.eval_iterator(8, pad_remainder=True), 8))
+        masks = [m for (_, _, m), _ in items]
+        valids = [v for _, v in items]
+        assert valids == [8, 8, 4]
+        assert masks[0] is masks[1]  # full-batch mask allocated once
+        np.testing.assert_array_equal(masks[2],
+                                      (np.arange(8) < 4).astype(np.float32))
+
+
+class TestEstimatorWireThrough:
+    """Lazy/mp sets flow through Estimator.train/evaluate end to end."""
+
+    def _estimator(self):
+        from analytics_zoo_tpu.estimator import Estimator
+        from analytics_zoo_tpu.keras import Sequential, objectives, optimizers
+        from analytics_zoo_tpu.keras.layers import Dense
+        return Estimator(
+            model=Sequential([Dense(8, activation="relu", name="a"),
+                              Dense(2, name="b")]),
+            loss_fn=objectives.get("sparse_categorical_crossentropy"),
+            optimizer=optimizers.SGD(0.05), metrics=["accuracy"])
+
+    def test_train_and_evaluate_on_lazy_loop_set(self, ctx):
+        rs = np.random.RandomState(0)
+        x = rs.rand(64, 6).astype(np.float32)
+        y = (x.sum(1) > 3).astype(np.float32)
+        p = Lambda(lambda r: (r - 0.5).astype(np.float32))
+        ref = FeatureSet.from_ndarrays(x, y, shuffle=True, seed=1
+                                       ).transform(p, mode="loop")
+        lz = FeatureSet.from_ndarrays(x, y, shuffle=True, seed=1
+                                      ).transform(p, mode="loop", lazy=True)
+        e1, e2 = self._estimator(), self._estimator()
+        out1 = e1.train(ref, batch_size=16, epochs=2)
+        out2 = e2.train(lz, batch_size=16, epochs=2)
+        assert out1["iterations"] == out2["iterations"] == 8
+        # identical data order + identical init seed ⇒ identical history
+        np.testing.assert_allclose(out1["loss_history"],
+                                   out2["loss_history"], rtol=1e-6)
+        r1 = e1.evaluate(ref, batch_size=16)
+        r2 = e2.evaluate(lz, batch_size=16)
+        assert r1 == r2
+
+    def test_train_on_mp_set_runs_and_shuts_down(self, ctx):
+        rs = np.random.RandomState(1)
+        x = rs.rand(64, 6).astype(np.float32)
+        y = (x.sum(1) > 3).astype(np.float32)
+        lz = FeatureSet.from_ndarrays(x, y, shuffle=True, seed=2).transform(
+            Lambda(lambda r: (r * 2).astype(np.float32)),
+            num_workers=2, mode="mp", lazy=True)
+        try:
+            est = self._estimator()
+            out = est.train(lz, batch_size=16, epochs=2)
+            assert out["iterations"] == 8
+            assert np.isfinite(out["loss_history"]).all()
+            scores = est.evaluate(lz, batch_size=16)
+            assert 0.0 <= scores["accuracy"] <= 1.0
+        finally:
+            lz.close()
+        ours = [p for p in multiprocessing.active_children()
+                if p.name == "zoo-transform-worker"]
+        assert ours == []
