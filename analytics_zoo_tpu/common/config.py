@@ -115,6 +115,40 @@ _global_config.register("failure.retry_times", 5,
 _global_config.register("failure.retry_interval_s", 120.0,
                         "Window seconds for retry budget reset "
                         "(reference: bigdl.failure.retryTimeInterval).")
+_global_config.register("failure.io_retries", 3,
+                        "Retries for transient remote file_io failures "
+                        "(exponential backoff; local paths never retry).")
+_global_config.register("failure.io_backoff_s", 0.05,
+                        "Base backoff seconds for remote IO retries "
+                        "(doubles per attempt).")
+_global_config.register("checkpoint.keep", 5,
+                        "Snapshots retained per checkpoint dir (older ones "
+                        "pruned after each successful write; >= 2 keeps a "
+                        "fallback candidate for torn-newest recovery; "
+                        "0 = unlimited).")
+_global_config.register("checkpoint.verify", True,
+                        "Verify the per-snapshot checksum manifest on "
+                        "restore; a mismatch raises CheckpointCorruptError "
+                        "and elastic restores fall back to the next-older "
+                        "valid snapshot.")
+_global_config.register("faults.plan", "",
+                        "Fault-injection schedule: 'site:N' fires on the "
+                        "N-th call, 'site:0.1' with probability 0.1, "
+                        "'@B' suffix sets the budget (default 1); "
+                        "comma-separated. '' = injection disabled.")
+_global_config.register("faults.seed", 0,
+                        "Seed for probabilistic fault-injection draws "
+                        "(per-site streams are derived deterministically).")
+_global_config.register("data.task_retries", 0,
+                        "Times a failed transform-worker task is retried "
+                        "before TransformWorkerError surfaces (transient "
+                        "per-task faults: flaky decode/remote reads).")
+_global_config.register("data.worker_respawns", 2,
+                        "Respawn budget for transform workers that die "
+                        "mid-task (SIGKILL/OOM): the pool forks a "
+                        "replacement and resubmits the lost task; once "
+                        "exhausted the consumer gets TransformWorkerError "
+                        "promptly instead of hanging.")
 _global_config.register("version_check", False,
                         "Warn on jax/libtpu version mismatches at context init "
                         "(reference: spark.analytics.zoo.versionCheck).")

@@ -23,14 +23,57 @@ caches, not durable artifacts.
 from __future__ import annotations
 
 import contextlib
+import logging
 import os
 import posixpath
 import re
 import shutil
 import tempfile
+import time
 from typing import Dict, Iterator, List, Optional
 
+from . import faults
+
 _SCHEME_RE = re.compile(r"^([a-zA-Z][a-zA-Z0-9+.-]*)://")
+
+logger = logging.getLogger(__name__)
+
+
+def _retryable(e: BaseException) -> bool:
+    """Transient-failure policy for remote backends: network/backend
+    OSErrors (and injected faults, which subclass OSError) retry;
+    deterministic filesystem answers must surface immediately — retrying
+    a FileNotFoundError just turns a clear error into a slow one."""
+    if isinstance(e, (FileNotFoundError, FileExistsError, IsADirectoryError,
+                      NotADirectoryError, PermissionError)):
+        return False
+    return isinstance(e, (OSError, TimeoutError))
+
+
+def _remote_op(op: str, path: str, fn):
+    """Run one remote-filesystem operation behind the ``io.remote`` fault
+    site and the transient-failure retry policy (``failure.io_retries``
+    attempts with ``failure.io_backoff_s`` exponential backoff). Local
+    paths never come through here — posix calls keep posix semantics."""
+    from .config import global_config
+    cfg = global_config()
+    retries = int(cfg.get("failure.io_retries") or 0)
+    backoff = float(cfg.get("failure.io_backoff_s") or 0.0)
+    attempt = 0
+    while True:
+        try:
+            faults.inject("io.remote")
+            return fn()
+        except BaseException as e:
+            if not _retryable(e) or attempt >= retries:
+                raise
+            delay = backoff * (2 ** attempt)
+            logger.warning(
+                "transient remote IO failure in %s(%r) (attempt %d/%d, "
+                "retrying in %.2fs): %r", op, path, attempt + 1, retries,
+                delay, e)
+            time.sleep(delay)
+            attempt += 1
 
 # scheme -> filesystem object with the fsspec AbstractFileSystem surface
 # (open/exists/isdir/ls/makedirs/rm/mv). Checked before fsspec so tests can
@@ -103,7 +146,7 @@ def fopen(path: str, mode: str = "r", encoding: Optional[str] = None,
     # EXISTING remote object would silently truncate or raise depending on
     # the backend, so fail loudly instead of guessing.
     if "a" in mode:
-        if fs.exists(str(path)):
+        if _remote_op("exists", path, lambda: fs.exists(str(path))):
             raise ValueError(
                 f"append mode is not supported on existing remote objects "
                 f"({path!r}): object stores cannot append — write a new "
@@ -112,7 +155,8 @@ def fopen(path: str, mode: str = "r", encoding: Optional[str] = None,
     # NOTE durability contract: buffered remote writes commit at close(), not
     # at flush() — a crash before close loses the object. Writers that must
     # survive crashes (SummaryWriter event files) write unique per-open files.
-    return fs.open(str(path), mode, **text_kw)
+    return _remote_op("open", path,
+                      lambda: fs.open(str(path), mode, **text_kw))
 
 
 _warned_non_exclusive: set = set()
@@ -136,7 +180,8 @@ def create_exclusive(path: str, data: bytes = b"") -> None:
         return
     fs = _fs(path)
     try:
-        f = fs.open(str(path), "xb")
+        f = _remote_op("create_exclusive", path,
+                       lambda: fs.open(str(path), "xb"))
     except FileExistsError:
         raise
     except (ValueError, NotImplementedError):
@@ -153,9 +198,9 @@ def create_exclusive(path: str, data: bytes = b"") -> None:
             logging.getLogger(__name__).warning(
                 "backend for %s lacks exclusive-create; claim markers "
                 "degrade to a non-atomic exists-check + write", scheme)
-        if fs.exists(str(path)):
+        if _remote_op("exists", path, lambda: fs.exists(str(path))):
             raise FileExistsError(path)
-        f = fs.open(str(path), "wb")
+        f = _remote_op("open", path, lambda: fs.open(str(path), "wb"))
     with f:
         f.write(data)
 
@@ -163,13 +208,15 @@ def create_exclusive(path: str, data: bytes = b"") -> None:
 def exists(path: str) -> bool:
     if not is_remote(path):
         return os.path.exists(local_path(path))
-    return bool(_fs(path).exists(str(path)))
+    return bool(_remote_op("exists", path,
+                           lambda: _fs(path).exists(str(path))))
 
 
 def isdir(path: str) -> bool:
     if not is_remote(path):
         return os.path.isdir(local_path(path))
-    return bool(_fs(path).isdir(str(path)))
+    return bool(_remote_op("isdir", path,
+                           lambda: _fs(path).isdir(str(path))))
 
 
 def listdir(path: str, refresh: bool = False) -> List[str]:
@@ -185,8 +232,13 @@ def listdir(path: str, refresh: bool = False) -> List[str]:
             fs.invalidate_cache(str(path))
         except Exception:
             pass  # backend without a listing cache
-    names = fs.ls(str(path), detail=False, refresh=True) \
-        if refresh and _accepts_refresh(fs) else fs.ls(str(path), detail=False)
+    if refresh and _accepts_refresh(fs):
+        names = _remote_op("listdir", path,
+                           lambda: fs.ls(str(path), detail=False,
+                                         refresh=True))
+    else:
+        names = _remote_op("listdir", path,
+                           lambda: fs.ls(str(path), detail=False))
     return [posixpath.basename(str(n).rstrip("/")) for n in names]
 
 
@@ -204,7 +256,8 @@ def makedirs(path: str, exist_ok: bool = True) -> None:
         return
     # object stores have no real directories; best-effort for stores that do
     try:
-        _fs(path).makedirs(str(path), exist_ok=exist_ok)
+        _remote_op("makedirs", path,
+                   lambda: _fs(path).makedirs(str(path), exist_ok=exist_ok))
     except FileExistsError:
         if not exist_ok:
             raise
@@ -214,14 +267,15 @@ def remove(path: str) -> None:
     if not is_remote(path):
         os.remove(local_path(path))
         return
-    _fs(path).rm_file(str(path))
+    _remote_op("remove", path, lambda: _fs(path).rm_file(str(path)))
 
 
 def rmtree(path: str) -> None:
     if not is_remote(path):
         shutil.rmtree(local_path(path))
         return
-    _fs(path).rm(str(path), recursive=True)
+    _remote_op("rmtree", path,
+               lambda: _fs(path).rm(str(path), recursive=True))
 
 
 def replace(src: str, dst: str) -> None:
@@ -234,10 +288,16 @@ def replace(src: str, dst: str) -> None:
     if scheme_of(src) != scheme_of(dst):
         raise ValueError(f"cross-scheme replace: {src!r} -> {dst!r}")
     fs = _fs(src)
-    # fsspec mv() refuses to clobber on some backends; drop the target first
-    if fs.exists(str(dst)):
-        fs.rm_file(str(dst))
-    fs.mv(str(src), str(dst))
+
+    def mv():
+        # fsspec mv() refuses to clobber on some backends; drop the target
+        # first (re-running after a transient failure re-checks, so a
+        # half-done rm+mv attempt resumes cleanly)
+        if fs.exists(str(dst)):
+            fs.rm_file(str(dst))
+        fs.mv(str(src), str(dst))
+
+    _remote_op("replace", src, mv)
 
 
 def put_tree(local_dir: str, remote_dir: str) -> None:
@@ -253,9 +313,14 @@ def put_tree(local_dir: str, remote_dir: str) -> None:
         for name in files:
             dst = (join(remote_dir, name) if rel == "." else
                    join(remote_dir, rel.replace(os.sep, "/"), name))
-            with open(os.path.join(root, name), "rb") as src, \
-                    fs.open(dst, "wb") as out:
-                shutil.copyfileobj(src, out)
+
+            def upload(src_path=os.path.join(root, name), dst=dst):
+                # whole-file op: a retry after a mid-copy failure restarts
+                # the object from byte 0 (object stores have no partials)
+                with open(src_path, "rb") as src, fs.open(dst, "wb") as out:
+                    shutil.copyfileobj(src, out)
+
+            _remote_op("put", dst, upload)
 
 
 def get_tree(remote_dir: str, local_dir: str) -> None:
@@ -268,13 +333,18 @@ def get_tree(remote_dir: str, local_dir: str) -> None:
     # way the filesystem does so the relative part lines up
     strip = getattr(fs, "_strip_protocol", lambda p: p)
     base = str(strip(str(remote_dir))).rstrip("/")
-    for src in fs.find(str(remote_dir)):
+    for src in _remote_op("find", remote_dir,
+                          lambda: list(fs.find(str(remote_dir)))):
         src = str(src)
         rel = src[len(base):].lstrip("/")
         dst = os.path.join(local_dir, *rel.split("/"))
         os.makedirs(os.path.dirname(dst), exist_ok=True)
-        with fs.open(src, "rb") as f, open(dst, "wb") as out:
-            shutil.copyfileobj(f, out)
+
+        def download(src=src, dst=dst):
+            with fs.open(src, "rb") as f, open(dst, "wb") as out:
+                shutil.copyfileobj(f, out)
+
+        _remote_op("get", src, download)
 
 
 @contextlib.contextmanager
@@ -297,9 +367,13 @@ def localized(path: str, mode: str = "r") -> Iterator[str]:
                 yield tmp
             else:
                 dst = os.path.join(tmp, posixpath.basename(str(path)))
-                with _fs(path).open(str(path), "rb") as f, \
-                        open(dst, "wb") as out:
-                    shutil.copyfileobj(f, out)
+
+                def download():
+                    with _fs(path).open(str(path), "rb") as f, \
+                            open(dst, "wb") as out:
+                        shutil.copyfileobj(f, out)
+
+                _remote_op("get", path, download)
                 yield dst
         elif mode == "w":
             yield tmp

@@ -1,1 +1,2 @@
-from .estimator import Estimator  # noqa: F401
+from .estimator import (CheckpointCorruptError, Estimator,  # noqa: F401
+                        PreemptedError)

@@ -27,10 +27,13 @@ jitted train step over a device mesh:
 """
 from __future__ import annotations
 
+import json
 import logging
 import os
+import signal
 import threading
 import time
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -38,7 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ..common import file_io
+from ..common import faults, file_io
 from ..common.config import global_config
 from ..common.context import get_context
 from ..common.triggers import EveryEpoch, MaxEpoch, TrainingState, Trigger
@@ -50,6 +53,81 @@ from ..keras import metrics as metrics_mod
 from ..keras.optimizers import Optimizer
 from ..parallel.mesh import param_sharding, replicated, shard_batch
 from ..utils.tensorboard import SummaryWriter
+
+
+class CheckpointCorruptError(ValueError):
+    """A snapshot failed checksum-manifest verification (torn write,
+    bit-rot, tampering). The elastic restore path treats it as 'skip this
+    snapshot and fall back to the next-older valid one'."""
+
+
+class PreemptedError(RuntimeError):
+    """Training stopped on a preemption notice (SIGTERM / the
+    ``train.preempt`` fault site). A final snapshot and a resumable marker
+    were written first when a checkpoint dir is configured; ``snapshot``
+    carries its path (or ``None``)."""
+
+    def __init__(self, message: str, snapshot: Optional[str] = None):
+        super().__init__(message)
+        self.snapshot = snapshot
+
+
+#: resumable-preemption marker filename, written next to the snapshots
+PREEMPT_MARKER = "PREEMPTED.json"
+
+#: per-snapshot checksum manifest filename (inside each snapshot dir)
+_MANIFEST_NAME = "zoo_manifest.json"
+
+
+def _dir_checksums(local_dir: str) -> Dict[str, List[int]]:
+    """``{relpath: [size, crc32]}`` for every file under ``local_dir``
+    except the manifest itself. crc32 (not a cryptographic hash) on
+    purpose: the threat model is torn writes and bit-rot, not an
+    adversary, and restore-time verification must stay cheap next to the
+    orbax read it guards."""
+    entries: Dict[str, List[int]] = {}
+    for root, _dirs, files in os.walk(local_dir):
+        for name in sorted(files):
+            if name == _MANIFEST_NAME:
+                continue
+            p = os.path.join(root, name)
+            rel = os.path.relpath(p, local_dir).replace(os.sep, "/")
+            crc, size = 0, 0
+            with open(p, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    crc = zlib.crc32(chunk, crc)
+                    size += len(chunk)
+            entries[rel] = [size, crc]
+    return entries
+
+
+def _write_manifest(local_dir: str) -> None:
+    with open(os.path.join(local_dir, _MANIFEST_NAME), "w") as f:
+        json.dump({"version": 1, "files": _dir_checksums(local_dir)}, f)
+
+
+def _verify_manifest(local_dir: str, origin: str) -> bool:
+    """Verify ``local_dir`` against its checksum manifest. Returns False
+    for pre-manifest snapshots (nothing to verify — legacy tolerance);
+    raises :class:`CheckpointCorruptError` on any size/checksum mismatch,
+    missing file, or unexpected extra file."""
+    mpath = os.path.join(local_dir, _MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return False
+    with open(mpath) as f:
+        manifest = json.load(f)
+    want = {k: tuple(v) for k, v in manifest.get("files", {}).items()}
+    have = {k: tuple(v) for k, v in _dir_checksums(local_dir).items()}
+    if want != have:
+        missing = sorted(set(want) - set(have))
+        extra = sorted(set(have) - set(want))
+        corrupt = sorted(k for k in set(want) & set(have)
+                         if want[k] != have[k])
+        raise CheckpointCorruptError(
+            f"checkpoint at {origin} failed checksum verification — torn "
+            f"or corrupt snapshot (missing={missing[:4]}, "
+            f"corrupt={corrupt[:4]}, unexpected={extra[:4]})")
+    return True
 
 
 class _AsyncSnapshotWriter:
@@ -231,6 +309,7 @@ class Estimator:
         self._ckpt_writer = _AsyncSnapshotWriter()
         self._train_writer: Optional[SummaryWriter] = None
         self._val_writer: Optional[SummaryWriter] = None
+        self._preempt_requested = False
 
     # -- configuration (reference KerasNet setters, Topology.scala:111-127) ---
 
@@ -429,6 +508,105 @@ class Estimator:
               validation_trigger: Optional[Trigger] = None,
               checkpoint_trigger: Optional[Trigger] = None,
               steps_per_dispatch: int = 1) -> Dict[str, Any]:
+        """Train with preemption protection: a SIGTERM during this call
+        (the TPU preemption notice — seconds of warning) stops at the next
+        step boundary, fences the async checkpoint writer, writes a final
+        snapshot plus a ``PREEMPTED.json`` resumable marker, and raises
+        :class:`PreemptedError`. A leftover marker from a previous
+        preempted run is consumed (removed) here — resuming is
+        ``load_checkpoint(latest)`` + ``train()`` as usual. See
+        :meth:`_train_impl` for the loop semantics."""
+        self._preempt_requested = False
+        restore_handler = self._install_preemption_handler()
+        try:
+            if self._ckpt_dir:
+                marker = file_io.join(self._ckpt_dir, PREEMPT_MARKER)
+                if file_io.exists(marker):
+                    file_io.remove(marker)
+            return self._train_impl(
+                train_set, batch_size, epochs=epochs,
+                end_trigger=end_trigger, validation_set=validation_set,
+                validation_trigger=validation_trigger,
+                checkpoint_trigger=checkpoint_trigger,
+                steps_per_dispatch=steps_per_dispatch)
+        finally:
+            restore_handler()
+
+    def _install_preemption_handler(self):
+        """Install the SIGTERM→preempt-flag handler for the duration of a
+        train() call; returns the undo callable. Signals only land on the
+        main thread — a train() driven from a worker thread (pod tests,
+        notebooks) keeps whatever handler the host process installed."""
+        if threading.current_thread() is not threading.main_thread():
+            return lambda: None
+        try:
+            prev = signal.signal(signal.SIGTERM, self._on_sigterm)
+        except ValueError:  # embedded interpreters without signal support
+            return lambda: None
+        return lambda: signal.signal(signal.SIGTERM, prev)
+
+    def _on_sigterm(self, signum, frame) -> None:
+        logger.warning(
+            "SIGTERM: preemption requested — will write a final snapshot "
+            "and a resumable marker at the next step boundary")
+        self._preempt_requested = True
+
+    @staticmethod
+    def preemption_marker(ckpt_dir: str) -> Optional[Dict[str, Any]]:
+        """Read a checkpoint dir's resumable-preemption marker (``None``
+        when the last run was not preempted)."""
+        path = file_io.join(ckpt_dir, PREEMPT_MARKER)
+        if not file_io.exists(path):
+            return None
+        with file_io.fopen(path) as f:
+            return json.load(f)
+
+    def _finalize_preemption(self, history: List[float],
+                             pending: List[Any]) -> None:
+        """The preempt flag is set and the step loop has stopped: drain
+        what the device still owes, fence the writer, publish a final
+        snapshot + marker, and surface :class:`PreemptedError`."""
+        try:
+            history.extend(_flat_losses(jax.device_get(pending)))
+        except Exception:
+            logger.exception(
+                "async step failure surfaced while draining losses during "
+                "preemption; the final snapshot still reflects the last "
+                "good params")
+        pending.clear()
+        snap = None
+        if self._ckpt_dir:
+            try:
+                self._ckpt_writer.wait()
+            except RuntimeError:
+                logger.exception(
+                    "background checkpoint write had failed; the "
+                    "preemption snapshot below replaces it")
+            snap = file_io.join(self._ckpt_dir,
+                                f"snapshot-{self.global_step}")
+            self._write_snapshot(snap, self._snapshot_tree())
+            with file_io.fopen(file_io.join(self._ckpt_dir, PREEMPT_MARKER),
+                               "w") as f:
+                json.dump({"global_step": self.global_step,
+                           "epoch": self.epoch,
+                           "snapshot": f"snapshot-{self.global_step}",
+                           "resumable": True}, f)
+        if self._train_writer is not None:
+            self._train_writer.flush()
+            self._val_writer.flush()
+        raise PreemptedError(
+            f"training preempted (SIGTERM) at step {self.global_step}"
+            + (f"; resume from {snap}" if snap
+               else "; no checkpoint dir configured — progress lost"),
+            snapshot=snap)
+
+    def _train_impl(self, train_set: FeatureSet, batch_size: int,
+                    epochs: Optional[int] = None,
+                    end_trigger: Optional[Trigger] = None,
+                    validation_set: Optional[FeatureSet] = None,
+                    validation_trigger: Optional[Trigger] = None,
+                    checkpoint_trigger: Optional[Trigger] = None,
+                    steps_per_dispatch: int = 1) -> Dict[str, Any]:
         """``steps_per_dispatch > 1`` runs K train steps per device dispatch
         (host stacks K batches, the device scans over them): trigger checks,
         per-step TB scalars and loss syncs then happen every K steps —
@@ -529,6 +707,10 @@ class Estimator:
             self._epoch_offset = epoch_iter
             try:
                 for x, y in feed:
+                    # chaos site: a firing injection models a chip/tunnel
+                    # failure at step dispatch — caught by the elastic
+                    # retry below exactly like a real one
+                    faults.inject("train.step")
                     step_start = time.perf_counter()
                     if group > 1:
                         g = jax.tree_util.tree_leaves(x)[0].shape[0]
@@ -606,7 +788,10 @@ class Estimator:
                                 self._val_writer.add_scalar(k, v, self.global_step)
                     if self._ckpt_dir and checkpoint_trigger(state):
                         self._save_snapshot()
-                    if state.epoch_finished or end_trigger(state):
+                    if faults.inject("train.preempt"):
+                        self._preempt_requested = True
+                    if (self._preempt_requested or state.epoch_finished
+                            or end_trigger(state)):
                         break
                 if not state.epoch_finished and not end_trigger(state):
                     # featureset exhausted mid-epoch (shouldn't happen: endless)
@@ -619,12 +804,6 @@ class Estimator:
                     retries_left = retry_budget  # sparse failures reset budget
                 last_failure = now
                 retries_left -= 1
-                if retries_left < 0 or not self._ckpt_dir or \
-                        not self._latest_snapshot():
-                    raise
-                logger.exception(
-                    "training step failed; resuming from checkpoint "
-                    "(%d retries left)", retries_left)
                 pending.clear()  # discard losses from the failed dispatch
                 try:
                     # drain a failed BACKGROUND write separately: it must not
@@ -636,7 +815,29 @@ class Estimator:
                     logger.exception(
                         "background checkpoint write had failed; retrying "
                         "from the newest intact snapshot anyway")
-                self.load_checkpoint(self._latest_snapshot())
+                if retries_left < 0 or not self._snapshot_candidates():
+                    # budget exhausted (or nothing to restore from):
+                    # surface the error — but restore the newest VALID
+                    # snapshot first, so the estimator's params are a
+                    # known-good state the caller can still save/serve
+                    if self._restore_latest_valid() is not None:
+                        logger.error(
+                            "retry budget exhausted after %d attempts; "
+                            "params restored to the newest valid snapshot "
+                            "(step %d) before surfacing the failure",
+                            retry_budget + 1, self.global_step)
+                    raise
+                logger.exception(
+                    "training step failed; resuming from checkpoint "
+                    "(%d retries left)", retries_left)
+                # a torn/corrupt NEWEST snapshot must not kill the retry:
+                # fall back past checksum-invalid snapshots to the newest
+                # valid one
+                if self._restore_latest_valid() is None:
+                    logger.error(
+                        "no restorable snapshot survived validation; "
+                        "surfacing the original step failure")
+                    raise
                 state.epoch = self.epoch
                 state.iteration = self.global_step
                 continue
@@ -644,6 +845,8 @@ class Estimator:
                 # epochs usually end by `break` with the feed still mid-epoch;
                 # stop its producer thread and release prefetched device batches
                 feed.close()
+            if self._preempt_requested:
+                self._finalize_preemption(history, pending)
             state.epoch_finished = False
 
         if pending:
@@ -653,11 +856,11 @@ class Estimator:
             try:
                 history.extend(_flat_losses(jax.device_get(pending)))
             except Exception:
-                if self._ckpt_dir and self._latest_snapshot():
+                if self._ckpt_dir and self._snapshot_candidates():
                     logger.exception(
                         "trailing training step failed; restoring newest "
-                        "checkpoint before surfacing the error")
-                    self.load_checkpoint(self._latest_snapshot())
+                        "valid checkpoint before surfacing the error")
+                    self._restore_latest_valid()
                 raise
             finally:
                 pending.clear()
@@ -1021,21 +1224,79 @@ class Estimator:
         leaves the previous snapshot intact; multi-process saves rely on
         orbax's own collective commit protocol, and remote URIs upload via
         a staging dir WITHOUT an atomic publish (object stores can't
-        rename atomically) — a torn remote snapshot is possible on crash
-        and surfaces as a structure-validation error at restore."""
+        rename atomically) — a crash can tear a remote snapshot, which the
+        per-snapshot checksum manifest catches at restore, falling back to
+        the next-older valid snapshot. Retention pruning
+        (``checkpoint.keep``) runs after each publish on the writer
+        thread."""
         path = file_io.join(self._ckpt_dir, f"snapshot-{self.global_step}")
         tree = self._snapshot_tree()  # device fetch, synchronous
-        self._ckpt_writer.submit(lambda: self._write_snapshot(path, tree))
+
+        def write_then_prune():
+            self._write_snapshot(path, tree)
+            self._prune_snapshots()
+
+        self._ckpt_writer.submit(write_then_prune)
+
+    def _snapshot_candidates(self) -> List[Tuple[int, str]]:
+        """``(step, path)`` for every published snapshot, ascending by
+        step. Only names of the exact ``snapshot-<int>`` form qualify:
+        ``.writing`` staging dirs are excluded by a real suffix check (a
+        substring test would also hide a valid snapshot whose path merely
+        CONTAINS '.writing'), and entries whose step suffix is not an
+        integer — foreign dirs, editor droppings — are skipped instead of
+        crashing the restore path."""
+        if not self._ckpt_dir or not file_io.isdir(self._ckpt_dir):
+            return []
+        out: List[Tuple[int, str]] = []
+        for d in file_io.listdir(self._ckpt_dir):
+            if not d.startswith("snapshot-") or d.endswith(".writing"):
+                continue
+            try:
+                step = int(d[len("snapshot-"):])
+            except ValueError:
+                continue
+            out.append((step, file_io.join(self._ckpt_dir, d)))
+        out.sort()
+        return out
 
     def _latest_snapshot(self) -> Optional[str]:
-        if not self._ckpt_dir or not file_io.isdir(self._ckpt_dir):
-            return None
-        snaps = [d for d in file_io.listdir(self._ckpt_dir)
-                 if d.startswith("snapshot-") and ".writing" not in d]
-        if not snaps:
-            return None
-        newest = max(snaps, key=lambda s: int(s.split("-")[1]))
-        return file_io.join(self._ckpt_dir, newest)
+        cands = self._snapshot_candidates()
+        return cands[-1][1] if cands else None
+
+    def _restore_latest_valid(self) -> Optional[str]:
+        """Restore the newest snapshot that passes checksum-manifest and
+        structure validation, transparently falling back past torn or
+        corrupt newer ones. Returns the restored path, or ``None`` when no
+        snapshot survives."""
+        for _step, path in reversed(self._snapshot_candidates()):
+            try:
+                self.load_checkpoint(path)
+                return path
+            except Exception:
+                logger.exception(
+                    "snapshot %s failed to restore; falling back to the "
+                    "next older snapshot", path)
+        return None
+
+    def _prune_snapshots(self) -> None:
+        """Retention: keep the newest ``checkpoint.keep`` snapshots (the
+        fallback candidates torn-newest recovery needs) and delete the
+        rest — bounded disk growth without giving up elasticity. Runs on
+        the writer thread after each successful publish; multi-process
+        pods prune on process 0 only (the dir is shared)."""
+        keep = int(global_config().get("checkpoint.keep") or 0)
+        if keep <= 0 or (self.ctx.process_count > 1
+                         and jax.process_index() != 0):
+            return
+        cands = self._snapshot_candidates()
+        for _step, path in cands[:-keep]:
+            try:
+                file_io.rmtree(path)
+                logger.info("pruned old snapshot %s (checkpoint.keep=%d)",
+                            path, keep)
+            except Exception:
+                logger.exception("failed to prune old snapshot %s", path)
 
     def save_checkpoint(self, path: str) -> None:
         """Write a snapshot (synchronous public API; the train loop's
@@ -1050,26 +1311,53 @@ class Estimator:
 
     def _write_snapshot(self, path: str, tree) -> None:
         import orbax.checkpoint as ocp
+
+        # chaos site: a firing injection models the writer dying before
+        # any publish — the previous snapshot must stay the newest intact
+        faults.inject("ckpt.write")
+        import shutil
         ckptr = ocp.PyTreeCheckpointer()
         if file_io.is_remote(path):
-            with file_io.localized(path, "w") as tmp:
+            import tempfile
+            tmp = tempfile.mkdtemp(prefix="zoo_snap_")
+            try:
                 ckptr.save(os.path.join(tmp, "ckpt"), tree, force=True)
-            return
-        final = os.path.abspath(file_io.local_path(path))
-        if self.ctx.process_count > 1:
-            # orbax's save is a collective: every process participates and
-            # orbax coordinates the write + its own commit atomicity; a
-            # per-process stage+rename would race between ranks
-            ckptr.save(final, tree, force=True)
-            return
-        staging = final + ".writing"
-        import shutil
-        if os.path.exists(staging):  # leftover from a killed writer
-            shutil.rmtree(staging)
-        ckptr.save(staging, tree, force=True)
-        if os.path.exists(final):
-            shutil.rmtree(final)
-        os.rename(staging, final)  # atomic publish
+                # manifest computed over the local staging tree BEFORE the
+                # upload: on object stores (no atomic rename) it is the
+                # only way restore can tell a torn upload from a whole one
+                _write_manifest(tmp)
+                if file_io.isdir(path):
+                    # re-writing this step (elastic replay / preemption
+                    # colliding with a triggered write): orbax file names
+                    # are content-addressed per write, so uploading over
+                    # the old objects would leave STALE extras that fail
+                    # manifest verification — clear the target first
+                    file_io.rmtree(path)
+                file_io.put_tree(tmp, path)
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+        else:
+            final = os.path.abspath(file_io.local_path(path))
+            if self.ctx.process_count > 1:
+                # orbax's save is a collective: every process participates
+                # and orbax coordinates the write + its own commit
+                # atomicity; a per-process stage+rename would race ranks
+                ckptr.save(final, tree, force=True)
+                if jax.process_index() == 0:  # one writer for the manifest
+                    _write_manifest(final)
+                return
+            staging = final + ".writing"
+            if os.path.exists(staging):  # leftover from a killed writer
+                shutil.rmtree(staging)
+            ckptr.save(staging, tree, force=True)
+            _write_manifest(staging)  # sealed into the same atomic publish
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.rename(staging, final)  # atomic publish
+        # chaos site: tear the snapshot AFTER publish — the checksum
+        # manifest must catch it at restore and fall back one older
+        if faults.inject("ckpt.corrupt"):
+            faults.tear_snapshot(path)
 
     def load_checkpoint(self, path: str) -> None:
         """Restore a snapshot. Restores are data-only (orbax reads arrays,
@@ -1080,12 +1368,17 @@ class Estimator:
         # fence: an in-flight async write may be producing the newest
         # snapshot (or the very one being restored)
         self._ckpt_writer.wait()
+        verify = bool(global_config().get("checkpoint.verify"))
         if file_io.is_remote(path):
             with file_io.localized(path, "r") as tmp:
+                if verify:
+                    _verify_manifest(tmp, path)
                 self._load_checkpoint_local(os.path.join(tmp, "ckpt"))
             return
-        self._load_checkpoint_local(
-            os.path.abspath(file_io.local_path(path)))
+        local = os.path.abspath(file_io.local_path(path))
+        if verify:
+            _verify_manifest(local, path)
+        self._load_checkpoint_local(local)
 
     def _load_checkpoint_local(self, path: str) -> None:
         import orbax.checkpoint as ocp

@@ -26,6 +26,7 @@ from typing import Any, Iterator, List, Optional
 import numpy as np
 from jax.sharding import Mesh
 
+from ..common import faults
 from ..common.config import global_config
 from ..parallel.mesh import shard_batch
 
@@ -86,6 +87,10 @@ def _produce(it: Iterator[Any], mesh: Mesh, q: "queue.Queue",
     # its __del__-triggered stop would never fire
     try:
         for batch in it:
+            # chaos site: a firing injection models the data plane dying
+            # mid-epoch — it must surface on the CONSUMER thread (errbox),
+            # where the estimator's elastic retry can catch it
+            faults.inject("feed.produce")
             if not _put_until_stopped(q, stop, shard_fn(mesh, batch)):
                 return
     except BaseException as e:  # surfaced on the consumer side

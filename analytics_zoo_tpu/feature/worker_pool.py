@@ -33,10 +33,13 @@ from __future__ import annotations
 
 import atexit
 import itertools
+import logging
 import multiprocessing as mp
 import os
 import queue as queue_mod
+import signal
 import threading
+import time
 import traceback
 import warnings
 from multiprocessing import shared_memory
@@ -44,7 +47,11 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..common import faults
+
 _ALIGN = 128  # slab leaf alignment (cache-line / vector friendly)
+
+logger = logging.getLogger(__name__)
 
 
 class TransformWorkerError(RuntimeError):
@@ -143,23 +150,42 @@ def _write_record(views: Sequence[np.ndarray], row: int, record) -> None:
 # -- worker side -------------------------------------------------------------
 
 
-def _worker_main(features, transform, slot_views, task_q, result_q) -> None:
+def _worker_main(wid, features, transform, slot_views, task_q,
+                 result_q) -> None:
     """Forked worker loop. Everything in ``args`` arrived by fork
     inheritance (no pickling): the source feature tree, the transform
-    chain, and numpy views over the MAP_SHARED slabs."""
+    chain, and numpy views over the MAP_SHARED slabs.
+
+    Protocol on ``result_q`` (a SimpleQueue — its ``put`` is a
+    SYNCHRONOUS locked pipe write, so a message that returned is
+    delivered even if the worker is SIGKILLed the next instruction; an
+    mp.Queue's feeder thread could lose it):
+
+    - ``("claim", tid, wid)`` before touching a task — the parent's
+      death ledger: if this worker dies, the parent knows exactly which
+      task to resubmit to the respawned replacement;
+    - ``("done", tid, rows, err)`` on completion or error.
+    """
     while True:
         task = task_q.get()
         if task is None:
             return
         task_id, slot, row0, idx = task
+        result_q.put(("claim", task_id, wid))
         try:
+            # chaos sites: a hard self-SIGKILL mid-batch (pool self-healing
+            # must respawn + resubmit) and a transient task failure (task
+            # retry budget must absorb it)
+            if faults.inject("worker.kill"):
+                os.kill(os.getpid(), signal.SIGKILL)
+            faults.inject("worker.task")
             views = slot_views[slot]
             for j, i in enumerate(idx):
                 rec = transform.apply(_index_tree(features, int(i)))
                 _write_record(views, row0 + j, rec)
-            result_q.put((task_id, len(idx), None))
+            result_q.put(("done", task_id, len(idx), None))
         except BaseException:
-            result_q.put((task_id, 0, traceback.format_exc()))
+            result_q.put(("done", task_id, 0, traceback.format_exc()))
 
 
 # -- parent side -------------------------------------------------------------
@@ -197,58 +223,137 @@ class TransformWorkerPool:
             shm = shared_memory.SharedMemory(create=True, size=slab_bytes)
             self._shms.append(shm)
             self._slot_views.append(self.spec.slab_views(shm, self.rows))
-        ctx = mp.get_context("fork")
-        self._task_q = ctx.SimpleQueue()
-        self._result_q = ctx.Queue()
+        from ..common.config import global_config
+        cfg = global_config()
+        self._ctx = mp.get_context("fork")
+        self._task_q = self._ctx.SimpleQueue()
+        # SimpleQueue, NOT mp.Queue: workers put results with a synchronous
+        # locked pipe write — a SIGKILLed child cannot strand a message in
+        # an unflushed feeder thread, so the parent's claim/done ledger
+        # stays exact through hard kills
+        self._result_q = self._ctx.SimpleQueue()
+        self._features = features
+        self._transform = transform
         self._procs: List[mp.Process] = []
+        for wid in range(self.num_workers):
+            self._procs.append(self._spawn_worker(wid))
+        self._task_counter = itertools.count()
+        self._outstanding: set = set()
+        self._results: Dict[int, Tuple[int, Optional[str]]] = {}
+        self._tasks: Dict[int, Tuple[int, int, np.ndarray]] = {}
+        self._claimed: Dict[int, int] = {}  # tid -> wid (death ledger)
+        self._retried: Dict[int, int] = {}  # tid -> error-retry count
+        self._task_retries = int(cfg.get("data.task_retries") or 0)
+        self._respawns_left = int(cfg.get("data.worker_respawns") or 0)
+        self._closed = False
+        self._lock = threading.Lock()
+        TransformWorkerPool._live[id(self)] = self
+
+    def _spawn_worker(self, wid: int) -> mp.Process:
         with warnings.catch_warnings():
             # jax warns on fork of its multithreaded parent; the children
             # never touch jax (numpy-only transform loops), so the warning
             # is noise here
             warnings.simplefilter("ignore")
-            for _ in range(self.num_workers):
-                p = ctx.Process(
-                    target=_worker_main,
-                    args=(features, transform, self._slot_views,
-                          self._task_q, self._result_q),
-                    daemon=True, name="zoo-transform-worker")
-                p.start()
-                self._procs.append(p)
-        self._task_counter = itertools.count()
-        self._outstanding: set = set()
-        self._results: Dict[int, Tuple[int, Optional[str]]] = {}
-        self._closed = False
-        self._lock = threading.Lock()
-        TransformWorkerPool._live[id(self)] = self
+            p = self._ctx.Process(
+                target=_worker_main,
+                args=(wid, self._features, self._transform,
+                      self._slot_views, self._task_q, self._result_q),
+                daemon=True, name=f"zoo-transform-worker-{wid}")
+            p.start()
+        return p
 
     # -- task plumbing -------------------------------------------------------
 
     def _submit(self, slot: int, row0: int, idx: np.ndarray) -> int:
         tid = next(self._task_counter)
         self._outstanding.add(tid)
-        self._task_q.put((tid, slot, row0,
-                          np.ascontiguousarray(idx, dtype=np.int64)))
+        idx = np.ascontiguousarray(idx, dtype=np.int64)
+        self._tasks[tid] = (slot, row0, idx)  # kept for resubmission
+        self._task_q.put((tid, slot, row0, idx))
         return tid
 
+    def _resubmit(self, tid: int) -> None:
+        slot, row0, idx = self._tasks[tid]
+        self._task_q.put((tid, slot, row0, idx))
+
+    def _result_get(self, timeout: float):
+        """``SimpleQueue.get`` with a timeout (single consumer thread —
+        the poll/recv pair cannot interleave with another reader)."""
+        if not self._result_q._reader.poll(timeout):
+            raise queue_mod.Empty
+        return self._result_q.get()
+
+    def _check_workers(self) -> None:
+        """Death sweep: a child that exited nonzero (SIGKILL, OOM, abort)
+        is respawned — fork inherits the same features/transform/slab
+        views — and whatever task it had claimed is resubmitted, so the
+        consumer never hangs on a result that can no longer arrive. Once
+        the respawn budget (``data.worker_respawns``) is spent, the death
+        surfaces promptly as :class:`TransformWorkerError` instead."""
+        for wid, p in enumerate(self._procs):
+            if p.is_alive() or p.exitcode in (0, None):
+                continue
+            lost = [tid for tid, w in self._claimed.items() if w == wid]
+            if self._respawns_left <= 0:
+                raise TransformWorkerError(
+                    f"transform worker died with exit code {p.exitcode} "
+                    f"(killed? OOM?) and the respawn budget is exhausted; "
+                    f"raise data.worker_respawns to self-heal") from None
+            self._respawns_left -= 1
+            logger.warning(
+                "transform worker %d died with exit code %s; respawning "
+                "(%d respawns left) and resubmitting %d lost task(s)",
+                wid, p.exitcode, self._respawns_left, len(lost))
+            self._procs[wid] = self._spawn_worker(wid)
+            for tid in lost:
+                self._claimed.pop(tid, None)
+                # only a task still outstanding can be lost; a 'done' that
+                # beat the death into the pipe wins (put is synchronous)
+                if tid in self._outstanding and tid not in self._results:
+                    self._resubmit(tid)
+
+    def _pump(self, timeout: float) -> bool:
+        """Drain one protocol message (or run the death sweep on a quiet
+        queue). Returns True when a message was processed."""
+        try:
+            msg = self._result_get(timeout)
+        except queue_mod.Empty:
+            self._check_workers()
+            return False
+        if msg[0] == "claim":
+            _, tid, wid = msg
+            self._claimed[tid] = wid
+            return True
+        _, tid, n, err = msg
+        self._claimed.pop(tid, None)
+        if err is not None and self._retried.get(tid, 0) < self._task_retries:
+            # transient-task resilience: burn one retry and re-run the
+            # task (same slot rows — a failed attempt's partial writes are
+            # simply overwritten)
+            self._retried[tid] = self._retried.get(tid, 0) + 1
+            logger.warning(
+                "transform task %d failed (retry %d/%d):\n%s", tid,
+                self._retried[tid], self._task_retries, err)
+            self._resubmit(tid)
+            return True
+        self._outstanding.discard(tid)
+        self._results[tid] = (n, err)
+        self._tasks.pop(tid, None)
+        self._retried.pop(tid, None)
+        return True
+
     def _collect(self, tid: int, timeout: float = 300.0) -> int:
-        """Block until task ``tid`` finished; returns rows written."""
+        """Block until task ``tid`` finished; returns rows written. Polls
+        in short slices so a dead child is noticed (and healed or
+        surfaced) within ~0.2s, not only when the whole queue goes
+        quiet."""
+        deadline = time.monotonic() + timeout
         while tid not in self._results:
-            try:
-                got_tid, n, err = self._result_q.get(timeout=1.0)
-            except queue_mod.Empty:
-                dead = [p for p in self._procs
-                        if not p.is_alive() and p.exitcode not in (0, None)]
-                if dead:
-                    raise TransformWorkerError(
-                        f"transform worker died with exit code "
-                        f"{dead[0].exitcode} (killed? OOM?)") from None
-                timeout -= 1.0
-                if timeout <= 0:
+            if not self._pump(timeout=0.2):
+                if time.monotonic() > deadline:
                     raise TransformWorkerError(
                         "timed out waiting for a transform worker") from None
-                continue
-            self._outstanding.discard(got_tid)
-            self._results[got_tid] = (n, err)
         n, err = self._results.pop(tid)
         if err is not None:
             raise TransformWorkerError(
@@ -373,8 +478,9 @@ class TransformWorkerPool:
             if p.is_alive():
                 p.kill()
                 p.join(timeout=2)
-        self._result_q.close()
-        self._result_q.cancel_join_thread()
+        close_q = getattr(self._result_q, "close", None)
+        if close_q is not None:  # SimpleQueue.close (3.9+): release pipes
+            close_q()
         if unlink:
             self.release_slabs()
 

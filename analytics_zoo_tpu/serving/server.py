@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..common import faults
 from ..inference.inference_model import InferenceModel
 from .config import ServingConfig
 from .queues import QueueBackend, decode_image, make_queue
@@ -95,6 +96,9 @@ class ClusterServing:
     # -- record prep ----------------------------------------------------------
 
     def _prepare(self, record: Dict[str, Any]) -> np.ndarray:
+        # chaos site: a faulty decode must become THIS record's error
+        # result (the _decode future handler), never kill the claim loop
+        faults.inject("serving.decode")
         cfg = self.config
         if "image" in record:  # base64-encoded image bytes
             img = decode_image(record["image"])
@@ -158,6 +162,9 @@ class ClusterServing:
 
     def _writeback(self, uris: List[str], probs: np.ndarray,
                    device_elapsed: float) -> None:
+        # chaos site: a failed writeback must error its batch and keep the
+        # server draining (the writeback thread's per-batch catch)
+        faults.inject("serving.writeback")
         cfg = self.config
         for uri, p in zip(uris, probs):
             p = np.asarray(p).reshape(-1)

@@ -1344,8 +1344,109 @@ def bench_quantized(batch_size: int = 32, steps: int = 30, warmup: int = 3):
 # skips from the END of this list (quantized/pipeline have stable
 # previously-published numbers; the north stars and the new int8-dataflow
 # row must always land)
+def bench_recovery(batch_size: int = 256, steps_per_epoch: int = 8,
+                   d: int = 64):
+    """Elastic-recovery cost: wall-clock overhead of one injected step
+    failure (checkpoint restore + replay + pipeline re-setup) vs the
+    clean run, and the restore cost alone — the number that tells you
+    what a preemption/chip failure actually costs at a given checkpoint
+    cadence. Uses the ``train.step`` fault site (``common/faults.py``)
+    with checkpoints every iteration, and parity-checks that the faulted
+    run's final params are BIT-IDENTICAL to the clean run's before any
+    number is published (recovery that changes the math is not
+    recovery)."""
+    import tempfile
+
+    from analytics_zoo_tpu.common import faults
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.common.triggers import SeveralIteration
+    from analytics_zoo_tpu.estimator import Estimator
+    from analytics_zoo_tpu.feature import FeatureSet
+    from analytics_zoo_tpu.keras import Sequential, objectives, optimizers
+    from analytics_zoo_tpu.keras.layers import Dense
+
+    ctx = init_tpu_context()
+    batch_size = max(ctx.num_devices,
+                     (batch_size // ctx.num_devices) * ctx.num_devices)
+    n = batch_size * steps_per_epoch
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, d).astype(np.float32)
+    y = (x.sum(1) > d / 2).astype(np.float32)
+
+    def make(ckpt_dir):
+        est = Estimator(
+            model=Sequential([Dense(256, activation="relu"), Dense(2)]),
+            loss_fn=objectives.get("sparse_categorical_crossentropy"),
+            optimizer=optimizers.SGD(0.1))
+        est.set_checkpoint(ckpt_dir, SeveralIteration(1))
+        return est
+
+    def fs():
+        return FeatureSet.from_ndarrays(x, y, shuffle=False)
+
+    def run(inject_at=None):
+        """Warm one epoch (compiles + first snapshot), then time two more
+        epochs — with an optional single step failure in the middle."""
+        ckpt = tempfile.mkdtemp(prefix="zoo_bench_recovery_")
+        est = make(ckpt)
+        est.train(fs(), batch_size=batch_size, epochs=1)
+        est._ckpt_writer.wait()
+        faults.reset()
+        if inject_at is not None:
+            faults.arm("train.step", at=inject_at, budget=1)
+        try:
+            t0 = time.perf_counter()
+            est.train(fs(), batch_size=batch_size, epochs=3)
+            elapsed = time.perf_counter() - t0
+            fired = faults.fire_count("train.step") if inject_at else 0
+        finally:
+            faults.reset()
+        est._ckpt_writer.wait()
+        return elapsed, est, ckpt
+
+    clean_s, est_clean, _ = run()
+    timed_steps = 2 * steps_per_epoch
+    clean_step_s = clean_s / timed_steps
+    faulted_s, est_faulted, ckpt = run(inject_at=steps_per_epoch)
+
+    import jax
+    pa = jax.tree_util.tree_leaves(est_clean.get_params())
+    pb = jax.tree_util.tree_leaves(est_faulted.get_params())
+    parity = all(np.array_equal(a, b) for a, b in zip(pa, pb))
+    if not parity:
+        raise RuntimeError(
+            "recovery parity FAILED: faulted run's final params differ "
+            "from the clean run's")
+
+    # restore cost alone (checksum verify + orbax read + device_put)
+    t0 = time.perf_counter()
+    est_faulted.load_checkpoint(est_faulted._latest_snapshot())
+    restore_s = time.perf_counter() - t0
+
+    recovery_s = max(0.0, faulted_s - clean_s)
+    return _BenchResult(
+        metric="recovery_seconds",
+        value=round(recovery_s, 4),
+        unit="s", mfu=None,
+        detail={"clean_wall_s": round(clean_s, 4),
+                "faulted_wall_s": round(faulted_s, 4),
+                "restore_ms": round(restore_s * 1e3, 2),
+                "clean_step_ms": round(clean_step_s * 1e3, 2),
+                "recovery_vs_step": round(recovery_s / clean_step_s, 2)
+                if clean_step_s > 0 else None,
+                "batch_size": batch_size,
+                "steps_per_epoch": steps_per_epoch,
+                "checkpoint_cadence": "every iteration",
+                "parity_ok": parity,
+                "note": "recovery_seconds = faulted wall - clean wall for "
+                        "an identical 2-epoch schedule with ONE injected "
+                        "step failure (train.step site); includes restore "
+                        "+ replay of the failed step + feed re-setup"})
+
+
 _WORKLOADS = {
     "resnet50": bench_resnet50,
+    "recovery": bench_recovery,
     "resnet50_int8": bench_resnet50_int8,
     "ncf": bench_ncf,
     "bert": bench_bert,
@@ -1413,6 +1514,7 @@ _COMPACT_KEYS = {
     "quantized": ("fp32_images_per_sec",),
     "serving": ("bert_records_per_sec", "device_records_per_sec"),
     "pipeline": (),
+    "recovery": ("restore_ms", "recovery_vs_step", "parity_ok"),
 }
 
 

@@ -1,0 +1,53 @@
+"""Tier-1 collection-time guard: the fault-injection registry and the
+``faults.inject(...)`` call sites must stay in bijection, with unique
+literal site names, and every site exercised by at least one test
+(``scripts/check_fault_sites.py``).
+
+Runs at IMPORT (= pytest collection) so a refactor that orphans a
+registry row, duplicates a site name, computes a site name dynamically,
+or leaves a new site untested fails the suite even though nothing
+behavioral notices chaos coverage rotting."""
+import importlib.util
+import os
+
+_script = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts", "check_fault_sites.py")
+_spec = importlib.util.spec_from_file_location("check_fault_sites", _script)
+_lint = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_lint)
+
+_problems = _lint.check()
+if _problems:  # collection-time failure, with the drifted sites
+    raise AssertionError(
+        "fault-site coverage drifted: " + "; ".join(_problems))
+
+
+def test_fault_sites_clean():
+    assert _lint.check() == []
+
+
+def test_registry_parse_matches_runtime_registry():
+    """The lint reads REGISTRY via AST (no jax import); it must agree with
+    the imported module — a computed registry would silently blind it."""
+    from analytics_zoo_tpu.common import faults
+    assert _lint.registry_sites() == set(faults.REGISTRY)
+
+
+def test_lint_catches_seeded_drift(tmp_path):
+    """The checker must detect a seeded unknown/duplicate/unregistered
+    site (guards against the lint rotting into a silent always-pass)."""
+    bad = tmp_path / "faults.py"
+    bad.write_text("REGISTRY = {'a.site': 1, 'b.site': 2}\n")
+    assert _lint.registry_sites(str(bad)) == {"a.site", "b.site"}
+
+    calls, non_literal = _lint.inject_sites()
+    assert calls  # the codebase really does inject
+    # every call the scanner found is a unique literal of a known site
+    assert non_literal == []
+    known = _lint.registry_sites()
+    assert set(calls) <= known
+
+
+def test_every_site_names_a_test_file():
+    for site in sorted(_lint.registry_sites()):
+        assert _lint.tests_mentioning(site), site

@@ -1,0 +1,153 @@
+"""Fault-injection framework unit tests: deterministic schedules, seeded
+probabilistic draws, shared budgets (including across forked children), the
+config-plan string, and the site registry contract."""
+import multiprocessing
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import faults
+from analytics_zoo_tpu.common.config import global_config
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+    global_config().unset("faults.plan")
+
+
+def fire_pattern(site, calls):
+    out = []
+    for _ in range(calls):
+        try:
+            out.append(1 if faults.inject(site) else 0)
+        except faults.FaultInjected:
+            out.append(1)
+    return out
+
+
+class TestSchedules:
+    def test_at_n_fires_exactly_once_on_nth_call(self):
+        faults.arm("train.step", at=3)
+        assert fire_pattern("train.step", 6) == [0, 0, 1, 0, 0, 0]
+        assert faults.fire_count("train.step") == 1
+
+    def test_raise_kind_raises_fault_injected(self):
+        faults.arm("train.step", at=1)
+        with pytest.raises(faults.FaultInjected, match="train.step"):
+            faults.inject("train.step")
+
+    def test_fault_injected_is_oserror(self):
+        # retry layers classify OSError as transient; injected faults must
+        # ride the same path as a real flaky backend
+        assert issubclass(faults.FaultInjected, OSError)
+
+    def test_flag_kind_returns_true(self):
+        faults.arm("worker.kill", at=1)
+        assert faults.inject("worker.kill") is True
+        assert faults.inject("worker.kill") is False
+
+    def test_probability_is_seeded_deterministic(self):
+        faults.arm("io.remote", p=0.3, budget=100, seed=11)
+        a = fire_pattern("io.remote", 200)
+        faults.reset()
+        faults.arm("io.remote", p=0.3, budget=100, seed=11)
+        assert fire_pattern("io.remote", 200) == a
+        faults.reset()
+        faults.arm("io.remote", p=0.3, budget=100, seed=12)
+        assert fire_pattern("io.remote", 200) != a  # seed actually matters
+        assert 30 <= sum(a) <= 100  # plausibly ~0.3, budget-capped
+
+    def test_budget_caps_total_fires(self):
+        faults.arm("io.remote", p=1.0, budget=4)
+        assert sum(fire_pattern("io.remote", 10)) == 4
+        assert faults.fire_count("io.remote") == 4
+
+    def test_unknown_site_rejected_everywhere(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            faults.inject("no.such.site")
+        with pytest.raises(ValueError, match="unknown fault site"):
+            faults.arm("no.such.site", at=1)
+
+    def test_arm_validation(self):
+        with pytest.raises(ValueError, match="exactly one"):
+            faults.arm("train.step")
+        with pytest.raises(ValueError, match="exactly one"):
+            faults.arm("train.step", at=1, p=0.5)
+        with pytest.raises(ValueError, match="1-based"):
+            faults.arm("train.step", at=0)
+        with pytest.raises(ValueError):
+            faults.arm("train.step", p=1.5)
+
+    def test_idle_site_is_silent(self):
+        assert fire_pattern("train.step", 50) == [0] * 50
+
+
+class TestPlanString:
+    def test_plan_parses_at_probability_and_budget(self):
+        global_config().set(
+            "faults.plan", "train.step:2,io.remote:1.0@3,worker.kill:1")
+        assert fire_pattern("train.step", 4) == [0, 1, 0, 0]
+        assert sum(fire_pattern("io.remote", 10)) == 3
+        assert faults.inject("worker.kill") is True
+
+    def test_plan_unknown_site_fails_loudly(self):
+        global_config().set("faults.plan", "bogus.site:1")
+        with pytest.raises(ValueError, match="unknown site"):
+            faults.inject("train.step")
+
+    def test_reset_disarms_plan(self):
+        global_config().set("faults.plan", "train.step:1")
+        with pytest.raises(faults.FaultInjected):
+            faults.inject("train.step")
+        global_config().unset("faults.plan")
+        faults.reset()
+        assert fire_pattern("train.step", 3) == [0, 0, 0]
+
+
+class TestForkSharing:
+    def test_budget_shared_with_forked_children(self):
+        """budget=1 armed before a fork must mean ONE firing across the
+        whole process tree — the 'kill exactly one worker' contract."""
+        faults.arm("worker.kill", at=1, budget=1)
+        ctx = multiprocessing.get_context("fork")
+        q = ctx.SimpleQueue()
+
+        def child():
+            q.put(bool(faults.inject("worker.kill")))
+
+        procs = [ctx.Process(target=child) for _ in range(4)]
+        for p in procs:
+            p.start()
+        for p in procs:
+            p.join(timeout=10)
+        fired = [q.get() for _ in range(4)]
+        assert sum(fired) == 1
+        assert faults.fire_count("worker.kill") == 1  # visible in parent
+
+
+class TestRegistry:
+    def test_registry_covers_all_layers(self):
+        # the spine of the chaos layer: estimator, checkpointing, IO,
+        # worker pool, device feed, serving
+        assert {"train.step", "train.preempt", "ckpt.write", "ckpt.corrupt",
+                "io.remote", "worker.task", "worker.kill", "feed.produce",
+                "serving.decode", "serving.writeback"} <= set(faults.REGISTRY)
+
+    def test_describe_lists_kinds(self):
+        desc = faults.describe()
+        assert desc["worker.kill"].startswith("flag:")
+        assert desc["train.step"].startswith("raise:")
+
+    def test_tear_snapshot_flips_a_data_file(self, tmp_path):
+        d = tmp_path / "snap"
+        d.mkdir()
+        (d / "data.bin").write_bytes(bytes(range(100)))
+        (d / "meta.json").write_text("{}")
+        before = (d / "data.bin").read_bytes()
+        faults.tear_snapshot(str(d))
+        assert (d / "data.bin").read_bytes() != before
+        assert (d / "meta.json").read_text() == "{}"  # metadata untouched

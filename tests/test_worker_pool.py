@@ -228,7 +228,7 @@ class TestWorkerPoolLifecycle:
             with pytest.raises(FileNotFoundError):
                 shared_memory.SharedMemory(name=name)
         ours = [p for p in multiprocessing.active_children()
-                if p.name == "zoo-transform-worker"]
+                if p.name.startswith("zoo-transform-worker")]
         assert ours == []
 
     def test_concurrent_train_and_eval_streams_same_set(self, ctx):
@@ -274,8 +274,116 @@ class TestWorkerPoolLifecycle:
             np.asarray(fs.features)[0],
             double_plus_head(np.arange(4, dtype=np.float32)))
         ours = [p for p in multiprocessing.active_children()
-                if p.name == "zoo-transform-worker"]
+                if p.name.startswith("zoo-transform-worker")]
         assert ours == []
+
+
+class TestSelfHealing:
+    """Dead-child recovery: a worker SIGKILLed mid-batch must not hang the
+    consumer — the pool respawns it and resubmits the lost task (within
+    the ``data.worker_respawns`` budget), or surfaces TransformWorkerError
+    promptly once the budget is spent. Transient task failures burn
+    ``data.task_retries`` before surfacing."""
+
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        from analytics_zoo_tpu.common import faults
+        from analytics_zoo_tpu.common.config import global_config
+        faults.reset()
+        yield
+        faults.reset()
+        global_config().unset("data.worker_respawns")
+        global_config().unset("data.task_retries")
+
+    def test_sigkilled_child_respawns_and_results_stay_exact(self, ctx):
+        from analytics_zoo_tpu.common import faults
+        from analytics_zoo_tpu.feature.worker_pool import TransformWorkerPool
+        x = np.arange(80, dtype=np.float32).reshape(20, 4)
+        faults.arm("worker.kill", at=2, budget=1)
+        pool = TransformWorkerPool(x, Lambda(lambda r: r * 2), rows=4,
+                                   slots=3, num_workers=2)
+        try:
+            idx_batches = [np.arange(i * 4, (i + 1) * 4) for i in range(5)]
+            got = [np.array(view) for _, view in
+                   pool.map_index_batches(iter(idx_batches))]
+        finally:
+            pool.close()
+        assert faults.fire_count("worker.kill") == 1
+        np.testing.assert_array_equal(np.concatenate(got), x * 2)
+
+    def test_exhausted_respawn_budget_surfaces_promptly(self, ctx):
+        import time
+
+        from analytics_zoo_tpu.common import faults
+        from analytics_zoo_tpu.common.config import global_config
+        from analytics_zoo_tpu.feature.worker_pool import TransformWorkerPool
+        global_config().set("data.worker_respawns", 0)
+        faults.arm("worker.kill", at=1, budget=1)
+        x = np.arange(80, dtype=np.float32).reshape(20, 4)
+        pool = TransformWorkerPool(x, Lambda(lambda r: r * 2), rows=4,
+                                   slots=2, num_workers=2)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(TransformWorkerError, match="worker died"):
+                for _ in pool.map_index_batches(iter([np.arange(4)])):
+                    pass
+            # promptly: seconds, not the 300s result-collection timeout
+            assert time.monotonic() - t0 < 10
+        finally:
+            pool.close()
+
+    def test_task_retries_absorb_transient_faults(self, ctx):
+        from analytics_zoo_tpu.common import faults
+        from analytics_zoo_tpu.common.config import global_config
+        from analytics_zoo_tpu.feature.worker_pool import transform_all
+        global_config().set("data.task_retries", 2)
+        faults.arm("worker.task", at=1, budget=1)
+        x = np.arange(80, dtype=np.float32).reshape(20, 4)
+        tree, keepalive = transform_all(x, 20, Lambda(lambda r: r * 2),
+                                        num_workers=2)
+        assert faults.fire_count("worker.task") == 1
+        np.testing.assert_array_equal(np.array(tree), x * 2)
+
+    def test_task_retry_budget_exhausts_to_error(self, ctx):
+        from analytics_zoo_tpu.common import faults
+        from analytics_zoo_tpu.common.config import global_config
+        from analytics_zoo_tpu.feature.worker_pool import TransformWorkerPool
+        global_config().set("data.task_retries", 1)
+        faults.arm("worker.task", p=1.0, budget=100)
+        x = np.arange(80, dtype=np.float32).reshape(20, 4)
+        pool = TransformWorkerPool(x, Lambda(lambda r: r * 2), rows=4,
+                                   slots=2, num_workers=2)
+        try:
+            with pytest.raises(TransformWorkerError, match="injected fault"):
+                for _ in pool.map_index_batches(iter([np.arange(4)])):
+                    pass
+        finally:
+            pool.close()
+
+    def test_respawned_pool_keeps_streaming_through_training(self, ctx):
+        """End-to-end: the eager mp transform behind an estimator survives
+        a killed worker and the trained params match the loop tier."""
+        from analytics_zoo_tpu.common import faults
+        from analytics_zoo_tpu.estimator import Estimator
+        from analytics_zoo_tpu.keras import Sequential, objectives, optimizers
+        from analytics_zoo_tpu.keras.layers import Dense
+
+        def run(kill):
+            faults.reset()
+            if kill:
+                faults.arm("worker.kill", at=1, budget=1)
+            fs = make_fs(n=40).transform(Lambda(double_plus_head),
+                                         num_workers=2, mode="mp")
+            est = Estimator(
+                model=Sequential([Dense(4, name="d1"), Dense(1, name="d2")]),
+                loss_fn=objectives.get("mse"),
+                optimizer=optimizers.SGD(0.01))
+            est.train(fs, batch_size=8, epochs=2)
+            return est.get_params()
+
+        pa, pb = run(kill=False), run(kill=True)
+        np.testing.assert_array_equal(pa["d1"]["kernel"], pb["d1"]["kernel"])
+        np.testing.assert_array_equal(pa["d2"]["kernel"], pb["d2"]["kernel"])
 
 
 class TestZeroAllocStaging:
