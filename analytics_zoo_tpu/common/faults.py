@@ -112,6 +112,15 @@ REGISTRY: Dict[str, Site] = {
     "serving.writeback": Site(
         "serving result writeback, once per batch — a failed writeback "
         "must error its batch and keep the server draining"),
+    "serving.claim": Site(
+        "serving claim stage, once per claim attempt — a flaky queue "
+        "backend must be retried and absorbed, never kill the serve loop"),
+    "serving.predict": Site(
+        "serving batch dispatch, once per batch — a failed predict must "
+        "post error results for ITS batch and keep the server serving"),
+    "serving.reload": Site(
+        "hot model reload, once per reload attempt — a failed reload "
+        "must roll back to the serving model with zero dropped requests"),
 }
 
 

@@ -1,5 +1,13 @@
 """Serving client SDK (reference ``pyzoo/zoo/serving/client.py``:
-``InputQueue.enqueue_image:87``, ``OutputQueue.dequeue:135`` / ``query``)."""
+``InputQueue.enqueue_image:87``, ``OutputQueue.dequeue:135`` / ``query``).
+
+SLO contract: every enqueue stamps ``enqueue_t`` (client wall clock — the
+only clock two processes share) and, when the caller passes
+``deadline_ms``, the request's latency budget. The server checks the
+deadline at claim, after decode, and before dispatch, and answers expired
+requests with ``{"error": "deadline exceeded"}`` instead of burning device
+time on work nobody is waiting for.
+"""
 from __future__ import annotations
 
 import time
@@ -16,30 +24,53 @@ class _API:
 
 
 class InputQueue(_API):
-    def enqueue_image(self, uri: str, img) -> None:
-        """``img``: ndarray (HWC), encoded bytes, or a path string."""
+    @staticmethod
+    def _stamp(payload: Dict[str, Any],
+               deadline_ms: Optional[int]) -> Dict[str, Any]:
+        # wall clock on purpose: enqueue_t crosses a process boundary, and
+        # monotonic clocks do not compare across processes
+        payload["enqueue_t"] = time.time()
+        if deadline_ms is not None:
+            payload["deadline_ms"] = int(deadline_ms)
+        return payload
+
+    def enqueue_image(self, uri: str, img,
+                      deadline_ms: Optional[int] = None) -> None:
+        """``img``: ndarray (HWC), encoded bytes, or a path string.
+        ``deadline_ms``: answer-by budget from now; past it the server
+        posts a deadline error instead of a prediction."""
         if isinstance(img, str):
             import cv2
             data = cv2.imread(img)
             if data is None:
                 raise ValueError(f"unreadable image path {img}")
             img = data
-        self.queue.enqueue(uri, {"image": encode_image(img)})
+        self.queue.enqueue(uri, self._stamp({"image": encode_image(img)},
+                                            deadline_ms))
 
-    def enqueue_tensor(self, uri: str, tensor) -> None:
-        self.queue.enqueue(uri, {"tensor": np.asarray(tensor).tolist()})
+    def enqueue_tensor(self, uri: str, tensor,
+                       deadline_ms: Optional[int] = None) -> None:
+        self.queue.enqueue(
+            uri, self._stamp({"tensor": np.asarray(tensor).tolist()},
+                             deadline_ms))
 
 
 class OutputQueue(_API):
     def query(self, uri: str, timeout_s: float = 0.0
               ) -> Optional[Dict[str, Any]]:
-        """Result for one uri; optionally poll up to ``timeout_s``."""
-        deadline = time.time() + timeout_s
+        """Result for one uri; optionally poll up to ``timeout_s``.
+        The wait is on the monotonic clock (a wall-clock step must not
+        stretch or collapse the timeout) with exponential poll backoff —
+        a long-poll client must not busy-hammer the result store."""
+        deadline = time.monotonic() + timeout_s
+        sleep_s = 0.005
         while True:
             res = self.queue.get_result(uri)
-            if res is not None or time.time() >= deadline:
+            remaining = deadline - time.monotonic()
+            if res is not None or remaining <= 0:
                 return res
-            time.sleep(0.01)
+            time.sleep(min(sleep_s, remaining))
+            sleep_s = min(sleep_s * 2, 0.25)
 
     def dequeue(self) -> Dict[str, Dict[str, Any]]:
         """All available results keyed by uri (reference HGETALL sweep)."""

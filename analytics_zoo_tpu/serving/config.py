@@ -20,11 +20,21 @@ class ServingConfig:
     filter_top_n: Optional[int] = None
     batch_size: int = 4
     batch_wait_ms: int = 20  # micro-batch window
-    max_pending: int = 10000  # backpressure trim threshold
+    max_pending: int = 10000  # erroring load-shed depth threshold
     concurrent_num: int = 1
     decode_threads: int = 4  # host threads decoding while the device runs
     quantize: Optional[str] = None  # bf16 | int8
     log_dir: Optional[str] = None  # TensorBoard serving summaries
+    # -- SLO layer ------------------------------------------------------------
+    default_deadline_ms: Optional[int] = None  # server-side deadline for
+    #   records that carry none (clients stamp per-request deadline_ms)
+    shed_wait_ms: Optional[int] = None  # estimated-wait admission: shed the
+    #   queue down to what the smoothed service rate can answer within this
+    #   wait (None = depth-only shedding via max_pending)
+    claim_retries: int = 20  # consecutive transient claim failures the loop
+    #   absorbs before surfacing the backend as dead
+    health_path: Optional[str] = None  # periodic + terminal health.json
+    health_interval_s: float = 1.0  # min seconds between health writes
 
     @staticmethod
     def from_yaml(path: str) -> "ServingConfig":
@@ -61,5 +71,14 @@ class ServingConfig:
         cfg.decode_threads = int(params.get("decode_threads",
                                             cfg.decode_threads))
         cfg.quantize = params.get("quantize", cfg.quantize)
+        if params.get("deadline_ms") is not None:
+            cfg.default_deadline_ms = int(params["deadline_ms"])
+        if params.get("shed_wait_ms") is not None:
+            cfg.shed_wait_ms = int(params["shed_wait_ms"])
+        cfg.claim_retries = int(params.get("claim_retries",
+                                           cfg.claim_retries))
         cfg.log_dir = raw.get("log_dir", cfg.log_dir)
+        cfg.health_path = raw.get("health_path", cfg.health_path)
+        if raw.get("health_interval_s") is not None:
+            cfg.health_interval_s = float(raw["health_interval_s"])
         return cfg

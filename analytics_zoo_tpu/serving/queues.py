@@ -49,7 +49,19 @@ class QueueBackend:
 
     def trim(self, max_pending: int) -> int:
         """Drop oldest requests beyond ``max_pending`` (the redis maxmem
-        xtrim guard, ClusterServing.scala:134-140). Returns dropped count."""
+        xtrim guard, ClusterServing.scala:134-140). Returns dropped count.
+        SILENT — the dropped clients poll to their timeout. Kept for
+        direct queue administration; the serve loop uses :meth:`shed`."""
+        raise NotImplementedError
+
+    def shed(self, max_pending: int,
+             reason: str = "shed: queue overloaded") -> List[str]:
+        """Erroring admission control: atomically remove the OLDEST
+        requests beyond ``max_pending`` and post a terminal
+        ``{"error": reason}`` result for each, so every dropped client
+        gets an explicit answer instead of polling to its timeout.
+        Returns the shed uris. Claims are exclusive — on a shared spool N
+        servers shedding concurrently drop each request at most once."""
         raise NotImplementedError
 
 
@@ -184,6 +196,21 @@ class FileQueue(QueueBackend):
                         pass
         return src
 
+    def _remove_claimed(self, name: str, path: str) -> None:
+        """Clean up a fully-consumed claim: request file(s) first, marker
+        LAST — a marker removed while the request still exists would let a
+        second consumer re-claim the record."""
+        cleanup = list({path, file_io.join(self.req_dir, name)})
+        if file_io.is_remote(path):
+            # the marker must not outlive the request either:
+            # remote spools would leak one object per record
+            cleanup.append(file_io.join(self.claim_dir, name + ".claim"))
+        for p in cleanup:
+            try:
+                file_io.remove(p)
+            except (OSError, FileNotFoundError):
+                pass
+
     def claim_batch(self, max_items: int) -> List[Tuple[str, Dict[str, Any]]]:
         out = []
         try:
@@ -209,21 +236,35 @@ class FileQueue(QueueBackend):
                 logging.getLogger("analytics_zoo_tpu.serving").warning(
                     "dropping malformed request file %s", name)
             finally:
-                # request file(s) first, marker LAST: a marker removed
-                # while the request still exists would let a second
-                # consumer re-claim the record
-                cleanup = list({path, file_io.join(self.req_dir, name)})
-                if file_io.is_remote(path):
-                    # the marker must not outlive the request either:
-                    # remote spools would leak one object per record
-                    cleanup.append(file_io.join(self.claim_dir,
-                                                name + ".claim"))
-                for p in cleanup:
-                    try:
-                        file_io.remove(p)
-                    except (OSError, FileNotFoundError):
-                        pass
+                self._remove_claimed(name, path)
         return out
+
+    def shed(self, max_pending: int,
+             reason: str = "shed: queue overloaded") -> List[str]:
+        try:
+            names = sorted(n for n in file_io.listdir(self.req_dir,
+                                                      refresh=True)
+                           if not n.startswith("."))
+        except FileNotFoundError:
+            return []
+        dropped: List[str] = []
+        for name in names[:max(0, len(names) - max_pending)]:
+            path = self._claim_one(name)  # exclusive: N shedders, one winner
+            if path is None:
+                continue
+            try:
+                with file_io.fopen(path) as f:
+                    rec = json.loads(f.read())
+                self.put_result(rec["uri"], {"error": reason})
+                dropped.append(rec["uri"])
+            except (ValueError, KeyError, OSError):
+                # malformed request: no uri to answer — drop it outright
+                import logging
+                logging.getLogger("analytics_zoo_tpu.serving").warning(
+                    "dropping malformed request file %s during shed", name)
+            finally:
+                self._remove_claimed(name, path)
+        return dropped
 
     def put_result(self, uri: str, value: Dict[str, Any]) -> None:
         key = hashlib.md5(uri.encode()).hexdigest()
@@ -272,12 +313,22 @@ class FileQueue(QueueBackend):
 
 class RedisQueue(QueueBackend):
     """The reference wire contract: XADD to ``image_stream``, consumer-group
-    reads, results HSET at ``result:<uri>``. Needs the redis package."""
+    reads, results HSET at ``result:<uri>``. Needs the redis package.
+
+    Delivery is AT-LEAST-ONCE past a crash: a claimed entry is XACKed only
+    after its result lands in :meth:`put_result` — a server that dies
+    between claim and result leaves the entry in the group's PEL, and
+    :meth:`claim_batch` XAUTOCLAIMs entries idle past ``claim_lease_s``
+    back onto a live consumer (the FileQueue claim-marker reaping stance,
+    in redis' native vocabulary)."""
 
     STREAM = "image_stream"
     GROUP = "serving"
+    #: a pending entry idle this long belongs to a consumer presumed dead
+    CLAIM_LEASE_S = 60.0
 
-    def __init__(self, host: str = "localhost", port: int = 6379):
+    def __init__(self, host: str = "localhost", port: int = 6379,
+                 claim_lease_s: Optional[float] = None):
         import redis  # gated dependency
         self.db = redis.StrictRedis(host=host, port=port, db=0)
         # unique consumer identity per server instance: XREADGROUP '>'
@@ -285,6 +336,12 @@ class RedisQueue(QueueBackend):
         # is what makes N serving servers on one stream exactly-once
         # (ClusterServing.scala's multi-executor contract)
         self.consumer = f"consumer-{uuid.uuid4().hex[:12]}"
+        self.claim_lease_s = (claim_lease_s if claim_lease_s is not None
+                              else self.CLAIM_LEASE_S)
+        # uri -> stream entry id, claimed but not yet answered; the ack in
+        # put_result closes the loop (plain dict ops are GIL-atomic, and
+        # claim/result run on different serve-loop threads)
+        self._unacked: Dict[str, Any] = {}
         try:
             self.db.xgroup_create(self.STREAM, self.GROUP, mkstream=True)
         except Exception:
@@ -294,22 +351,51 @@ class RedisQueue(QueueBackend):
         self.db.xadd(self.STREAM, {"uri": uri,
                                    "data": json.dumps(payload)})
 
+    def _reclaim_stale(self, max_items: int) -> List:
+        """XAUTOCLAIM entries whose claiming consumer died before acking
+        (idle past the lease). Absent on old servers/fakes: no reclaim."""
+        try:
+            resp = self.db.xautoclaim(
+                self.STREAM, self.GROUP, self.consumer,
+                min_idle_time=int(self.claim_lease_s * 1000.0),
+                count=max_items)
+        except Exception:
+            return []
+        # redis-py returns (next_id, entries[, deleted]) depending on
+        # server version; the entry list is always the second field
+        if isinstance(resp, (list, tuple)) and len(resp) >= 2:
+            return list(resp[1] or [])
+        return []
+
     def claim_batch(self, max_items: int) -> List[Tuple[str, Dict[str, Any]]]:
-        resp = self.db.xreadgroup(self.GROUP, self.consumer,
-                                  {self.STREAM: ">"}, count=max_items,
-                                  block=10)
+        entries = self._reclaim_stale(max_items)
+        if len(entries) < max_items:
+            resp = self.db.xreadgroup(self.GROUP, self.consumer,
+                                      {self.STREAM: ">"},
+                                      count=max_items - len(entries),
+                                      block=10)
+            for _, fresh in resp or []:
+                entries.extend(fresh)
         out = []
-        for _, entries in resp or []:
-            for eid, fields in entries:
-                uri = fields[b"uri"].decode()
-                payload = json.loads(fields[b"data"].decode())
-                out.append((uri, {"uri": uri, **payload}))
-                self.db.xack(self.STREAM, self.GROUP, eid)
+        for eid, fields in entries:
+            uri = fields[b"uri"].decode()
+            payload = json.loads(fields[b"data"].decode())
+            out.append((uri, {"uri": uri, **payload}))
+            # at-most-once fix: NO xack here — the ack waits for the
+            # result (put_result), so a crash mid-batch redelivers via
+            # _reclaim_stale instead of dropping the request forever
+            self._unacked[uri] = eid
         return out
 
     def put_result(self, uri: str, value: Dict[str, Any]) -> None:
         self.db.hset(f"result:{uri}", mapping={
             k: json.dumps(v) for k, v in value.items()})
+        eid = self._unacked.pop(uri, None)
+        if eid is not None:
+            # result durable → the claim is settled; ack AFTER the hset so
+            # a crash between the two redelivers (result overwrite is
+            # idempotent) rather than losing the request
+            self.db.xack(self.STREAM, self.GROUP, eid)
 
     def get_result(self, uri: str) -> Optional[Dict[str, Any]]:
         raw = self.db.hgetall(f"result:{uri}")
@@ -318,12 +404,43 @@ class RedisQueue(QueueBackend):
         return {k.decode(): json.loads(v.decode()) for k, v in raw.items()}
 
     def pending_count(self) -> int:
+        # undelivered backlog (group lag) when the server exposes it —
+        # XLEN counts already-served entries that linger until an XTRIM
+        # and would make admission control shed phantom load
+        try:
+            for g in self.db.xinfo_groups(self.STREAM):
+                name = g.get("name")
+                if name in (self.GROUP, self.GROUP.encode()):
+                    lag = g.get("lag")
+                    if lag is not None:
+                        return int(lag)
+        except Exception:
+            pass
         return self.db.xlen(self.STREAM)
 
     def trim(self, max_pending: int) -> int:
         before = self.pending_count()
         self.db.xtrim(self.STREAM, maxlen=max_pending)
         return max(0, before - self.pending_count())
+
+    def shed(self, max_pending: int,
+             reason: str = "shed: queue overloaded") -> List[str]:
+        dropped: List[str] = []
+        excess = self.pending_count() - max_pending
+        while excess > 0:
+            resp = self.db.xreadgroup(self.GROUP, self.consumer,
+                                      {self.STREAM: ">"}, count=excess,
+                                      block=10)
+            entries = [e for _, es in resp or [] for e in es]
+            if not entries:
+                break
+            for eid, fields in entries:
+                uri = fields[b"uri"].decode()
+                self.put_result(uri, {"error": reason})
+                self.db.xack(self.STREAM, self.GROUP, eid)
+                dropped.append(uri)
+            excess -= len(entries)
+        return dropped
 
 
 def make_queue(src: str) -> QueueBackend:

@@ -1,25 +1,49 @@
 """Serving engine (reference ``serving/ClusterServing.scala:45``): the loop
 is claim micro-batch → decode base64 images → preprocess to the model shape
 → batched ``InferenceModel.doPredict`` → top-N postprocess → result
-write-back, with a pending-queue trim guard and throughput summaries
-(``:312-331``). One process per host; the TPU executes the batched forward,
-threads only move bytes."""
+write-back, with throughput summaries (``:312-331``). One process per host;
+the TPU executes the batched forward, threads only move bytes.
+
+Request-lifecycle SLO layer (the Tail-at-Scale/Clipper machinery the
+reference leaves to the operator): the invariant is that **every claimed
+request receives exactly one terminal result — a value or an explicit
+error — no matter what fails**. Deadlines are checked at claim, after
+decode, and before dispatch (expired work answers ``deadline exceeded``
+instead of burning device time); overload sheds the oldest requests with
+explicit shed errors instead of silent trims; SIGTERM drains (finish
+in-flight, flush, terminal ``health.json``) instead of dropping; and
+``reload_model`` hot-swaps the model off the serve path with a canary
+predict and rollback. ``health_snapshot()`` is the deep-health surface
+(queue depth, claim age, in-flight, p50/p99, shed/expired/error counters)
+supervisors consume as a dict or as the periodically-written
+``config.health_path`` file."""
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..common import faults
+from ..common import faults, file_io
 from ..inference.inference_model import InferenceModel
 from .config import ServingConfig
 from .queues import QueueBackend, decode_image, make_queue
 
 logger = logging.getLogger("analytics_zoo_tpu.serving")
+
+#: canonical terminal error texts (clients match on these)
+SHED_ERROR = "shed: queue overloaded"
+DEADLINE_ERROR = "deadline exceeded"
+SHUTDOWN_ERROR = "serving shut down before this request completed"
+
+
+class ModelReloadError(RuntimeError):
+    """``reload_model`` failed; the PREVIOUS model is still serving."""
 
 
 def top_n(probs: np.ndarray, n: int) -> List[Dict[str, float]]:
@@ -30,6 +54,11 @@ def top_n(probs: np.ndarray, n: int) -> List[Dict[str, float]]:
 
 
 class ClusterServing:
+    #: min seconds between shed passes — a shed scans the backlog, and
+    #: re-scanning every 5ms claim poll would double the spool listings
+    #: (expensive on remote spools) for no added protection
+    SHED_INTERVAL_S = 0.05
+
     def __init__(self, config: ServingConfig,
                  model: Optional[InferenceModel] = None,
                  queue: Optional[QueueBackend] = None):
@@ -42,18 +71,36 @@ class ClusterServing:
         # it — tests assert no NEW compile on the first request)
         self.prewarmed = self._prewarm_model()
         self._stop = threading.Event()
+        self._draining = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._pool = None
         self.records_served = 0
         self.device_seconds = 0.0  # dispatch→fetch time across batches
+        # -- SLO bookkeeping --------------------------------------------------
+        self.counters: Dict[str, int] = {
+            "shed": 0, "expired": 0, "errors": 0, "claim_faults": 0,
+            "reloads": 0, "reload_failures": 0}
+        self._counter_lock = threading.Lock()
+        self._in_flight = 0  # claimed, no terminal result yet
+        self._meta: Dict[str, float] = {}  # uri -> enqueue_t (latency base)
+        self._latencies: deque = deque(maxlen=1024)  # terminal latencies, ms
+        self._ewma_record_s = 0.0  # smoothed device seconds per record
+        self._last_claim_m: Optional[float] = None  # monotonic
+        self._last_health_m = -1e18
+        self._last_shed_m = -1e18
+        self._claim_fail_streak = 0
+        self._loop_running = False
+        self._terminal_state: Optional[str] = None
+        self._reload_lock = threading.Lock()
         self._writer = None
         if config.log_dir:
             from ..utils.tensorboard import SummaryWriter
             self._writer = SummaryWriter(
                 os.path.join(config.log_dir, "serving"))
 
-    def _load_model(self) -> InferenceModel:
-        cfg = self.config
+    def _load_model(self, cfg: Optional[ServingConfig] = None
+                    ) -> InferenceModel:
+        cfg = cfg if cfg is not None else self.config
         im = InferenceModel(concurrent_num=cfg.concurrent_num)
         if cfg.model_type == "zoo":
             im.load_zoo(cfg.model_path)
@@ -73,19 +120,24 @@ class ClusterServing:
             im.quantize(cfg.quantize)
         return im
 
-    def _prewarm_model(self) -> bool:
-        """AOT-compile the configured ``batch_size`` bucket at startup.
-        The example batch mirrors what ``_prepare`` produces: image records
+    def _example_batch(self) -> np.ndarray:
+        """A zeros batch shaped like ``_prepare``'s output: image records
         decode to ``image_shape`` arrays (uint8 or float32 per
-        ``input_dtype``), tensor records are always float32. A model whose
-        forward rejects a zeros batch just logs and compiles lazily."""
+        ``input_dtype``), tensor records are always float32."""
         cfg = self.config
-        if not getattr(self.model, "prewarm", None):
-            return False
         dtype = np.uint8 if cfg.input_dtype == "uint8" else np.float32
-        example = np.zeros((cfg.batch_size,) + tuple(cfg.image_shape), dtype)
+        return np.zeros((cfg.batch_size,) + tuple(cfg.image_shape), dtype)
+
+    def _prewarm_model(self, model: Optional[InferenceModel] = None) -> bool:
+        """AOT-compile the configured ``batch_size`` bucket at startup.
+        A model whose forward rejects a zeros batch just logs and compiles
+        lazily."""
+        model = model if model is not None else self.model
+        if not getattr(model, "prewarm", None):
+            return False
         try:
-            self.model.prewarm(example, buckets=(cfg.batch_size,))
+            model.prewarm(self._example_batch(),
+                          buckets=(self.config.batch_size,))
             return True
         except Exception:
             logger.exception(
@@ -123,42 +175,191 @@ class ClusterServing:
                 thread_name_prefix="zoo-serving-decode")
         return self._pool
 
+    def _shutdown_pool(self) -> None:
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
+
+    # -- SLO bookkeeping ------------------------------------------------------
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._counter_lock:
+            self.counters[key] = self.counters.get(key, 0) + n
+
+    def _expiry(self, rec: Dict[str, Any]) -> Optional[float]:
+        """Absolute wall-clock expiry for a record, or None when it has no
+        deadline. Wall clock is deliberate: ``enqueue_t`` is stamped by the
+        CLIENT process and the wall is the only clock two processes share;
+        every purely-local interval in this file uses ``time.monotonic()``."""
+        deadline_ms = rec.get("deadline_ms") or self.config.default_deadline_ms
+        if not deadline_ms:
+            return None
+        t0 = rec.get("enqueue_t")
+        base = float(t0) if t0 is not None else time.time()
+        return base + float(deadline_ms) / 1000.0
+
+    def _post_terminal(self, uri: str, value: Dict[str, Any]) -> None:
+        """Every claimed request funnels its ONE terminal result (value or
+        error) through here — latency and in-flight accounting included."""
+        try:
+            self.queue.put_result(uri, value)
+        except Exception:
+            logger.exception("posting result for %s failed", uri)
+        with self._counter_lock:
+            self._in_flight = max(0, self._in_flight - 1)
+            t0 = self._meta.pop(uri, None)
+            if t0 is not None:
+                self._latencies.append((time.time() - t0) * 1000.0)
+
+    def _error_batch(self, uris: List[str], message: str,
+                     counter: str = "errors") -> None:
+        for uri in uris:
+            self._post_terminal(uri, {"error": message})
+        if uris:
+            self._count(counter, len(uris))
+
     # -- pipeline stages ------------------------------------------------------
 
-    def _claim(self) -> List:
-        """Claim up to one micro-batch, honoring the batch-wait deadline and
-        the backpressure trim guard."""
+    def _shed(self) -> None:
+        """Erroring admission control (replaces the silent trim): every
+        dropped request gets an explicit shed error result. Two knobs:
+        ``max_pending`` caps absolute depth; ``shed_wait_ms`` caps the
+        ESTIMATED WAIT of the queue tail (depth x smoothed per-record
+        service time) so a slow model sheds earlier than a fast one."""
+        now = time.monotonic()
+        if now - self._last_shed_m < self.SHED_INTERVAL_S:
+            return
+        self._last_shed_m = now
         cfg = self.config
-        dropped = self.queue.trim(cfg.max_pending)
+        allowed = cfg.max_pending
+        if cfg.shed_wait_ms:
+            with self._counter_lock:
+                per_record_s = self._ewma_record_s
+            if per_record_s > 0:
+                allowed = min(allowed, max(
+                    cfg.batch_size,
+                    int(cfg.shed_wait_ms / 1000.0 / per_record_s)))
+        try:
+            dropped = self.queue.shed(allowed, reason=SHED_ERROR)
+        except OSError as e:
+            logger.warning("shed pass failed (transient): %r", e)
+            return
         if dropped:
-            logger.warning("backpressure: dropped %d oldest requests", dropped)
-        deadline = time.time() + cfg.batch_wait_ms / 1000.0
-        batch: List = []
-        while len(batch) < cfg.batch_size and time.time() < deadline:
-            got = self.queue.claim_batch(cfg.batch_size - len(batch))
+            self._count("shed", len(dropped))
+            logger.warning(
+                "overload: shed %d oldest requests with error results "
+                "(allowed depth %d)", len(dropped), allowed)
+
+    def _claim(self) -> List[Tuple[str, Dict[str, Any]]]:
+        """Claim up to one micro-batch: shed first, then fill the batch
+        within the ``batch_wait_ms`` window on the MONOTONIC clock (a
+        wall-clock step must not warp the batch window). A transient
+        claim failure (flaky backend, injected ``serving.claim`` fault) is
+        absorbed and retried; ``claim_retries`` consecutive failures
+        surface the backend as dead."""
+        cfg = self.config
+        self._shed()
+        deadline = time.monotonic() + cfg.batch_wait_ms / 1000.0
+        batch: List[Tuple[str, Dict[str, Any]]] = []
+        while len(batch) < cfg.batch_size and time.monotonic() < deadline:
+            try:
+                # chaos site: a flaky queue backend must be retried, not
+                # kill the serve loop
+                faults.inject("serving.claim")
+                got = self.queue.claim_batch(cfg.batch_size - len(batch))
+                self._claim_fail_streak = 0
+            except OSError as e:
+                self._count("claim_faults")
+                self._claim_fail_streak += 1
+                if self._claim_fail_streak > cfg.claim_retries:
+                    raise  # dead backend, not a flaky one: surface it
+                logger.warning("transient claim failure (%d/%d): %r",
+                               self._claim_fail_streak, cfg.claim_retries, e)
+                time.sleep(0.002)
+                continue
             if got:
+                self._last_claim_m = time.monotonic()
                 batch.extend(got)
             elif not batch:
-                return []  # nothing pending at all
+                break  # nothing pending at all
             else:
                 time.sleep(0.001)
+        if batch:
+            now = time.time()
+            with self._counter_lock:
+                self._in_flight += len(batch)
+                for uri, rec in batch:
+                    self._meta[uri] = float(rec.get("enqueue_t") or now)
         return batch
+
+    def _filter_expired(self, batch: List[Tuple[str, Dict[str, Any]]]
+                        ) -> List[Tuple[str, Dict[str, Any]]]:
+        """Deadline check at claim: already-expired records answer the
+        deadline error immediately — no decode, no device time."""
+        if not batch:
+            return batch
+        now = time.time()
+        live, expired = [], []
+        for uri, rec in batch:
+            exp = self._expiry(rec)
+            (expired if exp is not None and now >= exp
+             else live).append((uri, rec))
+        if expired:
+            self._error_batch([u for u, _ in expired], DEADLINE_ERROR,
+                              counter="expired")
+        return live
 
     def _decode(self, batch: List):
         """Decode a claimed batch on the thread pool (cv2 releases the GIL);
-        undecodable records become error results immediately."""
-        uris, arrays, errors = [], [], []
-        futures = [(uri, self._decode_pool().submit(self._prepare, rec))
+        undecodable records become error results immediately, and records
+        whose deadline expired DURING decode answer the deadline error
+        instead of riding to the device."""
+        uris, arrays, expiries = [], [], []
+        errors, expired = [], []
+        futures = [(uri, rec, self._decode_pool().submit(self._prepare, rec))
                    for uri, rec in batch]
-        for uri, fut in futures:
+        for uri, rec, fut in futures:
             try:
-                arrays.append(fut.result())
-                uris.append(uri)
+                arr = fut.result()
             except Exception as e:  # undecodable record → error result
                 errors.append((uri, str(e)))
+                continue
+            exp = self._expiry(rec)
+            if exp is not None and time.time() >= exp:
+                expired.append(uri)
+                continue
+            uris.append(uri)
+            arrays.append(arr)
+            expiries.append(exp)
         for uri, msg in errors:
-            self.queue.put_result(uri, {"error": msg})
-        return uris, arrays
+            self._post_terminal(uri, {"error": msg})
+        if errors:
+            self._count("errors", len(errors))
+        self._error_batch(expired, DEADLINE_ERROR, counter="expired")
+        return uris, arrays, expiries
+
+    def _expire_before_dispatch(self, uris: List[str], x: np.ndarray,
+                                expiries: List[Optional[float]]):
+        """Last deadline check, right before device dispatch — queueing
+        inside the pipeline must not launder expired work onto the chip."""
+        now = time.time()
+        keep = [i for i, e in enumerate(expiries) if e is None or now < e]
+        if len(keep) == len(uris):
+            return uris, x
+        kept = set(keep)
+        self._error_batch([u for i, u in enumerate(uris) if i not in kept],
+                          DEADLINE_ERROR, counter="expired")
+        if not keep:
+            return [], x[:0]
+        return [uris[i] for i in keep], x[keep]
+
+    def _dispatch(self, x: np.ndarray):
+        """Async device dispatch for one decoded batch. Single choke point
+        for the ``serving.predict`` chaos site: callers catch any failure
+        and post per-uri error results so one bad batch cannot take the
+        loop (or its batch's clients) down with it."""
+        faults.inject("serving.predict")
+        return self.model.predict_async(x)
 
     def _writeback(self, uris: List[str], probs: np.ndarray,
                    device_elapsed: float) -> None:
@@ -169,11 +370,17 @@ class ClusterServing:
         for uri, p in zip(uris, probs):
             p = np.asarray(p).reshape(-1)
             if cfg.filter_top_n:
-                self.queue.put_result(uri, {"topN": top_n(p, cfg.filter_top_n)})
+                self._post_terminal(uri, {"topN": top_n(p, cfg.filter_top_n)})
             else:
-                self.queue.put_result(uri, {"value": p.tolist()})
+                self._post_terminal(uri, {"value": p.tolist()})
         self.records_served += len(uris)
         self.device_seconds += device_elapsed
+        if uris:
+            per = device_elapsed / len(uris)
+            with self._counter_lock:
+                self._ewma_record_s = (
+                    per if self._ewma_record_s == 0.0
+                    else 0.8 * self._ewma_record_s + 0.2 * per)
         if self._writer is not None:
             self._writer.add_scalar("Serving Throughput",
                                     len(uris) / max(device_elapsed, 1e-9),
@@ -198,32 +405,174 @@ class ClusterServing:
                     continue
                 if item is None:
                     continue
-                uris = item[0]
-                for uri in uris:
-                    try:
-                        self.queue.put_result(
-                            uri, {"error": "serving shut down before this "
-                                           "request completed"})
-                    except Exception:
-                        pass
+                self._error_batch(list(item[0]), SHUTDOWN_ERROR)
+
+    # -- deep health ----------------------------------------------------------
+
+    def health_snapshot(self) -> Dict[str, Any]:
+        """Structured deep-health snapshot: lifecycle state, queue depth,
+        last-claim age, in-flight count, p50/p99 terminal latency, and the
+        shed/expired/error counters. Supervisors consume the same dict as
+        the periodically-written ``config.health_path`` file; tests consume
+        it directly. (``check_health()`` remains the narrow liveness probe
+        that re-raises a crashed background loop.)"""
+        with self._counter_lock:
+            counters = dict(self.counters)
+            in_flight = self._in_flight
+            lat = sorted(self._latencies)
+
+        def _pct(p: float) -> Optional[float]:
+            if not lat:
+                return None
+            return round(lat[min(len(lat) - 1, int(p * (len(lat) - 1)))], 3)
+
+        err = getattr(self, "_background_error", None)
+        if self._terminal_state is not None:
+            state = self._terminal_state
+        elif err is not None:
+            state = "crashed"
+        elif self._draining.is_set():
+            state = "draining"
+        elif self._loop_running or (self._thread is not None
+                                    and self._thread.is_alive()):
+            state = "running"
+        else:
+            state = "idle"
+        try:
+            pending = self.queue.pending_count()
+        except Exception:
+            pending = None
+        now_m = time.monotonic()
+        return {
+            "state": state,
+            "time": time.time(),
+            "queue_pending": pending,
+            "in_flight": in_flight,
+            "records_served": self.records_served,
+            "device_seconds": round(self.device_seconds, 4),
+            "last_claim_age_s": (round(now_m - self._last_claim_m, 3)
+                                 if self._last_claim_m is not None else None),
+            "latency_ms": {"p50": _pct(0.50), "p99": _pct(0.99),
+                           "window": len(lat)},
+            "counters": counters,
+            "prewarmed": self.prewarmed,
+            "error": repr(err) if err is not None else None,
+        }
+
+    def _write_health(self) -> None:
+        path = self.config.health_path
+        if not path:
+            return
+        tmp = path + ".tmp"
+        try:
+            with file_io.fopen(tmp, "w") as f:
+                f.write(json.dumps(self.health_snapshot()))
+            file_io.replace(tmp, path)  # atomic: readers never see a tear
+        except OSError:
+            logger.warning("health write to %s failed", path)
+
+    def _maybe_write_health(self) -> None:
+        if not self.config.health_path:
+            return
+        now = time.monotonic()
+        if now - self._last_health_m >= self.config.health_interval_s:
+            self._last_health_m = now
+            self._write_health()
+
+    # -- hot model reload -----------------------------------------------------
+
+    def reload_model(self, model_path: Optional[str] = None, *,
+                     model: Optional[InferenceModel] = None,
+                     model_type: Optional[str] = None) -> InferenceModel:
+        """Hot-swap the serving model with canary + rollback. The candidate
+        loads and prewarms OFF the serve path (the old model keeps serving
+        the whole time), canary-predicts one synthetic batch, and only then
+        swaps in — a single attribute store, atomic under the GIL, so no
+        request is ever dropped or misrouted: in-flight batches hold a
+        reference to whichever model dispatched them. ANY failure (load,
+        prewarm, canary, injected ``serving.reload`` chaos) leaves the old
+        model serving and raises :class:`ModelReloadError`."""
+        with self._reload_lock:
+            old = self.model
+            cfg = self.config
+            try:
+                # chaos site: a reload that dies anywhere must roll back
+                faults.inject("serving.reload")
+                if model is None:
+                    if model_path is None:
+                        raise ValueError(
+                            "reload_model needs model_path= or model=")
+                    import dataclasses
+                    model = self._load_model(dataclasses.replace(
+                        cfg, model_path=model_path,
+                        model_type=model_type or cfg.model_type))
+                # prewarm + canary off the serve path: the swap only
+                # happens once the candidate has proven it can answer
+                self._prewarm_model(model)
+                example = self._example_batch()
+                canary = model.predict(example)
+                import jax
+                leaves = jax.tree_util.tree_leaves(canary)
+                if not leaves:
+                    raise ValueError("canary predict returned no outputs")
+                for leaf in leaves:
+                    a = np.asarray(leaf)
+                    if a.shape[0] != cfg.batch_size:
+                        raise ValueError(
+                            f"canary predict returned leading dim "
+                            f"{a.shape[0]} for a batch of {cfg.batch_size}")
+                    if np.issubdtype(a.dtype, np.floating) \
+                            and not np.isfinite(a).all():
+                        raise ValueError(
+                            "canary predict produced non-finite values")
+                self.model = model  # atomic swap: next dispatch uses it
+                if model_path is not None:
+                    cfg.model_path = model_path
+                    if model_type:
+                        cfg.model_type = model_type
+                self._count("reloads")
+                logger.info("model reloaded%s",
+                            f" from {model_path}" if model_path else "")
+                return model
+            except Exception as e:
+                self.model = old  # rollback (no-op unless a partial swap)
+                self._count("reload_failures")
+                logger.exception(
+                    "model reload failed; previous model still serving")
+                raise ModelReloadError(
+                    f"model reload failed ({e!r}); previous model still "
+                    f"serving") from e
 
     # -- the serve loop -------------------------------------------------------
 
     def serve_once(self) -> int:
-        """One synchronous micro-batch (claim → decode → predict → writeback);
-        returns number of records served. ``run()`` pipelines these stages —
-        this method is the single-step form for tests and manual driving."""
+        """One synchronous micro-batch (claim → decode → predict →
+        writeback); returns the number of records claimed — every one of
+        them receives a terminal result (value, deadline error, decode
+        error, or predict error) before this returns. ``run()`` pipelines
+        these stages — this method is the single-step form for tests and
+        manual driving."""
         batch = self._claim()
+        self._maybe_write_health()
         if not batch:
             return 0
-        uris, arrays = self._decode(batch)
+        claimed = len(batch)
+        uris, arrays, expiries = self._decode(self._filter_expired(batch))
         if arrays:
             x = np.stack(arrays)
-            start = time.perf_counter()
-            probs = np.asarray(self.model.predict(x))
-            elapsed = time.perf_counter() - start
-            self._writeback(uris, probs, elapsed)
-        return len(batch)
+            uris, x = self._expire_before_dispatch(uris, x, expiries)
+            if uris:
+                start = time.perf_counter()
+                try:
+                    fetch = self._dispatch(x)
+                    probs = np.asarray(fetch())
+                    self._writeback(uris, probs,
+                                    time.perf_counter() - start)
+                except Exception as e:
+                    logger.exception("predict/writeback failed for %d "
+                                     "records", len(uris))
+                    self._error_batch(uris, repr(e))
+        return claimed
 
     def run(self, poll_interval_s: float = 0.005) -> None:
         """Pipelined serve loop: a claim+decode thread feeds the dispatch
@@ -236,6 +585,13 @@ class ClusterServing:
 
         logger.info("serving started (src=%s batch=%d)",
                     self.config.data_src, self.config.batch_size)
+        self._terminal_state = None
+        self._loop_running = True
+        # a fresh loop gets an immediate admission pass: a backlog that
+        # piled up while the server was down must shed BEFORE it is
+        # claimed, not ride through because the previous loop's shed
+        # stamp is still inside the interval gate
+        self._last_shed_m = -1e18
         decoded_q: "pyqueue.Queue" = pyqueue.Queue(maxsize=2)
         fetch_q: "pyqueue.Queue" = pyqueue.Queue(maxsize=2)
         errors: List[BaseException] = []
@@ -243,25 +599,37 @@ class ClusterServing:
 
         def _put(q: "pyqueue.Queue", item) -> bool:
             """Bounded put that can never wedge the pipeline: gives up when
-            the loop is stopping or a peer stage has died."""
+            the loop is stopping or a peer stage has died. Monotonic-clock
+            stall accounting — wall steps must not mask a wedged stage."""
+            start = time.monotonic()
             while not dead.is_set():
                 try:
                     q.put(item, timeout=0.2)
                     return True
                 except pyqueue.Full:
+                    if time.monotonic() - start > 30:
+                        logger.warning(
+                            "pipeline stage blocked handing off a batch "
+                            "for %.0fs", time.monotonic() - start)
+                        start = time.monotonic()
                     continue
             return False
 
         def decoder() -> None:
             try:
                 while not self._stop.is_set() and not dead.is_set():
-                    batch = self._claim()
+                    if self._draining.is_set():
+                        return  # drain: stop CLAIMING; sentinel flushes
+                    self._maybe_write_health()
+                    batch = self._filter_expired(self._claim())
                     if not batch:
                         time.sleep(poll_interval_s)
                         continue
-                    uris, arrays = self._decode(batch)
-                    if arrays and not _put(decoded_q, (uris,
-                                                       np.stack(arrays))):
+                    uris, arrays, expiries = self._decode(batch)
+                    if arrays and not _put(decoded_q,
+                                           (uris, np.stack(arrays),
+                                            expiries)):
+                        self._error_batch(uris, SHUTDOWN_ERROR)
                         return
             except BaseException as e:  # pragma: no cover - surfaced below
                 errors.append(e)
@@ -285,11 +653,7 @@ class ClusterServing:
                     # error results and keep draining
                     logger.exception("writeback failed for %d records",
                                      len(uris))
-                    for uri in uris:
-                        try:
-                            self.queue.put_result(uri, {"error": repr(e)})
-                        except Exception:
-                            pass
+                    self._error_batch(list(uris), repr(e))
 
         threads = [threading.Thread(target=decoder, daemon=True,
                                     name="zoo-serving-claim"),
@@ -302,18 +666,35 @@ class ClusterServing:
                 item = decoded_q.get()
                 if item is None:
                     break
-                uris, x = item
+                uris, x, expiries = item
+                uris, x = self._expire_before_dispatch(uris, x, expiries)
+                if not uris:
+                    continue
                 # async dispatch: the device computes while the NEXT batch
                 # decodes and the PREVIOUS batch's fetch+writeback runs
-                fetch = self.model.predict_async(x)
+                try:
+                    fetch = self._dispatch(x)
+                except Exception as e:
+                    logger.exception("dispatch failed for %d records",
+                                     len(uris))
+                    self._error_batch(uris, repr(e))
+                    continue
                 if not _put(fetch_q, (uris, fetch)):
+                    self._error_batch(uris, SHUTDOWN_ERROR)
                     break
         finally:
+            drained = (self._draining.is_set() and not dead.is_set()
+                       and not errors)
             self._stop.set()
             dead.set()
             self._force_sentinel(fetch_q)
             for t in threads:
                 t.join(timeout=10)
+            self._shutdown_pool()
+            self._loop_running = False
+            self._terminal_state = ("crashed" if errors
+                                    else "drained" if drained else "stopped")
+            self._write_health()
         if errors:
             raise errors[0]
         if self._writer is not None:
@@ -325,6 +706,8 @@ class ClusterServing:
         :meth:`stop` / :meth:`check_health` — a dead queue backend must not
         kill the server silently."""
         self._stop.clear()
+        self._draining.clear()
+        self._terminal_state = None
         self._background_error: Optional[BaseException] = None
 
         def _run() -> None:
@@ -340,12 +723,41 @@ class ClusterServing:
 
     def check_health(self) -> None:
         """Raise the background loop's failure, if any (liveness probe for
-        supervisors driving :meth:`start`)."""
+        supervisors driving :meth:`start`; :meth:`health_snapshot` is the
+        rich readiness/depth surface)."""
         err = getattr(self, "_background_error", None)
         if err is not None:
             raise RuntimeError("serving loop died in the background") from err
 
+    def drain(self, timeout_s: float = 30.0) -> None:
+        """Graceful shutdown, distinct from the hard :meth:`stop`: stop
+        CLAIMING new requests, finish every in-flight batch, flush all
+        results, then write the terminal ``health.json`` state. A drained
+        server has answered everything it ever claimed — zero shutdown
+        errors. Called on a foreground :meth:`run` (e.g. from the SIGTERM
+        handler) it just flags the loop, which unwinds and finalizes
+        itself."""
+        self._draining.set()
+        if self._loop_running and self._thread is None:
+            return  # foreground run(): the loop finalizes itself
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout_s)
+            if t.is_alive():
+                raise RuntimeError(
+                    f"drain did not complete within {timeout_s}s "
+                    f"({self._in_flight} requests still in flight)")
+            self._thread = None
+        self._shutdown_pool()
+        if self._terminal_state is None:
+            self._terminal_state = "drained"
+        self._write_health()
+        self.check_health()
+
     def stop(self) -> None:
+        """Hard stop: the loop exits as fast as it can; displaced in-flight
+        work is answered with explicit shutdown errors (never silently
+        dropped). Use :meth:`drain` for deploys."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
@@ -357,12 +769,18 @@ class ClusterServing:
                     "serving loop did not shut down within 10s (queue "
                     "backend wedged?); thread leaked")
             self._thread = None
+        self._shutdown_pool()
+        if self._terminal_state is None:
+            self._terminal_state = "stopped"
+        self._write_health()
         self.check_health()
 
 
 def main() -> None:
     """CLI entry (the ``cluster-serving-start`` role, packaged as
-    ``zoo-serving``): read a YAML config, write a pidfile, serve."""
+    ``zoo-serving``): read a YAML config, write a pidfile, serve. SIGTERM
+    drains (deploy-friendly: finish in-flight, flush, terminal health);
+    SIGINT stops hard."""
     import signal
     import sys
 
@@ -372,7 +790,7 @@ def main() -> None:
     # startup failure can't leave a stale pidfile for a supervisor to kill
     # an unrelated reused pid with
     serving = ClusterServing(cfg)
-    signal.signal(signal.SIGTERM, lambda *_: serving.stop())
+    signal.signal(signal.SIGTERM, lambda *_: serving.drain())
     signal.signal(signal.SIGINT, lambda *_: serving.stop())
     pidfile = os.environ.get("ZOO_SERVING_PIDFILE", "/tmp/zoo_serving.pid")
     try:

@@ -40,14 +40,20 @@ def main():
                         data_src=queue_src, batch_size=4, filter_top_n=2)
     serving = ClusterServing(cfg).start()
 
-    # 3. client: enqueue tensors, await results
+    # 3. client: enqueue tensors (with an answer-by budget), await results
     inq, outq = InputQueue(queue_src), OutputQueue(queue_src)
     for i in range(args.requests):
-        inq.enqueue_tensor(f"req-{i}", x[i])
+        inq.enqueue_tensor(f"req-{i}", x[i], deadline_ms=30_000)
     for i in range(args.requests):
         result = outq.query(f"req-{i}", timeout_s=30)
         print(f"req-{i}: {result}")
-    serving.stop()
+
+    # 4. deep health + graceful drain (what a deploy's SIGTERM runs):
+    # finish in-flight work, flush results, leave nothing unanswered
+    snap = serving.health_snapshot()
+    print(f"health: state={snap['state']} served={snap['records_served']} "
+          f"p99_ms={snap['latency_ms']['p99']} counters={snap['counters']}")
+    serving.drain()
 
 
 if __name__ == "__main__":

@@ -16,7 +16,10 @@ when any of the following drifts:
    shared by two call sites makes budgets/schedules ambiguous);
 4. every REGISTRY row has a live call site (no stale advertising);
 5. every site name appears in at least one file under ``tests/`` — i.e.
-   some test arms or asserts on it.
+   some test arms or asserts on it;
+6. every registered site is documented in ``docs/faults.md`` (the site
+   table is the operator's chaos-plan vocabulary — an undocumented site
+   is invisible to whoever writes ``faults.plan`` schedules).
 """
 from __future__ import annotations
 
@@ -107,6 +110,19 @@ def tests_mentioning(site: str) -> List[str]:
     return out
 
 
+_DOCS_FAULTS = os.path.join(_REPO, "docs", "faults.md")
+
+
+def undocumented_sites(registered: Set[str]) -> List[str]:
+    """Registered sites with no `` `site` `` mention in docs/faults.md."""
+    try:
+        with open(_DOCS_FAULTS) as fh:
+            text = fh.read()
+    except OSError:
+        return sorted(registered)
+    return sorted(s for s in registered if f"`{s}`" not in text)
+
+
 def check() -> List[str]:
     """Human-readable violations; empty = clean."""
     registered = registry_sites()
@@ -130,6 +146,10 @@ def check() -> List[str]:
         problems.append(
             f"REGISTRY advertises site {site!r} but no faults.inject("
             f"{site!r}) call exists in the codebase")
+    for site in undocumented_sites(registered):
+        problems.append(
+            f"site {site!r} is registered but undocumented — add a row to "
+            f"the site table in docs/faults.md")
     return problems
 
 
@@ -137,8 +157,8 @@ def main() -> int:
     problems = check()
     if not problems:
         print(f"fault-site lint: clean "
-              f"({len(registry_sites())} sites, all registered, unique "
-              f"and test-exercised)")
+              f"({len(registry_sites())} sites, all registered, unique, "
+              f"test-exercised and documented)")
         return 0
     for p in problems:
         print(p, file=sys.stderr)

@@ -1,8 +1,13 @@
 """RedisQueue wire contract, exercised against an in-memory fake that
 implements the redis-stream subset the queue uses (XADD/XREADGROUP/XACK/
-HSET/HGETALL/XLEN/XTRIM) — the reference's Redis contract
-(``serving/queues.py`` RedisQueue) previously had no test at all."""
+XAUTOCLAIM/XINFO GROUPS/HSET/HGETALL/XLEN/XTRIM) — the reference's Redis
+contract (``serving/queues.py`` RedisQueue) previously had no test at all.
+
+The fake models the PEL faithfully (owning consumer + idle clock): the
+at-most-once fix — ACK only after the result lands, XAUTOCLAIM reclaims
+entries from consumers that died mid-batch — is asserted against it."""
 import sys
+import time as _time
 import types
 
 import numpy as np
@@ -41,7 +46,9 @@ class FakeRedis:
             if not mkstream:
                 raise RuntimeError("NOGROUP no such stream")
             self.streams[stream] = []
-        self.groups.setdefault((stream, group), {"delivered": 0, "pel": set()})
+        # pel: eid -> [consumer, last_delivery_monotonic] — the real PEL's
+        # ownership + idle-time fields, which XAUTOCLAIM keys on
+        self.groups.setdefault((stream, group), {"delivered": 0, "pel": {}})
 
     def xreadgroup(self, group, consumer, streams, count=None, block=None):
         out = []
@@ -54,7 +61,9 @@ class FakeRedis:
             if count is not None:
                 fresh = fresh[:count]
             g["delivered"] += len(fresh)
-            g["pel"].update(eid for eid, _ in fresh)
+            now = _time.monotonic()
+            for eid, _ in fresh:
+                g["pel"][eid] = [consumer, now]
             if fresh:
                 out.append((stream.encode(), list(fresh)))
         return out
@@ -63,10 +72,42 @@ class FakeRedis:
         g = self.groups[(stream, group)]
         n = 0
         for eid in ids:
-            if eid in g["pel"]:
-                g["pel"].discard(eid)
+            if g["pel"].pop(eid, None) is not None:
                 n += 1
         return n
+
+    def xautoclaim(self, stream, group, consumer, min_idle_time=0,
+                   start_id="0-0", count=None):
+        """Reassign PEL entries idle past ``min_idle_time`` ms to
+        ``consumer`` (redis >= 6.2 semantics, (next, entries, deleted)
+        response shape)."""
+        g = self.groups[(stream, group)]
+        now = _time.monotonic()
+        out = []
+        for eid, meta in sorted(g["pel"].items()):
+            if (now - meta[1]) * 1000.0 < min_idle_time:
+                continue
+            fields = next((f for e, f in self.streams.get(stream, [])
+                           if e == eid), None)
+            if fields is None:
+                continue  # trimmed out from under the PEL
+            meta[0] = consumer
+            meta[1] = now
+            out.append((eid, fields))
+            if count is not None and len(out) >= count:
+                break
+        return (b"0-0", out, [])
+
+    def xinfo_groups(self, stream):
+        out = []
+        for (s, group), g in self.groups.items():
+            if s != stream:
+                continue
+            out.append({"name": group.encode(),
+                        "pending": len(g["pel"]),
+                        "lag": max(0, len(self.streams.get(stream, []))
+                                   - g["delivered"])})
+        return out
 
     def xlen(self, stream):
         return len(self.streams.get(stream, []))
@@ -144,6 +185,71 @@ class TestRedisQueueContract:
         from analytics_zoo_tpu.serving.queues import RedisQueue, make_queue
         q = make_queue("somehost:6379")
         assert isinstance(q, RedisQueue)
+
+
+class TestAtMostOnceFix:
+    """The claim→result window must not lose requests: XACK happens only
+    AFTER put_result lands, and entries stranded in a dead consumer's PEL
+    are XAUTOCLAIMed back onto a live one."""
+
+    def _pel(self):
+        inst = FakeRedis.instances[("localhost", 6379, 0)]
+        return inst.groups[("image_stream", "serving")]["pel"]
+
+    def test_ack_only_after_result_lands(self, fake_redis):
+        from analytics_zoo_tpu.serving.queues import RedisQueue
+        q = RedisQueue()
+        q.enqueue("a", {"tensor": [1.0]})
+        batch = q.claim_batch(10)
+        assert [u for u, _ in batch] == ["a"]
+        # claimed but unanswered: the entry is still pending (NOT acked)
+        assert len(self._pel()) == 1
+        q.put_result("a", {"value": [1.0]})
+        assert self._pel() == {}  # result landed → ack closed the loop
+
+    def test_crash_between_claim_and_result_redelivers(self, fake_redis):
+        """A server that claims a batch and dies before posting results
+        must NOT drop it forever: once the lease expires, another consumer
+        reclaims the pending entry and serves it."""
+        from analytics_zoo_tpu.serving.queues import RedisQueue
+        qa = RedisQueue(claim_lease_s=0.05)
+        qa.enqueue("a", {"tensor": [1.0]})
+        assert [u for u, _ in qa.claim_batch(10)] == ["a"]
+        # qa "crashes" here: no put_result, no ack
+        qb = RedisQueue(claim_lease_s=0.05)
+        assert qb.consumer != qa.consumer
+        assert qb.claim_batch(10) == []  # lease still live: no steal
+        import time
+        time.sleep(0.08)
+        got = qb.claim_batch(10)  # lease expired: XAUTOCLAIM redelivers
+        assert [u for u, _ in got] == ["a"]
+        qb.put_result("a", {"value": [1.0]})
+        assert self._pel() == {}
+        assert qb.claim_batch(10) == []  # settled: nothing redelivers
+
+    def test_pending_count_is_undelivered_backlog(self, fake_redis):
+        from analytics_zoo_tpu.serving.queues import RedisQueue
+        q = RedisQueue()
+        for i in range(4):
+            q.enqueue(f"u{i}", {"tensor": [i]})
+        assert q.pending_count() == 4
+        q.claim_batch(2)
+        # claimed-but-unacked entries are in flight, not queue backlog —
+        # admission control must not shed phantom load
+        assert q.pending_count() == 2
+
+    def test_shed_posts_error_results(self, fake_redis):
+        from analytics_zoo_tpu.serving.queues import RedisQueue
+        q = RedisQueue()
+        for i in range(10):
+            q.enqueue(f"u{i}", {"tensor": [i]})
+        dropped = q.shed(4)
+        assert dropped == [f"u{i}" for i in range(6)]  # oldest first
+        for u in dropped:
+            assert "overloaded" in q.get_result(u)["error"]
+        assert self._pel() == {}  # shed entries are settled, not pending
+        # the newest max_pending survive and serve normally
+        assert [u for u, _ in q.claim_batch(10)] == ["u6", "u7", "u8", "u9"]
 
 
 class TestServingOverFakeRedis:
