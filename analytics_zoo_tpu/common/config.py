@@ -193,6 +193,12 @@ _global_config.register("compile.cache_dir", "",
                         "('' = disabled). Warm processes skip XLA "
                         "recompiles of programs compiled by ANY earlier "
                         "process pointed at the same dir.")
+_global_config.register("metrics.enabled", True,
+                        "Record into the process-global metrics registry "
+                        "(common/metrics.py). False turns every counter/"
+                        "gauge/histogram record into a sub-microsecond "
+                        "no-op (the bench obs_overhead A/B baseline); "
+                        "serving health counters go dark too.")
 _global_config.register("mesh.data_axis", "data", "Default data-parallel mesh axis name.")
 _global_config.register("mesh.model_axis", "model", "Default model-parallel mesh axis name.")
 _global_config.register("rng.impl", "",

@@ -56,6 +56,8 @@ import zlib
 from dataclasses import dataclass
 from typing import Dict, Optional
 
+from . import metrics as _metrics
+
 __all__ = ["FaultInjected", "Site", "REGISTRY", "inject", "arm", "reset",
            "fire_count", "armed", "describe", "tear_snapshot"]
 
@@ -124,6 +126,16 @@ REGISTRY: Dict[str, Site] = {
 }
 
 
+#: per-site chaos telemetry (fork-safe shared-memory slots: a site fired
+#: inside a worker child shows up in the parent's exposition)
+_M_ARMED = _metrics.counter(
+    "fault.armed_total", "Fault-injection rules armed, by site.",
+    labels=("site",))
+_M_FIRED = _metrics.counter(
+    "fault.fired_total", "Fault-injection firings, by site.",
+    labels=("site",))
+
+
 class _Rule:
     """One armed schedule for one site. Budget and fire counters live in
     shared memory so fork-inherited copies (worker children) coordinate
@@ -160,6 +172,7 @@ class _Rule:
             self.budget.value -= 1
         with self.fired.get_lock():
             self.fired.value += 1
+        _M_FIRED.labels(site=self.site).inc()
         return True
 
 
@@ -203,7 +216,10 @@ def _sync_plan() -> None:
             if not spec:
                 raise ValueError(f"faults.plan entry {entry!r} needs a "
                                  f"'site:spec' form")
-            _rules.setdefault(site, _parse_spec(site, spec, seed))
+            if site not in _rules:
+                _rules[site] = _parse_spec(site, spec, seed)
+                _M_ARMED.labels(site=site).inc()
+                _M_FIRED.labels(site=site)  # pre-fork slot for children
         _plan_cache = plan
 
 
@@ -217,6 +233,10 @@ def arm(site: str, at: Optional[int] = None, p: Optional[float] = None,
                          f"{sorted(REGISTRY)}")
     with _lock:
         _rules[site] = _Rule(site, at=at, p=p, budget=budget, seed=seed)
+    _M_ARMED.labels(site=site).inc()
+    # allocate the fired-counter slot NOW, before any fork: a child firing
+    # this site writes to a slot the parent's exposition already knows
+    _M_FIRED.labels(site=site)
 
 
 def reset() -> None:

@@ -56,7 +56,10 @@ def time_it(name: str, log: bool = False) -> Iterator[None]:
     finally:
         elapsed = time.perf_counter() - start
         timers.add(name, elapsed)
-        for hook in span_hooks:
+        # iterate a SNAPSHOT: a hook registered/removed concurrently from
+        # another thread must not break this in-flight span exit (list
+        # mutation during iteration raises / skips entries)
+        for hook in tuple(span_hooks):
             hook(name, start, elapsed)
         if log:
             logger.info("%s: %.3fms", name, elapsed * 1e3)

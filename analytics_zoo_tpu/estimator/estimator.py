@@ -42,6 +42,7 @@ import numpy as np
 import optax
 
 from ..common import faults, file_io
+from ..common import metrics as zoo_metrics
 from ..common.config import global_config
 from ..common.context import get_context
 from ..common.triggers import EveryEpoch, MaxEpoch, TrainingState, Trigger
@@ -71,6 +72,25 @@ class PreemptedError(RuntimeError):
         super().__init__(message)
         self.snapshot = snapshot
 
+
+#: train-loop + checkpoint telemetry (the shared registry every subsystem
+#: reports into — see docs/observability.md for the full metric table)
+_M_STEP = zoo_metrics.histogram(
+    "train.step_seconds",
+    "Train-step dispatch latency (device sync included only when the "
+    "loop syncs the loss).")
+_M_EXAMPLES = zoo_metrics.counter(
+    "train.examples_total", "Examples consumed by the train loop.")
+_M_CKPT_WRITE = zoo_metrics.histogram(
+    "ckpt.write_seconds", "Snapshot serialize+publish latency.")
+_M_CKPT_VERIFY = zoo_metrics.histogram(
+    "ckpt.verify_seconds", "Checksum-manifest verification latency.")
+_M_CKPT_RESTORE = zoo_metrics.histogram(
+    "ckpt.restore_seconds", "Snapshot restore latency (verify included).")
+_M_CKPT_FALLBACK = zoo_metrics.counter(
+    "ckpt.fallback_total",
+    "Restores that skipped a torn/corrupt newest snapshot and fell back "
+    "to an older one.")
 
 #: resumable-preemption marker filename, written next to the snapshots
 PREEMPT_MARKER = "PREEMPTED.json"
@@ -114,10 +134,12 @@ def _verify_manifest(local_dir: str, origin: str) -> bool:
     mpath = os.path.join(local_dir, _MANIFEST_NAME)
     if not os.path.exists(mpath):
         return False
+    t0 = time.perf_counter()
     with open(mpath) as f:
         manifest = json.load(f)
     want = {k: tuple(v) for k, v in manifest.get("files", {}).items()}
     have = {k: tuple(v) for k, v in _dir_checksums(local_dir).items()}
+    _M_CKPT_VERIFY.observe(time.perf_counter() - t0)
     if want != have:
         missing = sorted(set(want) - set(have))
         extra = sorted(set(have) - set(want))
@@ -762,6 +784,13 @@ class Estimator:
                                     "Throughput", global_batch / step_time,
                                     self.global_step)
 
+                    # telemetry: one histogram sample per dispatch (the
+                    # sync above is inside the window when it ran, so the
+                    # recorded time bounds this step's device work) + the
+                    # examples throughput counter
+                    _M_STEP.observe(time.perf_counter() - step_start)
+                    _M_EXAMPLES.inc(local_batch * g)
+
                     state.epoch_finished = epoch_iter >= batches_per_epoch
                     # boundaries CROSSED by this dispatch (g > 1 can jump
                     # over several sub-epoch slice marks at once)
@@ -1274,6 +1303,7 @@ class Estimator:
                 self.load_checkpoint(path)
                 return path
             except Exception:
+                _M_CKPT_FALLBACK.inc()
                 logger.exception(
                     "snapshot %s failed to restore; falling back to the "
                     "next older snapshot", path)
@@ -1310,11 +1340,16 @@ class Estimator:
         self._write_snapshot(path, self._snapshot_tree())
 
     def _write_snapshot(self, path: str, tree) -> None:
+        with time_it("ckpt.write"):
+            self._write_snapshot_impl(path, tree)
+
+    def _write_snapshot_impl(self, path: str, tree) -> None:
         import orbax.checkpoint as ocp
 
         # chaos site: a firing injection models the writer dying before
         # any publish — the previous snapshot must stay the newest intact
         faults.inject("ckpt.write")
+        write_t0 = time.perf_counter()
         import shutil
         ckptr = ocp.PyTreeCheckpointer()
         if file_io.is_remote(path):
@@ -1345,6 +1380,7 @@ class Estimator:
                 ckptr.save(final, tree, force=True)
                 if jax.process_index() == 0:  # one writer for the manifest
                     _write_manifest(final)
+                _M_CKPT_WRITE.observe(time.perf_counter() - write_t0)
                 return
             staging = final + ".writing"
             if os.path.exists(staging):  # leftover from a killed writer
@@ -1354,6 +1390,7 @@ class Estimator:
             if os.path.exists(final):
                 shutil.rmtree(final)
             os.rename(staging, final)  # atomic publish
+        _M_CKPT_WRITE.observe(time.perf_counter() - write_t0)
         # chaos site: tear the snapshot AFTER publish — the checksum
         # manifest must catch it at restore and fall back one older
         if faults.inject("ckpt.corrupt"):
@@ -1368,17 +1405,19 @@ class Estimator:
         # fence: an in-flight async write may be producing the newest
         # snapshot (or the very one being restored)
         self._ckpt_writer.wait()
+        restore_t0 = time.perf_counter()
         verify = bool(global_config().get("checkpoint.verify"))
         if file_io.is_remote(path):
             with file_io.localized(path, "r") as tmp:
                 if verify:
                     _verify_manifest(tmp, path)
                 self._load_checkpoint_local(os.path.join(tmp, "ckpt"))
-            return
-        local = os.path.abspath(file_io.local_path(path))
-        if verify:
-            _verify_manifest(local, path)
-        self._load_checkpoint_local(local)
+        else:
+            local = os.path.abspath(file_io.local_path(path))
+            if verify:
+                _verify_manifest(local, path)
+            self._load_checkpoint_local(local)
+        _M_CKPT_RESTORE.observe(time.perf_counter() - restore_t0)
 
     def _load_checkpoint_local(self, path: str) -> None:
         import orbax.checkpoint as ocp

@@ -21,16 +21,25 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Iterator, List, Optional
 
 import numpy as np
 from jax.sharding import Mesh
 
 from ..common import faults
+from ..common import metrics as _metrics
 from ..common.config import global_config
 from ..parallel.mesh import shard_batch
 
 _SENTINEL = object()
+
+#: accumulated consumer time blocked waiting on the producer — the train
+#: loop's "feed stall": nonzero growth here means the host data plane, not
+#: the device, is the bottleneck
+_M_STALL = _metrics.counter(
+    "train.feed_stall_seconds_total",
+    "Seconds the DeviceFeed consumer spent blocked on the host producer.")
 
 
 def masked_eval_batches(it: Iterator[Any], batch_size: int,
@@ -140,7 +149,9 @@ class DeviceFeed:
     def __next__(self):
         if self._stop.is_set():  # already exhausted or closed
             raise StopIteration
+        t0 = time.perf_counter()
         item = self._queue.get()
+        _M_STALL.inc(time.perf_counter() - t0)
         if item is _SENTINEL:
             self._stop.set()
             if self._errbox:

@@ -48,10 +48,22 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..common import faults
+from ..common import metrics as _metrics
+from ..common.utils import time_it
 
 _ALIGN = 128  # slab leaf alignment (cache-line / vector friendly)
 
 logger = logging.getLogger(__name__)
+
+#: task latency is observed INSIDE the forked child — the shared-memory
+#: metric slab (created before the fork) makes it visible to the parent's
+#: exposition, the proof-of-life for the registry's fork-safety
+_M_TASK = _metrics.histogram(
+    "worker.task_seconds",
+    "Transform-worker task latency (observed in the forked child).")
+_M_RESPAWN = _metrics.counter(
+    "worker.respawn_total",
+    "Transform workers respawned after dying mid-task (SIGKILL/OOM).")
 
 
 class TransformWorkerError(RuntimeError):
@@ -166,6 +178,8 @@ def _worker_main(wid, features, transform, slot_views, task_q,
       task to resubmit to the respawned replacement;
     - ``("done", tid, rows, err)`` on completion or error.
     """
+    from ..utils.trace import set_thread_label
+    set_thread_label(f"worker-{wid}")
     while True:
         task = task_q.get()
         if task is None:
@@ -179,10 +193,16 @@ def _worker_main(wid, features, transform, slot_views, task_q,
             if faults.inject("worker.kill"):
                 os.kill(os.getpid(), signal.SIGKILL)
             faults.inject("worker.task")
-            views = slot_views[slot]
-            for j, i in enumerate(idx):
-                rec = transform.apply(_index_tree(features, int(i)))
-                _write_record(views, row0 + j, rec)
+            t0 = time.perf_counter()
+            # the time_it span lands in any active trace session via the
+            # child-side spool, pid-tagged — worker activity is visible on
+            # the same Perfetto timeline as the consumer threads
+            with time_it("worker.task"):
+                views = slot_views[slot]
+                for j, i in enumerate(idx):
+                    rec = transform.apply(_index_tree(features, int(i)))
+                    _write_record(views, row0 + j, rec)
+            _M_TASK.observe(time.perf_counter() - t0)
             result_q.put(("done", task_id, len(idx), None))
         except BaseException:
             result_q.put(("done", task_id, 0, traceback.format_exc()))
@@ -301,6 +321,7 @@ class TransformWorkerPool:
                     f"(killed? OOM?) and the respawn budget is exhausted; "
                     f"raise data.worker_respawns to self-heal") from None
             self._respawns_left -= 1
+            _M_RESPAWN.inc()
             logger.warning(
                 "transform worker %d died with exit code %s; respawning "
                 "(%d respawns left) and resubmitting %d lost task(s)",

@@ -25,10 +25,19 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from ..common import metrics as _metrics
 from ..common.context import wire_compilation_cache
 from .quantize import dequantize_params, quantize_params
 
 _BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+#: process-wide XLA compile telemetry (per-model per-bucket detail stays in
+#: ``InferenceModel.compile_counts`` / ``compile_seconds``)
+_M_COMPILE = _metrics.counter(
+    "infer.compile_total", "XLA executables compiled by InferenceModel.")
+_M_COMPILE_S = _metrics.counter(
+    "infer.compile_seconds_total",
+    "Seconds spent in InferenceModel XLA compiles.")
 
 
 class _TextArtifact:
@@ -152,11 +161,13 @@ class InferenceModel:
                 exe = self._jit.lower(
                     self._params, list(xs) if is_multi else xs[0]).compile()
                 self._compiled[key] = exe
+                elapsed = time.perf_counter() - t0
                 self.compile_counts[bucket] = \
                     self.compile_counts.get(bucket, 0) + 1
                 self.compile_seconds[bucket] = \
-                    self.compile_seconds.get(bucket, 0.0) \
-                    + (time.perf_counter() - t0)
+                    self.compile_seconds.get(bucket, 0.0) + elapsed
+                _M_COMPILE.inc()
+                _M_COMPILE_S.inc(elapsed)
         return exe
 
     def prewarm(self, example,
@@ -202,11 +213,13 @@ class InferenceModel:
                     with art._lock:
                         if art._exe is None:
                             art._exe = art._compile()
+                            elapsed = time.perf_counter() - t0
                             self.compile_counts[b] = \
                                 self.compile_counts.get(b, 0) + 1
                             self.compile_seconds[b] = \
-                                self.compile_seconds.get(b, 0.0) \
-                                + (time.perf_counter() - t0)
+                                self.compile_seconds.get(b, 0.0) + elapsed
+                            _M_COMPILE.inc()
+                            _M_COMPILE_S.inc(elapsed)
                 # serialized jax.export artifacts load pre-compiled
             else:
                 self._ensure_compiled(shaped, is_multi, b)

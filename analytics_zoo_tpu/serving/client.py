@@ -15,6 +15,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ..utils import trace as _trace
 from .queues import FileQueue, QueueBackend, encode_image, make_queue
 
 
@@ -30,6 +31,12 @@ class InputQueue(_API):
         # wall clock on purpose: enqueue_t crosses a process boundary, and
         # monotonic clocks do not compare across processes
         payload["enqueue_t"] = time.time()
+        # every request carries a flow-chain id from birth: when a trace
+        # session is active (here or on the server), the Perfetto timeline
+        # draws enqueue→claim→decode→dispatch→result as one arrowed chain
+        flow_id = _trace.new_trace_id()
+        payload["trace_id"] = flow_id
+        _trace.flow_point(flow_id, "serving.enqueue", "s")
         if deadline_ms is not None:
             payload["deadline_ms"] = int(deadline_ms)
         return payload

@@ -19,18 +19,21 @@ supervisors consume as a dict or as the periodically-written
 ``config.health_path`` file."""
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import os
 import threading
 import time
-from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..common import faults, file_io
+from ..common import metrics as _metrics
+from ..common.utils import time_it
 from ..inference.inference_model import InferenceModel
+from ..utils import trace as _trace
 from .config import ServingConfig
 from .queues import QueueBackend, decode_image, make_queue
 
@@ -40,6 +43,50 @@ logger = logging.getLogger("analytics_zoo_tpu.serving")
 SHED_ERROR = "shed: queue overloaded"
 DEADLINE_ERROR = "deadline exceeded"
 SHUTDOWN_ERROR = "serving shut down before this request completed"
+
+#: SLO telemetry in the shared registry (common/metrics.py). Every family
+#: is labeled by server instance so two servers in one process (tests, the
+#: multi-server spool) keep separate series; ``health_snapshot()`` is a
+#: per-instance view of these.
+_M_COUNTERS = {
+    "shed": _metrics.counter(
+        "serving.shed_total", "Requests shed by admission control.",
+        labels=("server",)),
+    "expired": _metrics.counter(
+        "serving.expired_total", "Requests answered with deadline errors.",
+        labels=("server",)),
+    "errors": _metrics.counter(
+        "serving.error_total",
+        "Requests answered with non-deadline error results.",
+        labels=("server",)),
+    "claim_faults": _metrics.counter(
+        "serving.claim_fault_total", "Transient claim-stage failures.",
+        labels=("server",)),
+    "reloads": _metrics.counter(
+        "serving.reload_total", "Successful hot model reloads.",
+        labels=("server",)),
+    "reload_failures": _metrics.counter(
+        "serving.reload_failure_total",
+        "Model reloads that failed and rolled back.", labels=("server",)),
+}
+_M_RECORDS = _metrics.counter(
+    "serving.records_total", "Records answered with prediction values.",
+    labels=("server",))
+_M_LATENCY = _metrics.histogram(
+    "serving.request_latency_seconds",
+    "Enqueue-to-terminal-result latency (client-stamped enqueue_t).",
+    labels=("server",))
+_M_QUEUE_DEPTH = _metrics.gauge(
+    "serving.queue_depth", "Pending requests in the claim queue.",
+    labels=("server",))
+_M_IN_FLIGHT = _metrics.gauge(
+    "serving.in_flight", "Claimed requests without a terminal result yet.",
+    labels=("server",))
+_M_CLAIM_AGE = _metrics.gauge(
+    "serving.claim_age_seconds", "Seconds since the last successful claim.",
+    labels=("server",))
+
+_instance_ids = itertools.count()
 
 
 class ModelReloadError(RuntimeError):
@@ -77,13 +124,21 @@ class ClusterServing:
         self.records_served = 0
         self.device_seconds = 0.0  # dispatch→fetch time across batches
         # -- SLO bookkeeping --------------------------------------------------
-        self.counters: Dict[str, int] = {
-            "shed": 0, "expired": 0, "errors": 0, "claim_faults": 0,
-            "reloads": 0, "reload_failures": 0}
+        # counters/latency/gauges live in the process-global metrics
+        # registry, one label per server instance (health_snapshot() and
+        # the .counters property are views of it)
+        self.metrics_label = f"srv{next(_instance_ids)}"
+        self._m = {key: fam.labels(server=self.metrics_label)
+                   for key, fam in _M_COUNTERS.items()}
+        self._m_records = _M_RECORDS.labels(server=self.metrics_label)
+        self._m_latency = _M_LATENCY.labels(server=self.metrics_label)
+        self._m_depth = _M_QUEUE_DEPTH.labels(server=self.metrics_label)
+        self._m_in_flight = _M_IN_FLIGHT.labels(server=self.metrics_label)
+        self._m_claim_age = _M_CLAIM_AGE.labels(server=self.metrics_label)
         self._counter_lock = threading.Lock()
         self._in_flight = 0  # claimed, no terminal result yet
-        self._meta: Dict[str, float] = {}  # uri -> enqueue_t (latency base)
-        self._latencies: deque = deque(maxlen=1024)  # terminal latencies, ms
+        #: uri -> (enqueue_t, trace_id) — latency base + flow-chain id
+        self._meta: Dict[str, Tuple[float, Optional[int]]] = {}
         self._ewma_record_s = 0.0  # smoothed device seconds per record
         self._last_claim_m: Optional[float] = None  # monotonic
         self._last_health_m = -1e18
@@ -182,9 +237,24 @@ class ClusterServing:
 
     # -- SLO bookkeeping ------------------------------------------------------
 
+    @property
+    def counters(self) -> Dict[str, int]:
+        """Instance view of the registry-backed SLO counters (same keys the
+        old hand-rolled dict had, so supervisors/tests read it unchanged)."""
+        return {key: int(c.value()) for key, c in self._m.items()}
+
     def _count(self, key: str, n: int = 1) -> None:
+        self._m[key].inc(n)
+
+    def _flow_uris(self, uris: List[str], stage: str) -> None:
+        """Stamp one flow-chain point per uri (no-op unless a trace
+        session is active — the lookup cost stays off the hot path)."""
+        if not _trace.tracing():
+            return
         with self._counter_lock:
-            self.counters[key] = self.counters.get(key, 0) + n
+            ids = [self._meta.get(u, (0.0, None))[1] for u in uris]
+        for flow_id in ids:
+            _trace.flow_point(flow_id, stage, "t")
 
     def _expiry(self, rec: Dict[str, Any]) -> Optional[float]:
         """Absolute wall-clock expiry for a record, or None when it has no
@@ -207,9 +277,14 @@ class ClusterServing:
             logger.exception("posting result for %s failed", uri)
         with self._counter_lock:
             self._in_flight = max(0, self._in_flight - 1)
-            t0 = self._meta.pop(uri, None)
-            if t0 is not None:
-                self._latencies.append((time.time() - t0) * 1000.0)
+            in_flight = self._in_flight
+            meta = self._meta.pop(uri, None)
+        self._m_in_flight.set(in_flight)
+        if meta is not None:
+            t0, flow_id = meta
+            self._m_latency.observe(max(time.time() - t0, 0.0))
+            # flow terminus: the request's lifecycle chain ends here
+            _trace.flow_point(flow_id, "serving.result", "f")
 
     def _error_batch(self, uris: List[str], message: str,
                      counter: str = "errors") -> None:
@@ -288,8 +363,15 @@ class ClusterServing:
             now = time.time()
             with self._counter_lock:
                 self._in_flight += len(batch)
+                in_flight = self._in_flight
                 for uri, rec in batch:
-                    self._meta[uri] = float(rec.get("enqueue_t") or now)
+                    self._meta[uri] = (float(rec.get("enqueue_t") or now),
+                                       rec.get("trace_id"))
+            self._m_in_flight.set(in_flight)
+            if _trace.tracing():
+                for uri, rec in batch:
+                    _trace.flow_point(rec.get("trace_id"),
+                                      "serving.claim", "t")
         return batch
 
     def _filter_expired(self, batch: List[Tuple[str, Dict[str, Any]]]
@@ -316,21 +398,27 @@ class ClusterServing:
         instead of riding to the device."""
         uris, arrays, expiries = [], [], []
         errors, expired = [], []
-        futures = [(uri, rec, self._decode_pool().submit(self._prepare, rec))
-                   for uri, rec in batch]
-        for uri, rec, fut in futures:
-            try:
-                arr = fut.result()
-            except Exception as e:  # undecodable record → error result
-                errors.append((uri, str(e)))
-                continue
-            exp = self._expiry(rec)
-            if exp is not None and time.time() >= exp:
-                expired.append(uri)
-                continue
-            uris.append(uri)
-            arrays.append(arr)
-            expiries.append(exp)
+        tracing = _trace.tracing()
+        with time_it("serving.decode_batch"):
+            futures = [(uri, rec,
+                        self._decode_pool().submit(self._prepare, rec))
+                       for uri, rec in batch]
+            for uri, rec, fut in futures:
+                try:
+                    arr = fut.result()
+                except Exception as e:  # undecodable record → error result
+                    errors.append((uri, str(e)))
+                    continue
+                if tracing:
+                    _trace.flow_point(rec.get("trace_id"),
+                                      "serving.decode", "t")
+                exp = self._expiry(rec)
+                if exp is not None and time.time() >= exp:
+                    expired.append(uri)
+                    continue
+                uris.append(uri)
+                arrays.append(arr)
+                expiries.append(exp)
         for uri, msg in errors:
             self._post_terminal(uri, {"error": msg})
         if errors:
@@ -359,7 +447,8 @@ class ClusterServing:
         and post per-uri error results so one bad batch cannot take the
         loop (or its batch's clients) down with it."""
         faults.inject("serving.predict")
-        return self.model.predict_async(x)
+        with time_it("serving.dispatch_batch"):
+            return self.model.predict_async(x)
 
     def _writeback(self, uris: List[str], probs: np.ndarray,
                    device_elapsed: float) -> None:
@@ -367,12 +456,15 @@ class ClusterServing:
         # server draining (the writeback thread's per-batch catch)
         faults.inject("serving.writeback")
         cfg = self.config
-        for uri, p in zip(uris, probs):
-            p = np.asarray(p).reshape(-1)
-            if cfg.filter_top_n:
-                self._post_terminal(uri, {"topN": top_n(p, cfg.filter_top_n)})
-            else:
-                self._post_terminal(uri, {"value": p.tolist()})
+        with time_it("serving.writeback_batch"):
+            for uri, p in zip(uris, probs):
+                p = np.asarray(p).reshape(-1)
+                if cfg.filter_top_n:
+                    self._post_terminal(uri,
+                                        {"topN": top_n(p, cfg.filter_top_n)})
+                else:
+                    self._post_terminal(uri, {"value": p.tolist()})
+        self._m_records.inc(len(uris))
         self.records_served += len(uris)
         self.device_seconds += device_elapsed
         if uris:
@@ -415,16 +507,21 @@ class ClusterServing:
         shed/expired/error counters. Supervisors consume the same dict as
         the periodically-written ``config.health_path`` file; tests consume
         it directly. (``check_health()`` remains the narrow liveness probe
-        that re-raises a crashed background loop.)"""
+        that re-raises a crashed background loop.)
+
+        This is a per-instance VIEW of the shared metrics registry
+        (``common.metrics.metrics_snapshot()``): the counters and the
+        latency histogram live there, scrapable as Prometheus text via the
+        ``metrics.prom`` file written next to ``health.json``. On an empty
+        latency window ``p50``/``p99`` are ``null`` — never a fake
+        ``0.0`` (see docs/observability.md)."""
         with self._counter_lock:
-            counters = dict(self.counters)
             in_flight = self._in_flight
-            lat = sorted(self._latencies)
+        counters = self.counters
 
         def _pct(p: float) -> Optional[float]:
-            if not lat:
-                return None
-            return round(lat[min(len(lat) - 1, int(p * (len(lat) - 1)))], 3)
+            v = self._m_latency.percentile(p)
+            return None if v is None else round(v * 1e3, 3)
 
         err = getattr(self, "_background_error", None)
         if self._terminal_state is not None:
@@ -443,6 +540,15 @@ class ClusterServing:
         except Exception:
             pending = None
         now_m = time.monotonic()
+        claim_age = (round(now_m - self._last_claim_m, 3)
+                     if self._last_claim_m is not None else None)
+        # refresh the point-in-time gauges on the same cadence the
+        # snapshot is taken (scrapers read them from metrics.prom)
+        if pending is not None:
+            self._m_depth.set(pending)
+        self._m_in_flight.set(in_flight)
+        if claim_age is not None:
+            self._m_claim_age.set(claim_age)
         return {
             "state": state,
             "time": time.time(),
@@ -450,10 +556,9 @@ class ClusterServing:
             "in_flight": in_flight,
             "records_served": self.records_served,
             "device_seconds": round(self.device_seconds, 4),
-            "last_claim_age_s": (round(now_m - self._last_claim_m, 3)
-                                 if self._last_claim_m is not None else None),
+            "last_claim_age_s": claim_age,
             "latency_ms": {"p50": _pct(0.50), "p99": _pct(0.99),
-                           "window": len(lat)},
+                           "window": self._m_latency.count()},
             "counters": counters,
             "prewarmed": self.prewarmed,
             "error": repr(err) if err is not None else None,
@@ -470,6 +575,18 @@ class ClusterServing:
             file_io.replace(tmp, path)  # atomic: readers never see a tear
         except OSError:
             logger.warning("health write to %s failed", path)
+        # Prometheus exposition rides the same cadence: metrics.prom next
+        # to health.json, for a node-exporter textfile collector / sidecar
+        sep = "/" if "/" in path or "://" in path else os.sep
+        prom = path.rsplit(sep, 1)[0] + sep + "metrics.prom" \
+            if sep in path else "metrics.prom"
+        tmp = prom + ".tmp"
+        try:
+            with file_io.fopen(tmp, "w") as f:
+                f.write(_metrics.expose_text())
+            file_io.replace(tmp, prom)
+        except OSError:
+            logger.warning("metrics write to %s failed", prom)
 
     def _maybe_write_health(self) -> None:
         if not self.config.health_path:
@@ -564,6 +681,7 @@ class ClusterServing:
             if uris:
                 start = time.perf_counter()
                 try:
+                    self._flow_uris(uris, "serving.dispatch")
                     fetch = self._dispatch(x)
                     probs = np.asarray(fetch())
                     self._writeback(uris, probs,
@@ -673,6 +791,7 @@ class ClusterServing:
                 # async dispatch: the device computes while the NEXT batch
                 # decodes and the PREVIOUS batch's fetch+writeback runs
                 try:
+                    self._flow_uris(uris, "serving.dispatch")
                     fetch = self._dispatch(x)
                 except Exception as e:
                     logger.exception("dispatch failed for %d records",

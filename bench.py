@@ -1148,9 +1148,13 @@ def bench_serving_slo(requests: int = 360, batch_size: int = 16):
     ok = sum(1 for r in answered.values() if "value" in r)
     shed = snap["counters"]["shed"]
     expired = snap["counters"]["expired"]
+    # an empty latency window reads p50/p99 = null BY CONTRACT (see
+    # docs/observability.md) — possible here only if every request shed
+    # before claim; the headline metric must stay numeric for parsers
+    p99 = snap["latency_ms"]["p99"]
     return _BenchResult(
         metric="serving_slo_p99_ms",
-        value=snap["latency_ms"]["p99"],
+        value=p99 if p99 is not None else 0.0,
         unit="ms", mfu=None,
         detail={"requests": total, "batch_size": batch_size,
                 "capacity_records_per_sec": round(cap_rps, 1),
@@ -1158,6 +1162,7 @@ def bench_serving_slo(requests: int = 360, batch_size: int = 16):
                 "wall_records_per_sec": round(total / wall, 1),
                 "p50_ms": snap["latency_ms"]["p50"],
                 "p99_ms": snap["latency_ms"]["p99"],
+                "latency_window": snap["latency_ms"]["window"],
                 "served_ok": ok,
                 "shed_rate": round(shed / total, 4),
                 "deadline_miss_rate": round(expired / total, 4),
@@ -1168,6 +1173,174 @@ def bench_serving_slo(requests: int = 360, batch_size: int = 16):
                         "errors under the 3x phase are the admission "
                         "control working as designed — deadline_ms=2000, "
                         "max_pending=4 batches"})
+
+
+def bench_obs_overhead(batch_size: int = 256, steps_per_epoch: int = 16,
+                       d: int = 64, rounds: int = 3):
+    """Telemetry-plane cost, measured end to end.
+
+    Part 1 — train-loop A/B: identical epochs with (a) the metrics
+    registry disabled and no trace session vs (b) the full registry
+    enabled AND a live chrome-trace session recording every span. The
+    headline is the throughput delta (%); the target is < 2% — telemetry
+    that taxes the hot path more than that would get turned off in
+    production and rot. Rounds interleave a/b and take medians so the
+    number is a property of the code, not of which half of the run the
+    host's background noise landed in.
+
+    Part 2 — a traced serving soak (threaded pipeline loop + a concurrent
+    forked transform-worker pool, the unified-platform shape): the dumped
+    trace must be Perfetto-loadable, contain at least one COMPLETE
+    enqueue→claim→decode→dispatch→result flow chain, and carry spans from
+    >= 2 pids (the forked workers). Gated before any number is published.
+    """
+    import json as json_mod
+    import tempfile
+
+    from analytics_zoo_tpu.common import metrics as zoo_metrics
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.estimator import Estimator
+    from analytics_zoo_tpu.feature import FeatureSet
+    from analytics_zoo_tpu.keras import Sequential, objectives, optimizers
+    from analytics_zoo_tpu.keras.layers import Dense
+    from analytics_zoo_tpu.utils.trace import trace
+
+    ctx = init_tpu_context()
+    batch_size = max(ctx.num_devices,
+                     (batch_size // ctx.num_devices) * ctx.num_devices)
+    n = batch_size * steps_per_epoch
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, d).astype(np.float32)
+    y = (x.sum(1) > d / 2).astype(np.float32)
+    est = Estimator(
+        model=Sequential([Dense(256, activation="relu"), Dense(2)]),
+        loss_fn=objectives.get("sparse_categorical_crossentropy"),
+        optimizer=optimizers.SGD(0.1))
+    fs = FeatureSet.from_ndarrays(x, y, shuffle=False)
+    est.train(fs, batch_size=batch_size, epochs=1)  # compile warmup
+
+    tdir = tempfile.mkdtemp(prefix="zoo_bench_obs_")
+    reg = zoo_metrics.default_registry()
+
+    def epoch_off():
+        reg.set_enabled(False)
+        try:
+            t0 = time.perf_counter()
+            est.train(fs, batch_size=batch_size, epochs=1)
+            return time.perf_counter() - t0
+        finally:
+            reg.set_enabled(True)
+
+    _trace_n = iter(range(10 ** 6))
+
+    def epoch_on():
+        path = os.path.join(tdir, f"train_{next(_trace_n)}.json")
+        with trace(path):
+            t0 = time.perf_counter()
+            est.train(fs, batch_size=batch_size, epochs=1)
+            return time.perf_counter() - t0
+
+    offs, ons = [], []
+    for _ in range(rounds):
+        offs.append(epoch_off())
+        ons.append(epoch_on())
+    off_s = sorted(offs)[len(offs) // 2]
+    on_s = sorted(ons)[len(ons) // 2]
+    overhead_pct = (on_s - off_s) / off_s * 100.0
+    off_rate = n / off_s
+    on_rate = n / on_s
+
+    # -- part 2: traced serving soak + forked worker pool ---------------------
+    from analytics_zoo_tpu.feature.worker_pool import (
+        TransformWorkerPool, fork_available)
+    from analytics_zoo_tpu.inference import InferenceModel
+    from analytics_zoo_tpu.serving import ClusterServing, ServingConfig
+    from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+
+    im = InferenceModel(concurrent_num=2).load_jax(
+        lambda p, xx: xx.reshape(xx.shape[0], -1).mean(1, keepdims=True), {})
+    root = tempfile.mkdtemp(prefix="zoo_bench_obs_srv_")
+    src = f"dir://{root}"
+    cfg = ServingConfig(data_src=src, image_shape=(64,), batch_size=16,
+                        batch_wait_ms=5, input_dtype="float32")
+    serving = ClusterServing(cfg, model=im)
+    inq, outq = InputQueue(src), OutputQueue(src)
+    vec = rs.rand(64).astype(np.float32)
+    soak_n = 64
+    trace_path = os.path.join(tdir, "serving_soak.json")
+
+    class _Chain:
+        def apply(self, rec):
+            return rec * 2.0
+
+    with trace(trace_path):
+        serving.start()
+        try:
+            for i in range(soak_n):
+                inq.enqueue_tensor(f"s{i}", vec)
+            if fork_available():
+                # concurrent host data plane: forked workers put their
+                # pid-tagged spans on the same timeline
+                feats = rs.rand(32, 16).astype(np.float32)
+                pool = TransformWorkerPool(feats, _Chain(), rows=8,
+                                           slots=2, num_workers=2)
+                try:
+                    batches = [np.arange(8), np.arange(8, 16)]
+                    for _idx, _view in pool.map_index_batches(iter(batches)):
+                        pass
+                finally:
+                    pool.close()
+            deadline = time.monotonic() + 60
+            answered = {}
+            while time.monotonic() < deadline and len(answered) < soak_n:
+                answered.update(outq.dequeue())
+                time.sleep(0.02)
+        finally:
+            serving.drain(timeout_s=30)
+    if len(answered) != soak_n:
+        raise RuntimeError(
+            f"soak lost requests: {len(answered)}/{soak_n} answered")
+
+    events = json_mod.load(open(trace_path))  # Perfetto-loadable JSON
+    spans = [e for e in events if e.get("ph") == "X"]
+    chains = {}
+    for s in spans:
+        fid = (s.get("args") or {}).get("trace_id")
+        if fid is not None:
+            chains.setdefault(fid, set()).add(s["name"])
+    need = {"serving.enqueue", "serving.claim", "serving.decode",
+            "serving.dispatch", "serving.result"}
+    complete = sum(1 for c in chains.values() if need <= c)
+    pids = {s["pid"] for s in spans}
+    if complete < 1:
+        raise RuntimeError("no complete serving flow chain in the trace")
+    if fork_available() and len(pids) < 2:
+        raise RuntimeError(
+            f"trace has spans from only {len(pids)} pid(s); forked worker "
+            f"spans missing")
+
+    return _BenchResult(
+        metric="obs_overhead_pct",
+        value=round(overhead_pct, 3),
+        unit="%", mfu=None,
+        detail={"batch_size": batch_size,
+                "steps_per_epoch": steps_per_epoch,
+                "rounds": rounds,
+                "disabled_examples_per_sec": round(off_rate, 1),
+                "enabled_traced_examples_per_sec": round(on_rate, 1),
+                "overhead_pct": round(overhead_pct, 3),
+                "overhead_under_2pct": bool(overhead_pct < 2.0),
+                "soak_requests": soak_n,
+                "flow_chains_complete": complete,
+                "flow_chains_seen": len(chains),
+                "flow_chain_ok": bool(complete >= 1),
+                "trace_pids": len(pids),
+                "trace_spans": len(spans),
+                "note": "A/B medians over interleaved epochs: metrics "
+                        "registry disabled vs registry + live trace "
+                        "session; soak gate = Perfetto-loadable trace "
+                        "with a complete enqueue→claim→decode→dispatch→"
+                        "result chain and spans from >= 2 pids"})
 
 
 def _longseq_once(batch_size, heads, seq, head_dim, steps):
@@ -1555,6 +1728,7 @@ _WORKLOADS = {
     "eval": bench_eval,
     "serving": bench_serving,
     "serving_slo": bench_serving_slo,
+    "obs_overhead": bench_obs_overhead,
     "quantized": bench_quantized,
     "pipeline": bench_input_pipeline,
 }
@@ -1615,6 +1789,7 @@ _COMPACT_KEYS = {
     "quantized": ("fp32_images_per_sec",),
     "serving": ("bert_records_per_sec", "device_records_per_sec"),
     "serving_slo": ("p50_ms", "shed_rate", "deadline_miss_rate"),
+    "obs_overhead": ("overhead_under_2pct", "flow_chain_ok", "trace_pids"),
     "pipeline": (),
     "recovery": ("restore_ms", "recovery_vs_step", "parity_ok"),
 }

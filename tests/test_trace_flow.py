@@ -1,0 +1,214 @@
+"""Tests for the flow-tracing half of the telemetry plane: session
+nesting, thread labels, pid-correct spans from forked workers, serving
+request-lifecycle flow chains, and the health_snapshot() registry view."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.utils import time_it
+from analytics_zoo_tpu.utils import trace as trace_mod
+from analytics_zoo_tpu.utils.trace import (
+    flow_point, new_trace_id, set_thread_label, trace)
+
+_STAGES = {"serving.enqueue", "serving.claim", "serving.decode",
+           "serving.dispatch", "serving.result"}
+
+
+def _spans(path):
+    return [e for e in json.load(open(path)) if e.get("ph") == "X"]
+
+
+class TestSessionSemantics:
+    def test_nested_sessions_merge(self, tmp_path):
+        """Satellite: the outer session must keep recording during an
+        inner one (the old recorder silently dropped those spans)."""
+        outer_p = str(tmp_path / "outer.json")
+        inner_p = str(tmp_path / "inner.json")
+        with trace(outer_p):
+            with time_it("before_inner"):
+                pass
+            with trace(inner_p):
+                with time_it("during_inner"):
+                    pass
+            with time_it("after_inner"):
+                pass
+        outer = {s["name"] for s in _spans(outer_p)}
+        inner = {s["name"] for s in _spans(inner_p)}
+        assert {"before_inner", "during_inner", "after_inner"} <= outer
+        assert inner == {"during_inner"}
+
+    def test_not_tracing_outside_sessions(self, tmp_path):
+        assert not trace_mod.tracing()
+        with trace(str(tmp_path / "t.json")):
+            assert trace_mod.tracing()
+        assert not trace_mod.tracing()
+        # flow_point outside a session is a cheap no-op, not an error
+        flow_point(new_trace_id(), "serving.enqueue", "s")
+
+    def test_spans_carry_real_pid(self, tmp_path):
+        p = str(tmp_path / "t.json")
+        with trace(p):
+            with time_it("pid_probe"):
+                pass
+        (span,) = [s for s in _spans(p) if s["name"] == "pid_probe"]
+        assert span["pid"] == os.getpid()  # not the old hardcoded 0
+
+    def test_thread_rows_named_by_role(self, tmp_path):
+        """Satellite: thread meta rows use live thread names / the
+        set_thread_label() helper, not thread-0..n."""
+        import threading
+        p = str(tmp_path / "t.json")
+        with trace(p):
+            def work():
+                set_thread_label("producer")
+                with time_it("labeled_work"):
+                    pass
+            t = threading.Thread(target=work)
+            t.start()
+            t.join()
+        events = json.load(open(p))
+        names = {e["args"]["name"] for e in events
+                 if e.get("ph") == "M" and e["name"] == "thread_name"}
+        assert "producer" in names
+
+
+class TestForkedWorkerSpans:
+    def test_worker_pool_spans_merge_pid_correct(self, tmp_path):
+        """Tentpole: spans from forked transform workers land in the
+        dumped trace with THEIR pid — worker activity is visible on the
+        same timeline as the consumer."""
+        from analytics_zoo_tpu.feature.worker_pool import (
+            TransformWorkerPool, fork_available)
+        if not fork_available():
+            pytest.skip("no fork on this platform")
+
+        class Chain:
+            def apply(self, rec):
+                return rec + 1.0
+
+        feats = np.arange(32, dtype=np.float32).reshape(8, 4)
+        p = str(tmp_path / "workers.json")
+        with trace(p):
+            with time_it("parent_span"):
+                pass
+            pool = TransformWorkerPool(feats, Chain(), rows=4, slots=2,
+                                       num_workers=2)
+            try:
+                batches = [np.arange(4), np.arange(4, 8)]
+                for idx, view in pool.map_index_batches(iter(batches)):
+                    assert np.allclose(view, feats[idx] + 1.0)
+            finally:
+                pool.close()
+        spans = _spans(p)
+        worker_spans = [s for s in spans if s["name"] == "worker.task"]
+        assert worker_spans, "forked worker spans missing from the trace"
+        assert all(s["pid"] != os.getpid() for s in worker_spans)
+        assert len({s["pid"] for s in spans}) >= 2  # parent + worker(s)
+
+
+class TestServingFlowChain:
+    def test_full_lifecycle_chain(self, ctx, tmp_path):
+        """A traced serving pass draws at least one COMPLETE
+        enqueue→claim→decode→dispatch→result flow chain, every anchor
+        slice tagged with the request's trace_id."""
+        from analytics_zoo_tpu.inference import InferenceModel
+        from analytics_zoo_tpu.serving import ClusterServing, ServingConfig
+        from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+
+        im = InferenceModel().load_jax(
+            lambda p, x: x.reshape(x.shape[0], -1).mean(1, keepdims=True),
+            {})
+        root = str(tmp_path / "spool")
+        os.makedirs(root)
+        src = f"dir://{root}"
+        cfg = ServingConfig(data_src=src, image_shape=(8,), batch_size=4,
+                            batch_wait_ms=5, input_dtype="float32")
+        serving = ClusterServing(cfg, model=im)
+        inq, outq = InputQueue(src), OutputQueue(src)
+        p = str(tmp_path / "serve.json")
+        with trace(p):
+            for i in range(6):
+                inq.enqueue_tensor(f"r{i}", np.arange(8, dtype=np.float32))
+            done = 0
+            while done < 6:
+                done += serving.serve_once()
+        results = outq.dequeue()
+        assert len(results) == 6
+        chains = {}
+        for s in _spans(p):
+            tid_ = (s.get("args") or {}).get("trace_id")
+            if tid_ is not None:
+                chains.setdefault(tid_, set()).add(s["name"])
+        complete = [c for c in chains.values() if _STAGES <= c]
+        assert len(complete) == 6, chains
+        # flow-phase events present and bindable (s at enqueue, f at end)
+        phases = [e.get("ph") for e in json.load(open(p))
+                  if e.get("cat") == trace_mod.FLOW_CAT]
+        assert "s" in phases and "f" in phases and "t" in phases
+
+    def test_health_snapshot_is_registry_view(self, ctx, tmp_path):
+        """health_snapshot() counters/latency come from the shared metrics
+        registry; p50/p99 are null (not 0.0) on an empty window."""
+        from analytics_zoo_tpu.common import metrics as zoo_metrics
+        from analytics_zoo_tpu.inference import InferenceModel
+        from analytics_zoo_tpu.serving import ClusterServing, ServingConfig
+        from analytics_zoo_tpu.serving.client import InputQueue
+
+        im = InferenceModel().load_jax(
+            lambda p, x: x.reshape(x.shape[0], -1).mean(1, keepdims=True),
+            {})
+        root = str(tmp_path / "spool2")
+        os.makedirs(root)
+        cfg = ServingConfig(data_src=f"dir://{root}", image_shape=(8,),
+                            batch_size=4, batch_wait_ms=5,
+                            input_dtype="float32")
+        serving = ClusterServing(cfg, model=im)
+        snap = serving.health_snapshot()
+        # satellite: empty latency window reads null, never 0.0
+        assert snap["latency_ms"]["p50"] is None
+        assert snap["latency_ms"]["p99"] is None
+        assert snap["latency_ms"]["window"] == 0
+
+        inq = InputQueue(f"dir://{root}")
+        for i in range(4):
+            inq.enqueue_tensor(f"r{i}", np.arange(8, dtype=np.float32))
+        while serving.serve_once() == 0:
+            pass
+        snap = serving.health_snapshot()
+        assert snap["latency_ms"]["window"] == 4
+        assert snap["latency_ms"]["p50"] is not None
+        # the same numbers are visible through the registry exposition
+        reg = zoo_metrics.metrics_snapshot()
+        label = f"server={serving.metrics_label}"
+        assert reg["serving.request_latency_seconds"]["series"][label][
+            "count"] == 4
+        assert reg["serving.records_total"]["series"][label] == 4
+        text = zoo_metrics.expose_text()
+        assert ("zoo_serving_records_total{server=\""
+                + serving.metrics_label + "\"} 4") in text
+
+    def test_metrics_prom_written_next_to_health(self, ctx, tmp_path):
+        """The serving health loop drops Prometheus text at metrics.prom
+        beside health.json."""
+        from analytics_zoo_tpu.inference import InferenceModel
+        from analytics_zoo_tpu.serving import ClusterServing, ServingConfig
+
+        im = InferenceModel().load_jax(
+            lambda p, x: x.reshape(x.shape[0], -1).mean(1, keepdims=True),
+            {})
+        root = str(tmp_path / "spool3")
+        os.makedirs(root)
+        cfg = ServingConfig(data_src=f"dir://{root}", image_shape=(8,),
+                            batch_size=4, batch_wait_ms=5,
+                            input_dtype="float32",
+                            health_path=os.path.join(root, "health.json"))
+        serving = ClusterServing(cfg, model=im)
+        serving._write_health()
+        prom = os.path.join(root, "metrics.prom")
+        assert os.path.exists(prom)
+        text = open(prom).read()
+        assert "# TYPE zoo_serving_shed_total counter" in text
+        health = json.load(open(os.path.join(root, "health.json")))
+        assert health["state"] == "idle"
