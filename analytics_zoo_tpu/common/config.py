@@ -206,3 +206,28 @@ _global_config.register("rng.impl", "",
                         "('' = default threefry; 'rbg'/'unsafe_rbg' use the "
                         "TPU hardware RNG — faster bit generation, streams "
                         "differ from threefry's).")
+_global_config.register("profile.enabled", False,
+                        "Step-phase attribution profiler (common/profiler."
+                        "py): decompose train/eval/serving steps into "
+                        "host_input/dispatch/execute/fetch/compile phases "
+                        "with MFU and roofline gauges. Off = sub-microsecond "
+                        "no-ops; on, the train loop fences each step "
+                        "(block_until_ready) to separate execute from "
+                        "dispatch, trading pipelining for attribution.")
+_global_config.register("profile.capture_dir", "",
+                        "Output directory for jax.profiler capture windows "
+                        "('' disables all captures, armed or not).")
+_global_config.register("profile.capture_steps", 0,
+                        "Arm one jax.profiler capture for this many profiled "
+                        "steps at the first step boundary (0 = not armed).")
+_global_config.register("profile.capture_on_breach", False,
+                        "Arm a time-bounded jax.profiler capture on the "
+                        "first serving SLO breach (shed or expired) of the "
+                        "process.")
+_global_config.register("profile.capture_seconds", 2.0,
+                        "Wall-seconds bound for breach-triggered capture "
+                        "windows.")
+_global_config.register("profile.peak_flops", 0.0,
+                        "Override the device's peak bf16 FLOP/s for the MFU "
+                        "gauge (0 = auto-detect from the device kind; "
+                        "detection knows TPU v4/v5e/v5p/v6e).")

@@ -43,6 +43,7 @@ import optax
 
 from ..common import faults, file_io
 from ..common import metrics as zoo_metrics
+from ..common import profiler as _profiler
 from ..common.config import global_config
 from ..common.context import get_context
 from ..common.triggers import EveryEpoch, MaxEpoch, TrainingState, Trigger
@@ -91,6 +92,26 @@ _M_CKPT_FALLBACK = zoo_metrics.counter(
     "ckpt.fallback_total",
     "Restores that skipped a torn/corrupt newest snapshot and fell back "
     "to an older one.")
+
+#: step-phase attribution for the train loop (host_input / dispatch /
+#: execute / fetch / compile per step) — active only under profile.enabled
+_P_TRAIN = _profiler.StepProfiler("train")
+
+
+def _profiled_feed(feed, prof):
+    """Wrap the device feed so each step window opens just before its
+    blocking ``next()`` — host-input stalls land in THIS step's phases."""
+    it = iter(feed)
+    while True:
+        prof.step_start()
+        t0 = time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        prof.add("host_input", time.perf_counter() - t0, start=t0)
+        yield item
+
 
 #: resumable-preemption marker filename, written next to the snapshots
 PREEMPT_MARKER = "PREEMPTED.json"
@@ -501,6 +522,27 @@ class Estimator:
 
         return jax.jit(eval_step, donate_argnums=(2,))
 
+    def _wire_step_cost(self, group, x, y):
+        """One-time per compiled step fn: install the XLA cost model
+        (FLOPs + HBM bytes per dispatch) behind the train loop's MFU and
+        roofline gauges. ``lower()`` retraces abstractly — no execution,
+        no recompile — and any failure just leaves the gauges unset."""
+        try:
+            if group > 1:
+                lowered = self._multi_step.lower(
+                    self.params, self.opt_state, self.model_state,
+                    self.root_rng, np.int32(self.global_step), x, y)
+            else:
+                step_rng = jax.random.fold_in(self.root_rng,
+                                              self.global_step)
+                lowered = self._train_step.lower(
+                    self.params, self.opt_state, self.model_state,
+                    step_rng, x, y)
+            _P_TRAIN.set_cost(_profiler.cost_flops(lowered),
+                              _profiler.cost_bytes(lowered))
+        except Exception:
+            pass
+
     def _build_predict_step(self):
         model = self.model
 
@@ -666,6 +708,10 @@ class Estimator:
             self._frozen_at_build = frozen_now
             self._train_step = self._build_train_step()
             self._multi_step = None  # closes over _train_step
+            # first dispatch of a fresh step fn is compile-dominated: the
+            # profiler books it as phase=compile, not dispatch
+            self._prof_fresh_dispatch = True
+            self._prof_cost_done = False
         if self._tb and self._train_writer is None:
             log_dir, app = self._tb
             self._train_writer = SummaryWriter(os.path.join(log_dir, app, "train"))
@@ -717,6 +763,8 @@ class Estimator:
             if group > 1:
                 if self._multi_step is None:
                     self._multi_step = self._build_multi_step()
+                    self._prof_fresh_dispatch = True
+                    self._prof_cost_done = False
                 host_it = _group_host_batches(
                     host_it, batches_per_epoch - skip, batches_per_epoch,
                     group)
@@ -727,8 +775,11 @@ class Estimator:
                 feed = DeviceFeed(host_it, self.mesh)
             epoch_iter = skip
             self._epoch_offset = epoch_iter
+            prof = _profiler.enabled()
+            step_source = (_profiled_feed(feed, _P_TRAIN) if prof
+                           else iter(feed))
             try:
-                for x, y in feed:
+                for x, y in step_source:
                     # chaos site: a firing injection models a chip/tunnel
                     # failure at step dispatch — caught by the elastic
                     # retry below exactly like a real one
@@ -753,6 +804,23 @@ class Estimator:
                                 self.params, self.opt_state, self.model_state,
                                 step_rng, x, y)
                         losses = loss
+                    if prof:
+                        now = time.perf_counter()
+                        _P_TRAIN.add(
+                            "compile" if self._prof_fresh_dispatch
+                            else "dispatch", now - step_start,
+                            start=step_start)
+                        self._prof_fresh_dispatch = False
+                        if not self._prof_cost_done:
+                            self._prof_cost_done = True
+                            self._wire_step_cost(group, x, y)
+                        # explicit fence: device compute becomes its own
+                        # phase instead of hiding inside the loss sync —
+                        # profiling trades the async pipeline for this
+                        t_x = time.perf_counter()
+                        jax.block_until_ready(losses)
+                        _P_TRAIN.add("execute", time.perf_counter() - t_x,
+                                     start=t_x)
                     self.global_step += g
                     epoch_iter += g
                     self._epoch_offset = epoch_iter
@@ -761,7 +829,8 @@ class Estimator:
                     pending.append(losses)
 
                     if need_loss:
-                        loss_val = float(loss)  # device sync point
+                        with _P_TRAIN.phase("fetch"):
+                            loss_val = float(loss)  # device sync point
                         state.loss = loss_val
                         if self._train_writer is not None:
                             lr = self.optimizer.learning_rate
@@ -790,6 +859,8 @@ class Estimator:
                     # examples throughput counter
                     _M_STEP.observe(time.perf_counter() - step_start)
                     _M_EXAMPLES.inc(local_batch * g)
+                    if prof:
+                        _P_TRAIN.step_end()
 
                     state.epoch_finished = epoch_iter >= batches_per_epoch
                     # boundaries CROSSED by this dispatch (g > 1 can jump
@@ -940,10 +1011,25 @@ class Estimator:
             for m in self.metrics]
         host_it = masked_eval_batches(itertools.chain([first], it),
                                       local_batch)
-        with DeviceFeed(host_it, self.mesh, shard_fn=shard_payload) as feed:
+        prof = _profiler.enabled()
+        with DeviceFeed(host_it, self.mesh, shard_fn=shard_payload,
+                        profile_loop="eval" if prof else None) as feed:
             for (bx, by, bm), _ in feed:
+                t_d = time.perf_counter() if prof else 0.0
                 metric_states = self._eval_step(self.params, self.model_state,
                                                 metric_states, bx, by, bm)
+                if prof:
+                    _profiler.record_phase(
+                        "eval", "dispatch", time.perf_counter() - t_d,
+                        start=t_d)
+        if prof:
+            # the single host sync of the pass: everything blocked here
+            # is the fetch phase
+            t_f = time.perf_counter()
+            out = metrics_mod.compute_all(self.metrics, metric_states)
+            _profiler.record_phase("eval", "fetch",
+                                   time.perf_counter() - t_f, start=t_f)
+            return out
         return metrics_mod.compute_all(self.metrics, metric_states)
 
     def _evaluate_direct_exact(self, val_set: FeatureSet, batch_size: int
@@ -1008,11 +1094,24 @@ class Estimator:
         # per-batch (loss-sum, valid-count) scalars stay on device; the
         # dispatch loop never blocks — ONE device_get drains the pass
         pending: List[Any] = []
-        with DeviceFeed(host_batches(), self.mesh) as feed:
+        prof = _profiler.enabled()
+        with DeviceFeed(host_batches(), self.mesh,
+                        profile_loop="eval" if prof else None) as feed:
             for bx, by, bm in feed:
+                t_d = time.perf_counter() if prof else 0.0
                 pending.append(self._direct_pe_step(
                     self.params, self.model_state, eval_rng, bx, by, bm))
-        total, weight = _drain_sum_pairs(pending)
+                if prof:
+                    _profiler.record_phase(
+                        "eval", "dispatch", time.perf_counter() - t_d,
+                        start=t_d)
+        if prof:
+            t_f = time.perf_counter()
+            total, weight = _drain_sum_pairs(pending)
+            _profiler.record_phase("eval", "fetch",
+                                   time.perf_counter() - t_f, start=t_f)
+        else:
+            total, weight = _drain_sum_pairs(pending)
         if weight == 0:
             raise ValueError(
                 f"validation set is empty ({val_set.size} records)")

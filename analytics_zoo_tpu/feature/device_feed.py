@@ -29,6 +29,7 @@ from jax.sharding import Mesh
 
 from ..common import faults
 from ..common import metrics as _metrics
+from ..common import profiler as _profiler
 from ..common.config import global_config
 from ..parallel.mesh import shard_batch
 
@@ -121,7 +122,13 @@ class DeviceFeed:
     """
 
     def __init__(self, host_iterator: Iterator[Any], mesh: Mesh,
-                 prefetch: Optional[int] = None, shard_fn=None):
+                 prefetch: Optional[int] = None, shard_fn=None,
+                 profile_loop: Optional[str] = None):
+        # profile_loop: attribute consumer stalls to that loop's host_input
+        # phase (profiler). The train loop does NOT set it — it times its
+        # own next() so the phase lands inside the step window instead of
+        # being double-counted.
+        self._profile_loop = profile_loop
         depth = prefetch if prefetch is not None \
             else global_config().get("data.prefetch")
         self._queue: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
@@ -151,7 +158,11 @@ class DeviceFeed:
             raise StopIteration
         t0 = time.perf_counter()
         item = self._queue.get()
-        _M_STALL.inc(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        _M_STALL.inc(dt)
+        if self._profile_loop is not None:
+            _profiler.record_phase(self._profile_loop, "host_input", dt,
+                                   start=t0)
         if item is _SENTINEL:
             self._stop.set()
             if self._errbox:

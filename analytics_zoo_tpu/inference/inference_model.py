@@ -26,6 +26,7 @@ import jax
 import numpy as np
 
 from ..common import metrics as _metrics
+from ..common import profiler as _profiler
 from ..common.context import wire_compilation_cache
 from .quantize import dequantize_params, quantize_params
 
@@ -168,6 +169,8 @@ class InferenceModel:
                     self.compile_seconds.get(bucket, 0.0) + elapsed
                 _M_COMPILE.inc()
                 _M_COMPILE_S.inc(elapsed)
+                _profiler.record_phase("serving", "compile", elapsed,
+                                       start=t0)
         return exe
 
     def prewarm(self, example,
@@ -220,6 +223,8 @@ class InferenceModel:
                                 self.compile_seconds.get(b, 0.0) + elapsed
                             _M_COMPILE.inc()
                             _M_COMPILE_S.inc(elapsed)
+                            _profiler.record_phase("serving", "compile",
+                                                   elapsed, start=t0)
                 # serialized jax.export artifacts load pre-compiled
             else:
                 self._ensure_compiled(shaped, is_multi, b)

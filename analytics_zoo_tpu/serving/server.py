@@ -31,6 +31,7 @@ import numpy as np
 
 from ..common import faults, file_io
 from ..common import metrics as _metrics
+from ..common import profiler as _profiler
 from ..common.utils import time_it
 from ..inference.inference_model import InferenceModel
 from ..utils import trace as _trace
@@ -245,6 +246,10 @@ class ClusterServing:
 
     def _count(self, key: str, n: int = 1) -> None:
         self._m[key].inc(n)
+        if key in ("shed", "expired"):
+            # first SLO breach can arm a jax.profiler capture window
+            # (profile.capture_on_breach) — cheap no-op otherwise
+            _profiler.on_slo_breach(key)
 
     def _flow_uris(self, uris: List[str], stage: str) -> None:
         """Stamp one flow-chain point per uri (no-op unless a trace
@@ -399,6 +404,7 @@ class ClusterServing:
         uris, arrays, expiries = [], [], []
         errors, expired = [], []
         tracing = _trace.tracing()
+        t_dec = time.perf_counter()
         with time_it("serving.decode_batch"):
             futures = [(uri, rec,
                         self._decode_pool().submit(self._prepare, rec))
@@ -419,6 +425,8 @@ class ClusterServing:
                 uris.append(uri)
                 arrays.append(arr)
                 expiries.append(exp)
+        _profiler.record_phase("serving", "host_input",
+                               time.perf_counter() - t_dec, start=t_dec)
         for uri, msg in errors:
             self._post_terminal(uri, {"error": msg})
         if errors:
@@ -447,8 +455,12 @@ class ClusterServing:
         and post per-uri error results so one bad batch cannot take the
         loop (or its batch's clients) down with it."""
         faults.inject("serving.predict")
+        t_d = time.perf_counter()
         with time_it("serving.dispatch_batch"):
-            return self.model.predict_async(x)
+            handle = self.model.predict_async(x)
+        _profiler.record_phase("serving", "dispatch",
+                               time.perf_counter() - t_d, start=t_d)
+        return handle
 
     def _writeback(self, uris: List[str], probs: np.ndarray,
                    device_elapsed: float) -> None:
@@ -568,6 +580,15 @@ class ClusterServing:
         path = self.config.health_path
         if not path:
             return
+        # health cadence doubles as the profiler's slow tick: refresh the
+        # HBM/RSS/build-info gauges so they land in THIS metrics.prom, and
+        # close any elapsed time-bounded capture window (a quiet queue sees
+        # no step boundaries)
+        try:
+            _profiler.sample_memory()
+            _profiler.maybe_stop_capture()
+        except Exception:
+            logger.debug("profiler health tick failed", exc_info=True)
         tmp = path + ".tmp"
         try:
             with file_io.fopen(tmp, "w") as f:
@@ -764,8 +785,12 @@ class ClusterServing:
                 try:
                     t0 = time.perf_counter()
                     probs = fetch()  # blocks on the device fetch only
-                    self._writeback(uris, np.asarray(probs),
-                                    time.perf_counter() - t0)
+                    elapsed = time.perf_counter() - t0
+                    # device execute + transfer both resolve inside fetch()
+                    # on the async path; attribute the blocked time there
+                    _profiler.record_phase("serving", "fetch", elapsed,
+                                           start=t0)
+                    self._writeback(uris, np.asarray(probs), elapsed)
                 except BaseException as e:
                     # one failed batch must not wedge the server: record
                     # error results and keep draining

@@ -15,8 +15,11 @@ an undocumented metric is invisible to whoever writes the alerts. Mirrors
    name, one owning module (re-registration elsewhere would silently
    alias series);
 3. names follow the ``subsystem.noun_unit`` convention
-   (lower_snake, one dot), counters end in ``_total`` and histograms in
-   ``_seconds`` (all our histograms observe durations);
+   (lower_snake, one dot), counters end in ``_total``, histograms in
+   ``_seconds`` (all our histograms observe durations), and gauges carry
+   a unit suffix (``_seconds``/``_bytes``/``_ratio``/``_depth``) unless
+   allow-listed as genuinely unitless (``serving.in_flight`` counts,
+   ``build.info`` is an info-style constant-1 gauge);
 4. every registered metric is documented in ``docs/observability.md``
    (the metric table is the operator's scrape vocabulary).
 """
@@ -41,6 +44,12 @@ _EXCLUDE = (os.path.join("common", "metrics.py"),)
 _KINDS = ("counter", "gauge", "histogram")
 _NAME_RE = re.compile(r"^[a-z][a-z0-9]*\.[a-z][a-z0-9_]*$")
 _UNIT_SUFFIX = {"counter": "_total", "histogram": "_seconds"}
+
+#: gauges must say what they measure; any of these suffixes qualifies
+_GAUGE_UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio", "_depth")
+#: gauges that are genuinely unitless: a live request count and the
+#: info-style constant-1 build gauge (labels carry the payload)
+_GAUGE_UNITLESS_OK = {"serving.in_flight", "build.info"}
 
 
 def _is_registration(node: ast.Call) -> bool:
@@ -118,6 +127,12 @@ def check() -> List[str]:
             problems.append(
                 f"{kind} {name!r} ({places[0][0]}) must end in "
                 f"'{suffix}'")
+        if (kind == "gauge" and name not in _GAUGE_UNITLESS_OK
+                and not name.endswith(_GAUGE_UNIT_SUFFIXES)):
+            problems.append(
+                f"gauge {name!r} ({places[0][0]}) must end in one of "
+                f"{'/'.join(_GAUGE_UNIT_SUFFIXES)} or be allow-listed in "
+                f"_GAUGE_UNITLESS_OK")
     for name in undocumented(regs):
         problems.append(
             f"metric {name!r} is registered but undocumented — add a row "

@@ -1,0 +1,180 @@
+"""Tests for bench.py's outage-proof harness pieces: the CPU-parity ratio
+mode (every workload must land a schema-valid record with no accelerator),
+resumable sharding (BENCH_STATE.json round-trip, --shard selection),
+baseline diffing, record validation, partial-record stashing, and the
+argument parser. bench.py is a script, not a package module — loaded here
+by file path."""
+import importlib.util
+import os
+
+import pytest
+
+_BENCH_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench.py")
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("zoo_bench", _BENCH_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench = _load_bench()
+
+
+class TestRatioMode:
+    def test_plan_covers_every_workload(self):
+        assert set(bench._RATIO_PLAN) == set(bench._WORKLOADS)
+        for impl_key, _value_key in bench._RATIO_PLAN.values():
+            assert impl_key in bench._RATIO_IMPLS
+
+    @pytest.mark.parametrize("name", sorted(bench._RATIO_PLAN))
+    def test_every_workload_lands_a_valid_record(self, name, ctx):
+        """The outage contract: with no accelerator at all, each workload
+        still produces one schema-valid ratio record. Impl results are
+        memoized, so the 13 parametrizations run 7 actual probes."""
+        rec = bench._run_ratio(name)
+        assert bench._validate_record(rec) == []
+        assert rec["metric"] == f"{name}_cpu_ratio"
+        assert rec["unit"] == "ratio"
+        d = rec["detail"]
+        assert d["mode"] == "cpu_ratio"
+        assert d["proxy_for"] == name
+        if rec["value"] is not None:  # mp ratio is None where fork isn't
+            assert rec["value"] > 0
+
+    def test_obs_ratio_honors_disabled_contract(self):
+        detail = bench._ratio_memo.get("obs") or bench._ratio_obs()
+        assert detail["disabled_under_1us"] is True
+
+
+class TestShardAndState:
+    def test_shards_partition_the_run_order(self):
+        names = list(bench._WORKLOADS)
+        shards = [bench._select_shard(names, (i, 3)) for i in range(3)]
+        flat = [n for s in shards for n in s]
+        assert sorted(flat) == sorted(names)      # disjoint and complete
+        assert len(flat) == len(set(flat))
+        # round-robin: the expensive head rows spread across shards
+        assert names[0] in shards[0] and names[1] in shards[1]
+        assert bench._select_shard(names, None) == names
+
+    def test_state_roundtrip(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(bench, "_STATE_PATH",
+                            str(tmp_path / "BENCH_STATE.json"))
+        assert bench._load_state() == {}
+        results = {"resnet50": bench._BenchResult(
+            metric="resnet50_cpu_ratio", value=2.5, unit="ratio",
+            mfu=None, detail={"mode": "cpu_ratio"})}
+        bench._save_state(results)
+        loaded = bench._load_state()
+        assert set(loaded) == {"resnet50"}
+        assert loaded["resnet50"]["value"] == 2.5
+        assert isinstance(loaded["resnet50"], bench._BenchResult)
+        bench._clear_state()
+        assert bench._load_state() == {}
+        bench._clear_state()  # idempotent
+
+    def test_corrupt_state_is_ignored(self, tmp_path, monkeypatch):
+        path = tmp_path / "BENCH_STATE.json"
+        path.write_text("{not json")
+        monkeypatch.setattr(bench, "_STATE_PATH", str(path))
+        assert bench._load_state() == {}
+
+
+class TestBaseline:
+    def test_diff_math_and_filters(self):
+        baseline = {"workloads": {
+            "a": {"value": 100.0, "unit": "images/s"},
+            "b": {"value": 10.0, "unit": "ratio"},
+            "c": {"value": 50.0, "unit": "images/s"},
+            "z": {"value": 0.0, "unit": "x"},
+        }}
+        results = {
+            "a": bench._BenchResult(metric="a", value=110.0,
+                                    unit="images/s", detail={}),
+            "b": bench._BenchResult(metric="b", value=10.0,
+                                    unit="records/s", detail={}),  # unit drift
+            "c": bench._BenchResult(metric="c", value=None,
+                                    unit="images/s", detail={}),   # no value
+            "z": bench._BenchResult(metric="z", value=3.0,
+                                    unit="x", detail={}),          # zero base
+            "d": bench._BenchResult(metric="d", value=1.0,
+                                    unit="x", detail={}),          # no base
+        }
+        assert bench._baseline_diff(results, baseline) == {"a": 10.0}
+
+    def test_diff_is_null_without_reference_numbers(self):
+        results = {"a": bench._BenchResult(metric="a", value=1.0,
+                                           unit="x", detail={})}
+        assert bench._baseline_diff(results, {}) is None
+        assert bench._baseline_diff(results, {"published": {}}) is None
+
+    def test_write_then_diff_roundtrip(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BENCH_BASELINE",
+                           str(tmp_path / "BASELINE.json"))
+        results = {"a": bench._BenchResult(metric="a", value=200.0,
+                                           unit="x", detail={})}
+        doc = {"workloads": {"a": {"value": 160.0, "unit": "x"}}}
+        (tmp_path / "BASELINE.json").write_text(__import__("json").dumps(doc))
+        assert bench._baseline_diff(results) == {"a": 25.0}
+
+
+class TestRecordSchema:
+    def test_valid_record_is_clean(self):
+        rec = bench._BenchResult(metric="x_cpu_ratio", value=1.5,
+                                 unit="ratio", mfu=None, detail={})
+        assert bench._validate_record(rec) == []
+        rec["value"] = None  # null value is legal (failed sub-probe)
+        assert bench._validate_record(rec) == []
+
+    def test_junk_records_are_named(self):
+        assert bench._validate_record("nope") == ["record must be a dict"]
+        problems = bench._validate_record({"metric": "", "unit": 3,
+                                           "value": "fast", "detail": []})
+        assert len(problems) == 4
+
+    def test_note_partial_stashes_best_so_far(self):
+        saved = dict(bench._PARTIAL), dict(bench._PARTIAL["detail"])
+        try:
+            bench._PARTIAL.clear()
+            bench._PARTIAL["detail"] = {}
+            bench._note_partial(warmup_done=True)
+            assert "metric" not in bench._PARTIAL
+            bench._note_partial(metric="m", value=7.0, unit="u", rate=7.0)
+            assert bench._PARTIAL["metric"] == "m"
+            assert bench._PARTIAL["value"] == 7.0
+            assert bench._PARTIAL["detail"] == {"warmup_done": True,
+                                                "rate": 7.0}
+        finally:
+            bench._PARTIAL.clear()
+            bench._PARTIAL.update(saved[0])
+            bench._PARTIAL["detail"] = saved[1]
+
+
+class TestArgs:
+    def test_defaults(self):
+        args = bench._parse_args([])
+        assert args["which"] == "all" and args["one"] is None
+        assert not args["ratio"] and not args["resume"]
+        assert args["shard"] is None and args["budget"] is None
+
+    def test_flags_and_aliases(self):
+        args = bench._parse_args(["--one", "input_pipeline",
+                                  "--budget", "120.5"])
+        assert args["one"] == "pipeline"  # alias resolved
+        assert args["budget"] == 120.5
+        args = bench._parse_args(["--ratio", "--resume", "--full",
+                                  "--write-baseline", "--shard", "1/4",
+                                  "eval"])
+        assert args["ratio"] and args["resume"] and args["full"]
+        assert args["write_baseline"]
+        assert args["shard"] == (1, 4)
+        assert args["which"] == "eval"
+
+    def test_bad_input_rejected(self):
+        with pytest.raises(SystemExit):
+            bench._parse_args(["--wat"])
+        with pytest.raises(SystemExit):
+            bench._parse_args(["--shard", "4/4"])
