@@ -231,3 +231,22 @@ _global_config.register("profile.peak_flops", 0.0,
                         "Override the device's peak bf16 FLOP/s for the MFU "
                         "gauge (0 = auto-detect from the device kind; "
                         "detection knows TPU v4/v5e/v5p/v6e).")
+_global_config.register("data.validate_ids", "count",
+                        "Embedding-id validation policy ('count' | 'raise' "
+                        "| 'clamp'). 'clamp' keeps the historical silent "
+                        "jnp.take clip; 'count' clamps but counts offenders "
+                        "into embed.oob_ids_total; 'raise' raises on "
+                        "out-of-range ids when the lookup runs eagerly "
+                        "(test suites) and degrades to 'count' under jit.")
+_global_config.register("embed.sparse_updates", True,
+                        "Apply sparse row-subset optimizer updates to "
+                        "sharded embedding tables (parallel/embedding.py): "
+                        "only the rows touched this step are read/written, "
+                        "and their optimizer state lives outside the dense "
+                        "optax tree. False funnels embedding grads through "
+                        "the dense optimizer like any other parameter.")
+_global_config.register("embed.cold_lr", 0.01,
+                        "SGD learning rate for host-DRAM cold-tier embedding "
+                        "rows (applied eagerly on the host inside the "
+                        "backward callback; independent of the device "
+                        "optimizer).")

@@ -69,6 +69,12 @@ _M_ROOF = _metrics.gauge(
     "profile.hbm_roofline_ratio",
     "Achieved HBM GB/s of the last profiled step divided by the chip's "
     "peak memory bandwidth.", labels=("loop",))
+_M_ROOF_UTIL = _metrics.gauge(
+    "profile.roofline_utilization_ratio",
+    "Roofline utilization of the last profiled step: the LARGER of MFU "
+    "and the HBM-bandwidth fraction, so bytes-bound steps (embedding "
+    "gathers) report how close they run to the roofline instead of a "
+    "misleading ~0 MFU.", labels=("loop",))
 _M_HBM_USED = _metrics.gauge(
     "profile.hbm_used_bytes",
     "Device memory in use (jax memory_stats, sampled on the health "
@@ -255,16 +261,22 @@ class StepProfiler:
             _phase_child(self.loop, "other").observe(wall - attributed)
         _loop_child(_M_WALL, "w", self.loop).observe(wall)
         if wall > 0:
+            mfu = roof = None
             if self._flops is not None:
                 peak = self._resolve_peak()
                 if peak:
-                    _loop_child(_M_MFU, "m", self.loop).set(
-                        self._flops / wall / peak)
+                    mfu = self._flops / wall / peak
+                    _loop_child(_M_MFU, "m", self.loop).set(mfu)
             if self._bytes is not None:
                 hbm = self._resolve_hbm()
                 if hbm:
-                    _loop_child(_M_ROOF, "r", self.loop).set(
-                        self._bytes / wall / (hbm * 1e9))
+                    roof = self._bytes / wall / (hbm * 1e9)
+                    _loop_child(_M_ROOF, "r", self.loop).set(roof)
+            if mfu is not None or roof is not None:
+                # the binding ceiling: a step is "fast" when it saturates
+                # EITHER the matmul peak or the memory bandwidth
+                _loop_child(_M_ROOF_UTIL, "u", self.loop).set(
+                    max(mfu or 0.0, roof or 0.0))
         step_boundary()
 
     def _resolve_peak(self) -> Optional[float]:

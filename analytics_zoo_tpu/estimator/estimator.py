@@ -53,7 +53,9 @@ from ..feature.device_feed import (DeviceFeed, masked_eval_batches,
                                    shard_payload)
 from ..keras import metrics as metrics_mod
 from ..keras.optimizers import Optimizer
-from ..parallel.mesh import param_sharding, replicated, shard_batch
+from ..parallel import embedding as _embed_engine
+from ..parallel.mesh import (param_sharding, replicated, shard_batch,
+                             vocab_sharding_rule)
 from ..utils.tensorboard import SummaryWriter
 
 
@@ -323,6 +325,9 @@ class Estimator:
         self.ctx = get_context()
         self.mesh = mesh if mesh is not None else self.ctx.mesh
         self.param_rules = param_sharding_rules
+        # vocab-sharded embedding layers built outside a mesh context must
+        # shard against THIS estimator's mesh (parallel/embedding.py)
+        _embed_engine.set_default_mesh(self.mesh)
         rng_impl = global_config().get("rng.impl") or None
         if rng_impl:
             # "rbg"/"unsafe_rbg" use the TPU's hardware RNG for bit
@@ -353,6 +358,9 @@ class Estimator:
         self._train_writer: Optional[SummaryWriter] = None
         self._val_writer: Optional[SummaryWriter] = None
         self._preempt_requested = False
+        #: per-traced-step (exchange, grad) byte totals of the sharded
+        #: embedding path; None until the first dispatch of a fresh step fn
+        self._embed_step_bytes: Optional[Tuple[int, int]] = None
 
     # -- configuration (reference KerasNet setters, Topology.scala:111-127) ---
 
@@ -370,6 +378,81 @@ class Estimator:
 
     # -- initialization -------------------------------------------------------
 
+    def _model_layers(self) -> List:
+        m = self.model
+        if hasattr(m, "flattened_layers"):
+            return m.flattened_layers()
+        return list(getattr(m, "layers", None) or [m])
+
+    def _sharded_table_specs(self) -> Dict[Tuple[str, str], Any]:
+        """``{(layer_name, param_key): ShardSpec}`` over every vocab-sharded
+        embedding table in the model. Deterministic PRE-BUILD (layers compute
+        their spec on demand), so checkpoint restore can rebuild the split
+        optimizer-state structure before the first trace."""
+        out: Dict[Tuple[str, str], Any] = {}
+        for layer in self._model_layers():
+            tables = getattr(layer, "sharded_tables", None)
+            if tables is None:
+                continue
+            for key, spec in tables().items():
+                out[(layer.name, key)] = spec
+        return out
+
+    def _embed_plan(self) -> Dict[Tuple[str, str], Any]:
+        """Tables the SPARSE row-subset optimizer path owns this build:
+        vocab-sharded tables x an optimizer whose math has a sparse
+        equivalent. Empty plan == exactly the historical dense behavior."""
+        if (self.optimizer is None
+                or getattr(self.optimizer, "sparse_rows", None) is None
+                or self.direct_loss_fn is not None
+                or not global_config().get("embed.sparse_updates")):
+            return {}
+        return self._sharded_table_specs()
+
+    def _maybe_add_vocab_rules(self) -> None:
+        """Idempotently append the GSPMD vocab-sharding rule for the
+        model's sharded tables to ``param_rules`` (params, frozen-table
+        model state and row-wise optimizer state all ride the same rule)."""
+        _embed_engine.set_default_mesh(self.mesh)
+        tables = {k: spec.axis
+                  for k, spec in self._sharded_table_specs().items()}
+        if not tables or getattr(self, "_vocab_rule_tables", None) == tables:
+            return
+        rule = vocab_sharding_rule(tables)
+        rule._is_vocab_rule = True
+        base = [r for r in (self.param_rules or [])
+                if not getattr(r, "_is_vocab_rule", False)]
+        self.param_rules = base + [rule]
+        self._vocab_rule_tables = tables
+
+    def _opt_rules(self) -> Optional[List]:
+        """Sharding rules for the optimizer state tree (row-wise embed
+        state shards with its table; everything else stays replicated)."""
+        tables = {k: spec.axis
+                  for k, spec in self._sharded_table_specs().items()}
+        return [vocab_sharding_rule(tables)] if tables else None
+
+    def _init_opt_state(self, params):
+        """Optimizer-state init honoring the sparse-embedding plan: plan
+        tables get row-wise state under ``opt["embed"]`` (read/written only
+        for touched rows each step) and are STRIPPED from the dense optax
+        state; an empty plan returns the plain optax init unchanged."""
+        plan = self._embed_plan()
+        plan = {k: v for k, v in plan.items()
+                if k[0] in params and k[1] in params[k[0]]}
+        if not plan:
+            return self.optimizer.init(params)
+        kind, _hyper = self.optimizer.sparse_rows
+        stripped = {ln: {k: v for k, v in sub.items()
+                         if (ln, k) not in plan}
+                    for ln, sub in params.items()}
+        stripped = {ln: sub for ln, sub in stripped.items() if sub}
+        embed: Dict[str, Dict[str, Any]] = {}
+        for ln, key in sorted(plan):
+            embed.setdefault(ln, {})[key] = _embed_engine.init_row_state(
+                kind, params[ln][key])
+        return {"dense": self.optimizer.init(stripped), "embed": embed}
+
     def _ensure_initialized(self, sample_x) -> None:
         # "state resolved" distinguishes a genuinely-stateless model (state
         # legitimately {}) from state that simply hasn't been built yet — an
@@ -380,6 +463,7 @@ class Estimator:
         if self.params is not None and state_resolved and (
                 self.opt_state is not None or self.optimizer is None):
             return
+        self._maybe_add_vocab_rules()
         from ..keras.engine import init_model
         self.root_rng, init_rng = jax.random.split(self.root_rng)
         if self.params is None:
@@ -402,9 +486,9 @@ class Estimator:
                 self.model_state = {}
             self._state_resolved = True
         if self.opt_state is None and self.optimizer is not None:
-            opt = self.optimizer.init(self.params)
+            opt = self._init_opt_state(self.params)
             self.opt_state = jax.device_put(
-                opt, param_sharding(self.mesh, opt, None))
+                opt, param_sharding(self.mesh, opt, self._opt_rules()))
 
     def _clip_transform(self):
         if self._clip is None:
@@ -436,6 +520,8 @@ class Estimator:
         direct = self.direct_loss_fn
         clip = self._clip_transform()
         cast = self._cast_inputs
+        plan = self._embed_plan()
+        sparse = getattr(optimizer, "sparse_rows", None) if plan else None
 
         # transfer learning: frozen layers get stop_gradient (XLA then
         # dead-code-eliminates their backward pass) and zeroed updates (so
@@ -472,15 +558,66 @@ class Estimator:
 
             (loss, new_state), grads = jax.value_and_grad(
                 compute_loss, has_aux=True)(params)
+            # sharded embedding layers stash their forward exchange blob in
+            # the state tree; it must come OUT of the carried state (scan
+            # carry structure) whether or not the sparse update consumes it
+            rows_map, new_state = _embed_engine.pop_stashed_rows(new_state)
             if clip is not None:
                 grads, _ = clip.update(grads, clip.init(params), params)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
+            if not plan:
+                updates, opt_state = optimizer.update(grads, opt_state, params)
+                if frozen:
+                    updates = {k: jax.tree_util.tree_map(jnp.zeros_like, u)
+                               if k in frozen else u
+                               for k, u in updates.items()}
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, new_state, loss
+
+            # sparse path: dense optax over the non-plan leaves, row-subset
+            # updates over the sharded tables (untouched rows' optimizer
+            # state is neither read nor written)
+            kind, hyper = sparse
+            dense_params = {ln: {k: v for k, v in sub.items()
+                                 if (ln, k) not in plan}
+                            for ln, sub in params.items()}
+            dense_params = {ln: sub for ln, sub in dense_params.items() if sub}
+            dense_grads = {ln: {k: g for k, g in sub.items()
+                                if (ln, k) not in plan}
+                           for ln, sub in grads.items()}
+            dense_grads = {ln: sub for ln, sub in dense_grads.items() if sub}
+            updates, dense_opt = optimizer.update(
+                dense_grads, opt_state["dense"], dense_params)
             if frozen:
                 updates = {k: jax.tree_util.tree_map(jnp.zeros_like, u)
                            if k in frozen else u
                            for k, u in updates.items()}
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, new_state, loss
+            new_dense = optax.apply_updates(dense_params, updates)
+            out_params = {ln: dict(sub) for ln, sub in params.items()}
+            for ln, sub in new_dense.items():
+                for k, v in sub.items():
+                    out_params[ln][k] = v
+            embed_opt = {ln: dict(sub)
+                         for ln, sub in opt_state["embed"].items()}
+            for ln, key in sorted(plan):
+                spec = plan[(ln, key)]
+                table, g = params[ln][key], grads[ln][key]
+                rstate = opt_state["embed"][ln][key]
+                blob = rows_map.get(ln, {}).get(key)
+                if ln in frozen:
+                    new_table, new_rstate = table, rstate
+                elif blob is not None:
+                    new_table, new_rstate = _embed_engine.apply_row_update(
+                        kind, hyper, spec, table, g, blob, rstate)
+                else:
+                    # lookup fell back to the dense gather this step (id
+                    # count not divisible over the shards): same optimizer
+                    # arithmetic applied to the whole (sharded) table
+                    new_table, new_rstate = _embed_engine.apply_dense_update(
+                        kind, hyper, table, g, rstate)
+                out_params[ln][key] = new_table
+                embed_opt[ln][key] = new_rstate
+            return (out_params, {"dense": dense_opt, "embed": embed_opt},
+                    new_state, loss)
 
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
@@ -712,6 +849,10 @@ class Estimator:
             # profiler books it as phase=compile, not dispatch
             self._prof_fresh_dispatch = True
             self._prof_cost_done = False
+            # the sharded-embedding engine counts its exchange bytes at
+            # trace time; a fresh step fn re-traces, so re-attribute
+            self._embed_step_bytes = None
+            _embed_engine.reset_trace_bytes()
         if self._tb and self._train_writer is None:
             log_dir, app = self._tb
             self._train_writer = SummaryWriter(os.path.join(log_dir, app, "train"))
@@ -765,6 +906,8 @@ class Estimator:
                     self._multi_step = self._build_multi_step()
                     self._prof_fresh_dispatch = True
                     self._prof_cost_done = False
+                    self._embed_step_bytes = None
+                    _embed_engine.reset_trace_bytes()
                 host_it = _group_host_batches(
                     host_it, batches_per_epoch - skip, batches_per_epoch,
                     group)
@@ -859,6 +1002,14 @@ class Estimator:
                     # examples throughput counter
                     _M_STEP.observe(time.perf_counter() - step_start)
                     _M_EXAMPLES.inc(local_batch * g)
+                    if self._embed_step_bytes is None:
+                        # the first dispatch traced the step: the engine's
+                        # accumulator now holds ONE step's exchange bytes
+                        self._embed_step_bytes = \
+                            _embed_engine.take_trace_bytes()
+                    ex_b, gr_b = self._embed_step_bytes
+                    if ex_b or gr_b:
+                        _embed_engine.note_exchange_bytes(ex_b * g, gr_b * g)
                     if prof:
                         _P_TRAIN.step_end()
 
@@ -1303,6 +1454,7 @@ class Estimator:
         return jax.tree_util.tree_map(np.asarray, self.params)
 
     def set_params(self, params) -> None:
+        self._maybe_add_vocab_rules()
         sharding = param_sharding(self.mesh, params, self.param_rules)
         self.params = jax.device_put(params, sharding)
 
@@ -1318,7 +1470,7 @@ class Estimator:
             # saving a compiled-but-never-stepped model: materialize the
             # optimizer state so the checkpoint restores against the same
             # structure a trained snapshot has
-            self.opt_state = self.optimizer.init(self.params)
+            self.opt_state = self._init_opt_state(self.params)
         tree = {
             "params": jax.tree_util.tree_map(np.asarray, self.params),
             "opt_state": jax.tree_util.tree_map(np.asarray, self.opt_state),
@@ -1520,6 +1672,7 @@ class Estimator:
 
     def _load_checkpoint_local(self, path: str) -> None:
         import orbax.checkpoint as ocp
+        self._maybe_add_vocab_rules()
         ckptr = ocp.PyTreeCheckpointer()
         tree = ckptr.restore(path)
         missing = {"params", "opt_state", "model_state", "meta"} - set(tree)
@@ -1539,7 +1692,7 @@ class Estimator:
         # orbax returns optax NamedTuple states as plain containers; re-restore
         # with a live template so the optimizer state keeps its structure.
         live_opt = (self.opt_state if self.opt_state is not None
-                    else self.optimizer.init(tree["params"]))
+                    else self._init_opt_state(tree["params"]))
         tree = ckptr.restore(path, item={
             "params": tree["params"],
             "opt_state": live_opt,
@@ -1552,7 +1705,8 @@ class Estimator:
             tree["model_state"],
             param_sharding(self.mesh, tree["model_state"], self.param_rules))
         self.opt_state = jax.device_put(
-            tree["opt_state"], param_sharding(self.mesh, tree["opt_state"], None))
+            tree["opt_state"],
+            param_sharding(self.mesh, tree["opt_state"], self._opt_rules()))
         self.global_step = int(tree["meta"]["global_step"])
         self.epoch = int(tree["meta"]["epoch"])
         # a restored model_state (even a legitimately empty one) is final —

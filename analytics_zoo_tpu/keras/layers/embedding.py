@@ -5,12 +5,15 @@ TPU note (SURVEY.md §7 hard part (b)): the reference densifies sparse embedding
 grads through BigDL's allreduce; here gradients of ``jnp.take`` are naturally
 scatter-adds that XLA executes on-device, and under pure DP the psum of the
 dense grad table is the allreduce-stress case benchmarked by Wide&Deep. For
-giant tables, shard the vocab axis over the model axis via
-``parallel.mesh.param_sharding`` rules.
+giant tables, pass ``shard=True``: the vocab axis shards over the mesh via
+the sparse engine in ``parallel/embedding.py`` (dedup-unique -> all-to-all
+exchange -> local gather, segment-sum backward into only the touched shard
+rows), with an optional host-DRAM ``cold_rows`` tail for vocabularies that
+do not fit HBM even sharded. See docs/embeddings.md.
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -19,13 +22,15 @@ import numpy as np
 from .. import initializers
 from ..engine import Layer
 from ...common import file_io
+from ...parallel import embedding as _embed
 
 
 class Embedding(Layer):
     def __init__(self, input_dim: int, output_dim: int, init="uniform",
                  input_length: Optional[int] = None,
                  weights: Optional[np.ndarray] = None, trainable: bool = True,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 shard: Union[bool, str, None] = None, cold_rows: int = 0):
         super().__init__(name)
         self.input_dim = input_dim
         self.output_dim = output_dim
@@ -33,6 +38,40 @@ class Embedding(Layer):
         self.input_length = input_length
         self.weights = weights
         self.trainable = trainable
+        #: False/None = replicated table (historical layout); True = shard
+        #: the vocab axis over the default embedding mesh axis; a string
+        #: names the mesh axis explicitly.
+        self.shard = shard
+        #: last ``cold_rows`` logical rows live in a host-DRAM shared-
+        #: memory slab instead of HBM (parallel.embedding.HostColdTier).
+        self.cold_rows = int(cold_rows)
+        if self.cold_rows < 0 or self.cold_rows >= input_dim:
+            raise ValueError(f"cold_rows={cold_rows} must be in "
+                             f"[0, input_dim={input_dim})")
+        self._shard_spec = None
+        self._cold_tier = None
+
+    @property
+    def hot_dim(self) -> int:
+        """Rows resident on device (input_dim minus the cold tail)."""
+        return self.input_dim - self.cold_rows
+
+    def _make_spec(self):
+        if not self.shard:
+            return None
+        axis = self.shard if isinstance(self.shard, str) else None
+        return _embed.make_shard_spec(self.hot_dim, self.output_dim,
+                                      axis=axis)
+
+    def sharded_tables(self):
+        """``{param_key: ShardSpec}`` for the estimator's sparse-update
+        plan and GSPMD vocab-sharding rules. Deterministic pre-build (a
+        restored checkpoint must init optimizer state before the first
+        trace builds the layer)."""
+        if not self.trainable:
+            return {}
+        spec = self._shard_spec or self._make_spec()
+        return {"embeddings": spec} if spec is not None else {}
 
     def build(self, rng, input_shape):
         if self.weights is not None:
@@ -43,14 +82,59 @@ class Embedding(Layer):
                     f"({self.input_dim}, {self.output_dim})")
         else:
             table = self.init(rng, (self.input_dim, self.output_dim))
+        if self.cold_rows:
+            cold_vals = table[self.hot_dim:]
+            table = table[:self.hot_dim]
+            if self._cold_tier is None:
+                self._cold_tier = _embed.HostColdTier(
+                    self.cold_rows, self.output_dim, name=self.name)
+            if not isinstance(cold_vals, jax.core.Tracer):
+                # abstract (jitted) builds cannot fill the slab; it stays
+                # zero until fill()/load() runs with concrete values
+                self._cold_tier.fill(np.asarray(cold_vals))
+        self._shard_spec = spec = self._make_spec()
+        if spec is not None:
+            pad = spec.padded - table.shape[0]
+            if pad:
+                table = jnp.concatenate(
+                    [table, jnp.zeros((pad, self.output_dim), table.dtype)])
+            _embed.note_table_bytes(self.name, spec.table_bytes)
         if self.trainable:
             return {"embeddings": table}, {}
         return {}, {"embeddings": table}  # frozen: state, not params
 
+    def _lookup(self, table, idx, state):
+        """Validated lookup through the sharded engine (with dense and
+        cold-tier fallthroughs); returns ``(rows, new_state)`` with the
+        exchange blob stashed for the estimator's sparse update."""
+        idx = _embed.validate_ids(idx, self.input_dim)
+        spec, tier = self._shard_spec, self._cold_tier
+        if spec is None and tier is None:
+            return jnp.take(table, idx, axis=0), state
+        flat = idx.reshape(-1)
+        is_cold = (flat >= self.hot_dim) if tier is not None else None
+        new_state = state
+        if spec is not None and _embed.can_run(spec, flat.shape[0]):
+            dev_ids = flat if is_cold is None \
+                else jnp.where(is_cold, spec.padded, flat)
+            out_flat, rows = _embed.sharded_lookup(table, dev_ids, spec)
+            new_state = dict(state)
+            new_state[_embed.ROWS_PREFIX + "embeddings"] = rows
+        else:
+            safe = flat if is_cold is None \
+                else jnp.minimum(flat, self.hot_dim - 1)
+            out_flat = jnp.take(table, safe, axis=0)
+        if is_cold is not None:
+            rel = jnp.where(is_cold, flat - self.hot_dim, -1)
+            cold = _embed.cold_lookup(tier, rel, table[0, 0])
+            out_flat = jnp.where(is_cold[:, None],
+                                 cold.astype(out_flat.dtype), out_flat)
+        return out_flat.reshape(idx.shape + (self.output_dim,)), new_state
+
     def call(self, params, state, inputs, *, training=False, rng=None):
         idx = inputs.astype(jnp.int32)
         table = params["embeddings"] if self.trainable else state["embeddings"]
-        return jnp.take(table, idx, axis=0), state
+        return self._lookup(table, idx, state)
 
     def compute_output_shape(self, input_shape):
         return tuple(input_shape) + (self.output_dim,)
@@ -139,9 +223,10 @@ class SparseEmbedding(Embedding):
 
     def __init__(self, input_dim: int, output_dim: int, combiner: str = "sum",
                  init="uniform", weights=None, trainable: bool = True,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 shard: Union[bool, str, None] = None):
         super().__init__(input_dim, output_dim, init=init, weights=weights,
-                         trainable=trainable, name=name)
+                         trainable=trainable, name=name, shard=shard)
         if combiner not in ("sum", "mean", "sqrtn", None):
             raise ValueError(f"unknown combiner {combiner}")
         self.combiner = combiner
@@ -150,17 +235,30 @@ class SparseEmbedding(Embedding):
         # inputs: [..., bag] int indices; negative ids mean padding
         idx = inputs.astype(jnp.int32)
         table = params["embeddings"] if self.trainable else state["embeddings"]
+        idx = _embed.validate_ids(idx, self.input_dim, allow_negative=True)
         valid = (idx >= 0).astype(table.dtype)[..., None]
-        emb = jnp.take(table, jnp.maximum(idx, 0), axis=0) * valid
+        spec = self._shard_spec
+        new_state = state
+        flat = idx.reshape(-1)
+        if spec is not None and _embed.can_run(spec, flat.shape[0]):
+            # padding ids route to the SENTINEL (zero rows, no grad) —
+            # the valid-mask multiply keeps the combiner math unchanged
+            dev_ids = jnp.where(flat < 0, spec.padded, flat)
+            emb_flat, rows = _embed.sharded_lookup(table, dev_ids, spec)
+            new_state = dict(state)
+            new_state[_embed.ROWS_PREFIX + "embeddings"] = rows
+            emb = emb_flat.reshape(idx.shape + (self.output_dim,)) * valid
+        else:
+            emb = jnp.take(table, jnp.maximum(idx, 0), axis=0) * valid
         if self.combiner is None:
-            return emb, state
+            return emb, new_state
         total = jnp.sum(emb, axis=-2)
         if self.combiner == "sum":
-            return total, state
+            return total, new_state
         n = jnp.maximum(jnp.sum(valid, axis=-2), 1.0)
         if self.combiner == "mean":
-            return total / n, state
-        return total / jnp.sqrt(n), state  # sqrtn
+            return total / n, new_state
+        return total / jnp.sqrt(n), new_state  # sqrtn
 
     def compute_output_shape(self, input_shape):
         if self.combiner is None:
@@ -208,6 +306,11 @@ class SparseDense(Layer):
         if isinstance(inputs, (list, tuple)):
             idx, vals = inputs
             idx = idx.astype(jnp.int32)
+            # ids beyond the kernel used to clamp silently to the last
+            # row; the data.validate_ids policy now counts or raises
+            # (negatives stay legal padding, masked below)
+            idx = _embed.validate_ids(idx, kernel.shape[0],
+                                      allow_negative=True)
             rows = jnp.take(kernel, jnp.maximum(idx, 0), axis=0)
             rows = rows * (idx >= 0).astype(rows.dtype)[..., None]
             y = jnp.einsum("...n,...nd->...d", vals.astype(rows.dtype), rows)

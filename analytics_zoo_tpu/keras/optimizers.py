@@ -44,6 +44,14 @@ def warmup_cosine_decay(lr: float, warmup_steps: int, total_steps: int
 class Optimizer:
     """Named wrapper so models can introspect/serialize their optimizer."""
 
+    #: When set to ``(kind, hyperparams)`` the estimator may apply this
+    #: optimizer to vocab-sharded embedding tables as a sparse row-subset
+    #: update (parallel/embedding.py) — state for untouched rows is neither
+    #: read nor written. ``None`` means the optimizer math has no sparse
+    #: equivalent (momentum/decay/schedules) and sharded tables fall back
+    #: to the dense optax update.
+    sparse_rows = None
+
     def __init__(self, name: str, tx: optax.GradientTransformation,
                  learning_rate: Schedule):
         self.name = name
@@ -65,14 +73,25 @@ def SGD(learningrate: float = 0.01, momentum: float = 0.0, dampening: float = 0.
     if weightdecay > 0:
         parts.append(optax.add_decayed_weights(weightdecay))
     parts.append(optax.sgd(lr, momentum=momentum or None, nesterov=nesterov))
-    return Optimizer("sgd", optax.chain(*parts), lr)
+    opt = Optimizer("sgd", optax.chain(*parts), lr)
+    if (momentum == 0.0 and dampening == 0.0 and not nesterov
+            and weightdecay == 0.0 and learningrate_schedule is None):
+        opt.sparse_rows = ("sgd", {"lr": float(learningrate)})
+    return opt
 
 
 def Adam(learningrate: float = 1e-3, beta1: float = 0.9, beta2: float = 0.999,
          epsilon: float = 1e-8,
          learningrate_schedule: Optional[Schedule] = None) -> Optimizer:
     lr = learningrate_schedule if learningrate_schedule is not None else learningrate
-    return Optimizer("adam", optax.adam(lr, b1=beta1, b2=beta2, eps=epsilon), lr)
+    opt = Optimizer("adam", optax.adam(lr, b1=beta1, b2=beta2, eps=epsilon), lr)
+    if learningrate_schedule is None:
+        # Lazy adam: moments decay only for touched rows. Same fixed point,
+        # NOT bit-identical to dense adam (docs/embeddings.md).
+        opt.sparse_rows = ("adam", {"lr": float(learningrate),
+                                    "b1": float(beta1), "b2": float(beta2),
+                                    "eps": float(epsilon)})
+    return opt
 
 
 def AdamWeightDecay(learningrate: float = 1e-4, warmup_portion: float = -1.0,
@@ -106,7 +125,10 @@ def Adagrad(learningrate: float = 1e-2, weightdecay: float = 0.0) -> Optimizer:
     if weightdecay > 0:
         parts.append(optax.add_decayed_weights(weightdecay))
     parts.append(optax.adagrad(learningrate))
-    return Optimizer("adagrad", optax.chain(*parts), learningrate)
+    opt = Optimizer("adagrad", optax.chain(*parts), learningrate)
+    if weightdecay == 0.0:
+        opt.sparse_rows = ("adagrad", {"lr": float(learningrate), "eps": 1e-7})
+    return opt
 
 
 def Adadelta(decayrate: float = 0.9, epsilon: float = 1e-10) -> Optimizer:

@@ -4,8 +4,9 @@ user/item embeddings, softmax head; same constructor surface).
 
 TPU design notes: the four embedding tables are plain param arrays whose
 lookup gradients XLA turns into on-device scatter-adds; for huge vocabularies
-pass ``shard_embeddings=True`` to the Estimator wiring so the vocab axis is
-sharded over the ``model`` mesh axis.
+pass ``shard_embeddings=True`` so the vocab axis shards over the mesh through
+the sparse engine (``parallel/embedding.py``: all-to-all lookup, segment-sum
+grads into only the touched rows, sparse row-subset optimizer updates).
 """
 from __future__ import annotations
 
@@ -21,7 +22,8 @@ class NeuralCF(Recommender):
     def __init__(self, user_count: int, item_count: int, num_classes: int,
                  user_embed: int = 20, item_embed: int = 20,
                  hidden_layers: Sequence[int] = (40, 20, 10),
-                 include_mf: bool = True, mf_embed: int = 20):
+                 include_mf: bool = True, mf_embed: int = 20,
+                 shard_embeddings=None):
         super().__init__()
         self.user_count = user_count
         self.item_count = item_count
@@ -31,6 +33,9 @@ class NeuralCF(Recommender):
         self.hidden_layers = list(hidden_layers)
         self.include_mf = include_mf
         self.mf_embed = mf_embed
+        #: None/False = replicated tables; True/axis-name = vocab-shard all
+        #: four tables over the mesh (parallel/embedding.py)
+        self.shard_embeddings = shard_embeddings
 
     def get_config(self):
         return {
@@ -38,6 +43,7 @@ class NeuralCF(Recommender):
             "num_classes": self.num_classes, "user_embed": self.user_embed,
             "item_embed": self.item_embed, "hidden_layers": self.hidden_layers,
             "include_mf": self.include_mf, "mf_embed": self.mf_embed,
+            "shard_embeddings": self.shard_embeddings,
         }
 
     def build_model(self) -> Model:
@@ -45,12 +51,13 @@ class NeuralCF(Recommender):
         user = Lambda(lambda x: x[:, 0:1], name="user_select")(pairs)
         item = Lambda(lambda x: x[:, 1:2], name="item_select")(pairs)
 
+        shard = self.shard_embeddings
         mlp_user = Flatten(name="mlp_user_flat")(
             Embedding(self.user_count + 1, self.user_embed, init="normal",
-                      name="mlp_user_table")(user))
+                      name="mlp_user_table", shard=shard)(user))
         mlp_item = Flatten(name="mlp_item_flat")(
             Embedding(self.item_count + 1, self.item_embed, init="normal",
-                      name="mlp_item_table")(item))
+                      name="mlp_item_table", shard=shard)(item))
         h = merge([mlp_user, mlp_item], mode="concat")
         for i, units in enumerate(self.hidden_layers):
             h = Dense(units, activation="relu", name=f"mlp_dense_{i}")(h)
@@ -60,10 +67,10 @@ class NeuralCF(Recommender):
                 raise ValueError("mf_embed must be positive when include_mf")
             mf_user = Flatten(name="mf_user_flat")(
                 Embedding(self.user_count + 1, self.mf_embed, init="normal",
-                          name="mf_user_table")(user))
+                          name="mf_user_table", shard=shard)(user))
             mf_item = Flatten(name="mf_item_flat")(
                 Embedding(self.item_count + 1, self.mf_embed, init="normal",
-                          name="mf_item_table")(item))
+                          name="mf_item_table", shard=shard)(item))
             gmf = merge([mf_user, mf_item], mode="mul")
             h = merge([h, gmf], mode="concat")
         out = Dense(self.num_classes, activation="softmax", name="prediction")(h)

@@ -23,6 +23,7 @@ from ..common import Recommender, register_zoo_model
 from ...keras import Input, Model
 from ...keras.engine import Layer
 from ...keras.layers import Dense, Embedding, Flatten, Lambda, merge
+from ...parallel import embedding as _embed
 
 
 @dataclass
@@ -119,21 +120,57 @@ def features_from_dataframe(df, column_info: ColumnFeatureInfo
 
 
 class _WideLinear(Layer):
-    """Embedding-sum sparse linear layer: the TPU ``SparseDense``."""
+    """Embedding-sum sparse linear layer: the TPU ``SparseDense``.
 
-    def __init__(self, total_dim: int, num_classes: int, name=None):
+    With ``shard`` set, the ``[total_wide_dim, num_classes]`` table vocab-
+    shards over the mesh through ``parallel/embedding.py`` — the hashed-
+    cross vocabulary (easily 100M buckets) stops being replicated per
+    device and its gradient stops being a dense-table allreduce."""
+
+    def __init__(self, total_dim: int, num_classes: int, name=None,
+                 shard=None):
         super().__init__(name)
         self.total_dim = total_dim
         self.num_classes = num_classes
+        self.shard = shard
+        self._shard_spec = None
+
+    def _make_spec(self):
+        if not self.shard:
+            return None
+        axis = self.shard if isinstance(self.shard, str) else None
+        return _embed.make_shard_spec(self.total_dim, self.num_classes,
+                                      axis=axis)
+
+    def sharded_tables(self):
+        spec = self._shard_spec or self._make_spec()
+        return {"table": spec} if spec is not None else {}
 
     def build(self, rng, input_shape):
         import jax
         table = jax.random.uniform(
             rng, (self.total_dim, self.num_classes), minval=-0.05, maxval=0.05)
+        self._shard_spec = spec = self._make_spec()
+        if spec is not None:
+            pad = spec.padded - self.total_dim
+            if pad:
+                table = jnp.concatenate(
+                    [table, jnp.zeros((pad, self.num_classes), table.dtype)])
+            _embed.note_table_bytes(self.name, spec.table_bytes)
         return {"table": table, "bias": jnp.zeros((self.num_classes,))}, {}
 
     def call(self, params, state, inputs, *, training=False, rng=None):
         idx = inputs.astype(jnp.int32)  # [b, n_wide] offset bucket ids
+        idx = _embed.validate_ids(idx, self.total_dim)
+        spec = self._shard_spec
+        flat = idx.reshape(-1)
+        if spec is not None and _embed.can_run(spec, flat.shape[0]):
+            rows, blob = _embed.sharded_lookup(params["table"], flat, spec)
+            out = rows.reshape(idx.shape + (self.num_classes,)).sum(1) \
+                + params["bias"]
+            new_state = dict(state)
+            new_state[_embed.ROWS_PREFIX + "table"] = blob
+            return out, new_state
         out = jnp.take(params["table"], idx, axis=0).sum(1) + params["bias"]
         return out, state
 
@@ -166,7 +203,7 @@ class WideAndDeep(Recommender):
     def __init__(self, model_type: str = "wide_n_deep", num_classes: int = 2,
                  column_info: Optional[ColumnFeatureInfo] = None,
                  hidden_layers: Sequence[int] = (40, 20, 10),
-                 **column_kwargs):
+                 shard_embeddings=None, **column_kwargs):
         super().__init__()
         if model_type not in ("wide", "deep", "wide_n_deep"):
             raise ValueError(f"unknown model_type {model_type}")
@@ -178,12 +215,16 @@ class WideAndDeep(Recommender):
         self.num_classes = num_classes
         self.column_info = column_info
         self.hidden_layers = list(hidden_layers)
+        #: None/False = replicated tables; True/axis-name = vocab-shard the
+        #: wide table and per-column embed tables (parallel/embedding.py)
+        self.shard_embeddings = shard_embeddings
 
     def get_config(self) -> Dict[str, Any]:
         ci = self.column_info
         return {
             "model_type": self.model_type, "num_classes": self.num_classes,
             "hidden_layers": self.hidden_layers,
+            "shard_embeddings": self.shard_embeddings,
             "column_info": {
                 "wide_base_cols": list(ci.wide_base_cols),
                 "wide_base_dims": list(ci.wide_base_dims),
@@ -210,7 +251,8 @@ class WideAndDeep(Recommender):
         wide_out = None
         if ci.wide_cols:
             wide_out = _WideLinear(sum(ci.wide_dims), self.num_classes,
-                                   name="wide_linear")(in_wide)
+                                   name="wide_linear",
+                                   shard=self.shard_embeddings)(in_wide)
 
         deep_out = None
         deep_parts = []
@@ -220,7 +262,8 @@ class WideAndDeep(Recommender):
         for i, (c, din, dout) in enumerate(zip(
                 ci.embed_cols, ci.embed_in_dims, ci.embed_out_dims)):
             col = Lambda(lambda x, i=i: x[:, i:i + 1], name=f"embed_col_{i}")(in_emb)
-            e = Embedding(din, dout, init="normal", name=f"embed_table_{c}")(col)
+            e = Embedding(din, dout, init="normal", name=f"embed_table_{c}",
+                          shard=self.shard_embeddings)(col)
             deep_parts.append(Flatten(name=f"embed_flat_{c}")(e))
         if ci.continuous_cols:
             deep_parts.append(in_cont)

@@ -1,6 +1,11 @@
 from .mesh import (  # noqa: F401
     DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, SEQ_AXIS, data_sharding,
-    global_batch_shapes, param_sharding, replicated, shard_batch)
+    embedding_axis, global_batch_shapes, param_sharding, replicated,
+    shard_batch, vocab_sharding_rule)
+from .embedding import (  # noqa: F401
+    HostColdTier, ShardSpec, apply_dense_update, apply_row_update,
+    cold_lookup, init_row_state, make_shard_spec, set_default_mesh,
+    sharded_lookup, validate_ids)
 from .ring_attention import (  # noqa: F401
     ring_attention, ring_self_attention, ulysses_attention)
 from .moe import MoE, moe_sharding_rule  # noqa: F401

@@ -81,6 +81,41 @@ def param_sharding(mesh: Mesh, params: Any,
     return jax.tree_util.tree_unflatten(treedef, specs)
 
 
+def embedding_axis(mesh: Mesh) -> str:
+    """The mesh axis vocab-sharded embedding tables partition over: the
+    DATA axis when present, else the first axis. Sharding the vocab over
+    the same axis the batch rides means every device requests rows for
+    its own batch shard, so the sharded-lookup backward needs no
+    cross-replica psum (parallel/embedding.py)."""
+    return DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
+
+
+def vocab_sharding_rule(tables):
+    """``param_sharding`` rule for vocab-sharded embedding tables.
+
+    ``tables`` maps ``(layer_name, param_key)`` to the mesh axis the
+    vocab shards over. The rule matches any tree path containing that
+    adjacent key pair — so it shards both the parameter itself
+    (``params[layer][key]``) and its row-wise optimizer state
+    (``opt["embed"][layer][key]["acc" | "mu" | "nu"]``) — and emits
+    ``P(axis, None, ...)`` for rank >= 2 leaves (scalars like the lazy
+    adam step count stay replicated)."""
+    def _key(entry) -> str:
+        return str(getattr(entry, "key", getattr(entry, "name", entry)))
+
+    def rule(path, leaf):
+        ndim = getattr(leaf, "ndim", 0)
+        if ndim < 2:
+            return None
+        names = [_key(p) for p in path]
+        for a, b in zip(names, names[1:]):
+            axis = tables.get((a, b))
+            if axis is not None:
+                return P(axis, *([None] * (ndim - 1)))
+        return None
+    return rule
+
+
 def global_batch_shapes(batch: Any) -> Any:
     """ShapeDtypeStruct pytree for a host batch (for AOT lowering)."""
     return jax.tree_util.tree_map(

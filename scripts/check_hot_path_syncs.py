@@ -27,6 +27,15 @@ the test run at collection time instead (``tests/test_hot_path_lint.py``).
    ``masked_eval_batches`` must not rebuild its ``np.arange`` mask per
    batch (cached-mask fix), and the ``_produce`` producer loop must never
    sync.
+
+4. **Sharded-embedding exchange bodies** (``parallel/embedding.py``:
+   ``_routing``/``_lookup_body``/``_lookup_bwd_body``/``_update_body``,
+   the shard_map-traced lookup/grad/update path): no host syncs, no
+   per-row Python loops (everything stays a vectorized
+   unique/all-to-all/segment-sum pipeline), and no ``one_hot`` calls —
+   a one-hot matmul densifies the [vocab, dim] gradient the segment-sum
+   backward exists to avoid. The ``one_hot`` ban applies to every
+   policed function above, not just the embedding bodies.
 """
 from __future__ import annotations
 
@@ -42,6 +51,11 @@ FEATURESET_PY = os.path.join(_REPO, "analytics_zoo_tpu", "feature",
                              "featureset.py")
 DEVICE_FEED_PY = os.path.join(_REPO, "analytics_zoo_tpu", "feature",
                               "device_feed.py")
+EMBEDDING_PY = os.path.join(_REPO, "analytics_zoo_tpu", "parallel",
+                            "embedding.py")
+
+EMBED_BODIES = ("_routing", "_lookup_body", "_lookup_bwd_body",
+                "_update_body")
 
 HOT_FUNCS = ("evaluate", "_evaluate_direct", "_evaluate_direct_exact",
              "predict")
@@ -60,6 +74,7 @@ _CHECKS: List[Tuple[str, Optional[str], Sequence[str], Sequence[str],
     (DEVICE_FEED_PY, None, ("masked_eval_batches",), ("arange",), False,
      "loops"),
     (DEVICE_FEED_PY, None, ("_produce",), (), False, "loops"),
+    (EMBEDDING_PY, None, EMBED_BODIES, (), True, "body"),
 ]
 
 
@@ -68,7 +83,11 @@ def _banned_call(node: ast.Call, np_attrs: Sequence[str] = ("asarray",)
     f = node.func
     if isinstance(f, ast.Name) and f.id == "float":
         return "float()"
+    if isinstance(f, ast.Name) and f.id == "one_hot":
+        return "one_hot()"
     if isinstance(f, ast.Attribute):
+        if f.attr == "one_hot":
+            return "one_hot()"
         base = f.value
         if (f.attr in np_attrs and isinstance(base, ast.Name)
                 and base.id in ("np", "numpy")):

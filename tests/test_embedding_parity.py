@@ -1,0 +1,190 @@
+"""Estimator-level parity for the sharded sparse-embedding engine: N
+training steps through the real ``Estimator.train`` loop on a 4-device CPU
+mesh must produce BIT-IDENTICAL parameters to the replicated dense
+reference — for NCF and Wide&Deep, for SGD and Adagrad, and across a
+snapshot save -> restore -> continue of a sharded table.
+
+Adam is the documented exception (docs/embeddings.md): the row-subset
+update is LAZY (untouched rows' moments do not decay), so it is checked
+for structure and finiteness, not bit parity.
+"""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from analytics_zoo_tpu.estimator import Estimator
+from analytics_zoo_tpu.feature import FeatureSet
+from analytics_zoo_tpu.keras import objectives
+from analytics_zoo_tpu.keras.optimizers import SGD, Adagrad, Adam
+from analytics_zoo_tpu.models.recommendation.ncf import NeuralCF
+from analytics_zoo_tpu.models.recommendation.wide_and_deep import (
+    ColumnFeatureInfo, WideAndDeep)
+
+USERS, ITEMS, B = 40, 36, 16
+
+
+def _mesh4():
+    return Mesh(np.asarray(jax.devices()[:4]), ("data",))
+
+
+def _loss():
+    return objectives.get("sparse_categorical_crossentropy")
+
+
+def _ncf_fs(n=64):
+    rs = np.random.default_rng(0)
+    x = np.stack([rs.integers(1, USERS + 1, size=(n,)),
+                  rs.integers(1, ITEMS + 1, size=(n,))], 1).astype(np.int32)
+    y = rs.integers(0, 2, size=(n,)).astype(np.int32)
+    return FeatureSet.from_ndarrays(x, y, shuffle=False)
+
+
+def _ncf_estimator(shard, opt, mesh):
+    model = NeuralCF(USERS, ITEMS, 2, user_embed=8, item_embed=8,
+                     hidden_layers=(16, 8), mf_embed=8,
+                     shard_embeddings=shard).build_model()
+    return Estimator(model=model, loss_fn=_loss(), optimizer=opt,
+                     mesh=mesh, seed=7)
+
+
+def _train_ncf(shard, opt, mesh, epochs=1):
+    est = _ncf_estimator(shard, opt, mesh)
+    est.train(_ncf_fs(), batch_size=B, epochs=epochs)
+    return est
+
+
+def _wnd_fs(ci, n=64):
+    rs = np.random.RandomState(0)
+    offsets = np.cumsum([0] + ci.wide_dims)[:-1]
+    wide = np.stack([rs.randint(0, d, n) + off
+                     for d, off in zip(ci.wide_dims, offsets)],
+                    1).astype(np.int32)
+    ind = np.stack([rs.randint(0, d, n) for d in ci.indicator_dims],
+                   1).astype(np.int32)
+    emb = np.stack([rs.randint(0, d, n) for d in ci.embed_in_dims],
+                   1).astype(np.int32)
+    cont = rs.rand(n, 1).astype(np.float32)
+    y = rs.randint(0, 2, n).astype(np.int32)
+    return FeatureSet.from_ndarrays([wide, ind, emb, cont], y,
+                                    shuffle=False)
+
+
+def _train_wnd(shard, opt, mesh):
+    ci = ColumnFeatureInfo(
+        wide_base_cols=["a"], wide_base_dims=[8],
+        wide_cross_cols=["ab"], wide_cross_dims=[64],
+        indicator_cols=["w"], indicator_dims=[4],
+        embed_cols=["a_e"], embed_in_dims=[12], embed_out_dims=[4],
+        continuous_cols=["age"])
+    wnd = WideAndDeep("wide_n_deep", 2, ci, hidden_layers=(8, 4),
+                      shard_embeddings=shard)
+    est = Estimator(model=wnd._ensure_built(), loss_fn=_loss(),
+                    optimizer=opt, mesh=mesh, seed=7)
+    est.train(_wnd_fs(ci), batch_size=B, epochs=1)
+    return est
+
+
+def _assert_params_bitwise(ref, sharded):
+    """Compare trees key-by-key; sharded tables carry padding rows, which
+    are truncated before the bitwise comparison."""
+    pr = jax.tree_util.tree_map(np.asarray, ref.params)
+    ps = jax.tree_util.tree_map(np.asarray, sharded.params)
+    assert set(pr) == set(ps)
+    for lname in sorted(pr):
+        assert set(pr[lname]) == set(ps[lname])
+        for k in sorted(pr[lname]):
+            a, b = pr[lname][k], ps[lname][k]
+            if b.ndim == 2 and b.shape[0] > a.shape[0]:
+                b = b[:a.shape[0]]
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"{lname}/{k} diverged")
+
+
+class TestNCFParity:
+    def test_sgd_bitwise(self, ctx):
+        mesh = _mesh4()
+        ref = _train_ncf(False, SGD(0.1), mesh)
+        sh = _train_ncf(True, SGD(0.1), mesh)
+        assert sh._embed_plan(), "sharded run did not take the sparse path"
+        assert not ref._embed_plan()
+        _assert_params_bitwise(ref, sh)
+
+    def test_adagrad_bitwise_with_row_state(self, ctx):
+        mesh = _mesh4()
+        ref = _train_ncf(False, Adagrad(0.05), mesh)
+        sh = _train_ncf(True, Adagrad(0.05), mesh)
+        _assert_params_bitwise(ref, sh)
+        embed_opt = sh.opt_state["embed"]
+        assert sorted(embed_opt) == ["mf_item_table", "mf_user_table",
+                                     "mlp_item_table", "mlp_user_table"]
+        for sub in embed_opt.values():
+            acc = np.asarray(sub["embeddings"]["acc"])
+            assert np.any(acc > np.float32(0.1))       # touched rows moved
+            assert np.any(acc == np.float32(0.1))      # untouched: pristine
+
+    def test_adam_lazy_trains_and_counts_steps(self, ctx):
+        mesh = _mesh4()
+        sh = _train_ncf(True, Adam(1e-2), mesh)
+        leaves = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, sh.params))
+        assert all(np.isfinite(lf).all() for lf in leaves)
+        for sub in sh.opt_state["embed"].values():
+            assert int(sub["embeddings"]["count"]) == 4  # 64/16 steps
+
+
+class TestWideAndDeepParity:
+    def test_sgd_bitwise(self, ctx):
+        mesh = _mesh4()
+        ref = _train_wnd(False, SGD(0.1), mesh)
+        sh = _train_wnd(True, SGD(0.1), mesh)
+        assert sh._embed_plan()
+        specs = sh._sharded_table_specs()
+        assert ("wide_linear", "table") in specs
+        _assert_params_bitwise(ref, sh)
+
+
+class TestShardedSnapshotResume:
+    def test_resume_matches_straight_run(self, ctx, tmp_path):
+        mesh = _mesh4()
+        straight = _train_ncf(True, SGD(0.1), mesh, epochs=4)
+
+        ck = str(tmp_path / "ck")
+        est_b = _ncf_estimator(True, SGD(0.1), mesh)
+        est_b.set_checkpoint(ck)
+        est_b.train(_ncf_fs(), batch_size=B, epochs=2)
+
+        est_c = _ncf_estimator(True, SGD(0.1), mesh)
+        est_c.set_checkpoint(ck)
+        est_c.load_checkpoint(est_c._latest_snapshot())
+        est_c.train(_ncf_fs(), batch_size=B, epochs=4)
+
+        # the restored sharded tables (padding included) continue exactly
+        _assert_params_bitwise(straight, est_c)
+        pa = jax.tree_util.tree_map(np.asarray, straight.params)
+        pc = jax.tree_util.tree_map(np.asarray, est_c.params)
+        np.testing.assert_array_equal(
+            pa["mf_user_table"]["embeddings"],
+            pc["mf_user_table"]["embeddings"])  # full padded table
+
+    def test_restored_table_keeps_vocab_sharding(self, ctx, tmp_path):
+        mesh = _mesh4()
+        ck = str(tmp_path / "ck")
+        est_a = _ncf_estimator(True, Adagrad(0.05), mesh)
+        est_a.set_checkpoint(ck)
+        est_a.train(_ncf_fs(), batch_size=B, epochs=1)
+
+        est_b = _ncf_estimator(True, Adagrad(0.05), mesh)
+        est_b.set_checkpoint(ck)
+        est_b.load_checkpoint(est_b._latest_snapshot())
+        # row-subset optimizer state survives the round trip bitwise
+        a = np.asarray(
+            est_a.opt_state["embed"]["mf_user_table"]["embeddings"]["acc"])
+        b = np.asarray(
+            est_b.opt_state["embed"]["mf_user_table"]["embeddings"]["acc"])
+        np.testing.assert_array_equal(a, b)
+        sharding = est_b.params["mf_user_table"]["embeddings"].sharding
+        spec = tuple(getattr(sharding, "spec", ()))
+        assert spec and spec[0] == "data", (
+            f"restored table lost its vocab sharding: {sharding}")

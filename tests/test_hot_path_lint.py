@@ -35,10 +35,12 @@ def test_lint_covers_data_plane_files():
     """The policy table must keep policing the data-plane files — a
     refactor that drops them would silently shrink coverage."""
     files = {os.path.basename(row[0]) for row in _lint._CHECKS}
-    assert {"estimator.py", "featureset.py", "device_feed.py"} <= files
+    assert {"estimator.py", "featureset.py", "device_feed.py",
+            "embedding.py"} <= files
     funcs = {fn for row in _lint._CHECKS for fn in row[2]}
     assert {"_gather", "masked_eval_batches", "_produce",
-            "evaluate", "predict"} <= funcs
+            "evaluate", "predict", "_routing", "_lookup_body",
+            "_lookup_bwd_body", "_update_body"} <= funcs
 
 
 def test_lint_catches_a_seeded_sync(tmp_path):
@@ -81,3 +83,27 @@ def test_lint_catches_seeded_data_plane_regressions(tmp_path):
     found = _lint._check_file(str(bad_df), None, ("masked_eval_batches",),
                               ("arange",), False, "loops")
     assert {w for _, _, w in found} == {"np.arange()"}
+
+
+def test_lint_catches_seeded_embedding_regressions(tmp_path):
+    """A one-hot densified gradient, a per-row Python loop, or a host sync
+    inside the sharded lookup/grad bodies must trip the embedding rules."""
+    bad = tmp_path / "embedding.py"
+    bad.write_text(
+        "def _lookup_bwd_body(ct, ids, table):\n"
+        "    onehot = jax.nn.one_hot(ids, table.shape[0])\n"
+        "    grads = [ct[i] for i in range(ct.shape[0])]\n"
+        "    n = float(ct.sum())\n"
+        "    return onehot.T @ ct, grads, n\n")
+    found = _lint._check_file(str(bad), None, _lint.EMBED_BODIES, (),
+                              True, "body")
+    whats = {w for _, _, w in found}
+    assert {"one_hot()", "per-record Python loop", "float()"} <= whats
+
+
+def test_embedding_bodies_are_policed_clean():
+    """The real engine bodies must currently satisfy their own policy (no
+    loops, no syncs, no one_hot) — direct check, independent of _CHECKS."""
+    found = _lint._check_file(_lint.EMBEDDING_PY, None, _lint.EMBED_BODIES,
+                              (), True, "body")
+    assert found == []

@@ -59,8 +59,10 @@ class TestNeuralCF:
         recs = ncf.recommend_for_user(users, items, max_items=2)
         assert set(recs) == {1, 2}
         assert len(recs[1]) == 2
-        # items ranked by descending probability
-        assert recs[1][0][2] >= recs[1][1][2]
+        # ranked by the documented key: (class desc, probability desc) —
+        # probability only breaks ties WITHIN a class
+        keys = [(-c, -p) for _i, c, p in recs[1]]
+        assert keys == sorted(keys)
         recs_i = ncf.recommend_for_item(users, items, max_users=1)
         assert set(recs_i) == {1, 2, 3}
 

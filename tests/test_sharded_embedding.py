@@ -1,0 +1,346 @@
+"""Engine tests for the sharded sparse-embedding plane
+(``parallel/embedding.py``): all-to-all lookup vs the dense gather,
+segment-sum gradients, row-subset optimizer updates, the
+``data.validate_ids`` policy, and the host-DRAM cold tier.
+
+Estimator-level N-step training parity lives in
+``tests/test_embedding_parity.py``; this file stays at the engine API.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.common.config import global_config
+from analytics_zoo_tpu.parallel import embedding as embed
+
+
+def _spec(ctx, vocab=96, dim=8):
+    spec = embed.make_shard_spec(vocab, dim, mesh=ctx.mesh)
+    assert spec is not None and spec.shards == 8
+    return spec
+
+
+def _table(spec, seed=0):
+    rs = np.random.RandomState(seed)
+    return jnp.asarray(rs.randn(spec.padded, spec.dim).astype(np.float32))
+
+
+class TestShardedLookup:
+    def test_forward_matches_dense_gather(self, ctx):
+        spec = _spec(ctx)
+        table = _table(spec)
+        ids = jnp.asarray(np.random.RandomState(1).randint(
+            0, spec.vocab, 64).astype(np.int32))
+        assert embed.can_run(spec, ids.shape[0])
+        rows, blob = jax.jit(embed.sharded_lookup,
+                             static_argnums=(2,))(table, ids, spec)
+        dense = jax.jit(lambda t: jnp.take(t, ids, axis=0))(table)
+        np.testing.assert_array_equal(np.asarray(rows), np.asarray(dense))
+        assert blob.shape[0] == ids.shape[0]  # blob rides the id axis
+
+    def test_sentinel_ids_read_zero_rows(self, ctx):
+        spec = _spec(ctx)
+        table = _table(spec)
+        ids = np.random.RandomState(2).randint(
+            0, spec.vocab, 64).astype(np.int32)
+        ids[::4] = spec.padded  # SENTINEL
+        rows, _ = jax.jit(embed.sharded_lookup, static_argnums=(2,))(
+            table, jnp.asarray(ids), spec)
+        out = np.asarray(rows)
+        np.testing.assert_array_equal(out[::4], 0.0)
+        np.testing.assert_array_equal(
+            out[1::4], np.asarray(table)[ids[1::4]])
+
+    def test_grad_matches_dense_segment_sum(self, ctx):
+        spec = _spec(ctx)
+        table = _table(spec)
+        # repeated ids so the segment-sum accumulation is exercised
+        ids = jnp.asarray((np.arange(64) % 13).astype(np.int32))
+        w = jnp.asarray(np.random.RandomState(3).randn(
+            64, spec.dim).astype(np.float32))
+
+        @jax.jit
+        def sharded_grad(t):
+            def loss(tt):
+                rows, _ = embed.sharded_lookup(tt, ids, spec)
+                return jnp.sum(rows * w)
+            return jax.grad(loss)(t)
+
+        @jax.jit
+        def dense_grad(t):
+            return jax.grad(
+                lambda tt: jnp.sum(jnp.take(tt, ids, axis=0) * w))(t)
+
+        g_sh, g_d = np.asarray(sharded_grad(table)), np.asarray(
+            dense_grad(table))
+        np.testing.assert_array_equal(g_sh, g_d)
+        assert np.all(g_sh[13:] == 0.0)  # untouched rows: exactly zero
+
+    def test_can_run_requires_divisible_ids(self, ctx):
+        spec = _spec(ctx)
+        assert embed.can_run(spec, 64)
+        assert not embed.can_run(spec, 63)   # not divisible by 8
+        assert not embed.can_run(spec, 4)    # fewer ids than shards
+        assert not embed.can_run(None, 64)
+
+    def test_no_spec_without_multi_device_axis(self, ctx):
+        from jax.sharding import Mesh
+        one = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        assert embed.make_shard_spec(96, 8, mesh=one) is None
+
+
+class TestRowUpdates:
+    def test_sgd_touches_only_looked_up_rows(self, ctx):
+        spec = _spec(ctx)
+        table = _table(spec)
+        ids = jnp.asarray((np.arange(64) % 13).astype(np.int32))
+
+        @jax.jit
+        def step(t):
+            def loss(tt):
+                rows, blob = embed.sharded_lookup(tt, ids, spec)
+                return jnp.sum(rows ** 2), blob
+            (_l, blob), g = jax.value_and_grad(loss, has_aux=True)(t)
+            new_t, _ = embed.apply_row_update(
+                "sgd", {"lr": 0.1}, spec, t, g, blob, {})
+            return new_t, g
+
+        new_t, g = step(table)
+        old, new = np.asarray(table), np.asarray(new_t)
+        np.testing.assert_array_equal(new[13:], old[13:])  # untouched
+        assert not np.array_equal(new[:13], old[:13])
+        # same arithmetic as the dense elementwise mirror, bitwise
+        dense_new, _ = jax.jit(lambda t, gg: embed.apply_dense_update(
+            "sgd", {"lr": 0.1}, t, gg, {}))(table, g)
+        np.testing.assert_array_equal(new, np.asarray(dense_new))
+
+    def test_adagrad_row_state_only_accumulates_touched(self, ctx):
+        spec = _spec(ctx)
+        table = _table(spec)
+        ids = jnp.asarray((np.arange(64) % 13).astype(np.int32))
+        state = embed.init_row_state("adagrad", table)
+        np.testing.assert_array_equal(np.asarray(state["acc"]),
+                                      np.float32(0.1))
+
+        @jax.jit
+        def step(t, st):
+            def loss(tt):
+                rows, blob = embed.sharded_lookup(tt, ids, spec)
+                return jnp.sum(rows ** 2), blob
+            (_l, blob), g = jax.value_and_grad(loss, has_aux=True)(t)
+            return embed.apply_row_update(
+                "adagrad", {"lr": 0.1, "eps": 1e-7}, spec, t, g, blob, st)
+
+        new_t, new_st = step(table, state)
+        acc = np.asarray(new_st["acc"])
+        np.testing.assert_array_equal(acc[13:], np.float32(0.1))
+        assert np.all(acc[:13] > np.float32(0.1))
+        np.testing.assert_array_equal(
+            np.asarray(new_t)[13:], np.asarray(table)[13:])
+
+    def test_adam_counts_steps_and_updates_moments(self, ctx):
+        spec = _spec(ctx)
+        table = _table(spec)
+        ids = jnp.asarray((np.arange(64) % 13).astype(np.int32))
+        state = embed.init_row_state("adam", table)
+
+        @jax.jit
+        def step(t, st):
+            def loss(tt):
+                rows, blob = embed.sharded_lookup(tt, ids, spec)
+                return jnp.sum(rows ** 2), blob
+            (_l, blob), g = jax.value_and_grad(loss, has_aux=True)(t)
+            return embed.apply_row_update(
+                "adam", {"lr": 1e-2, "b1": 0.9, "b2": 0.999, "eps": 1e-8},
+                spec, t, g, blob, st)
+
+        t1, s1 = step(table, state)
+        t2, s2 = step(t1, s1)
+        assert int(s2["count"]) == 2
+        mu = np.asarray(s2["mu"])
+        assert np.all(mu[13:] == 0.0)  # lazy: untouched moments never move
+        assert np.isfinite(np.asarray(t2)).all()
+
+    def test_apply_dense_update_mirrors_optax(self):
+        import optax
+        rs = np.random.RandomState(7)
+        t = jnp.asarray(rs.randn(10, 4).astype(np.float32))
+        g = jnp.asarray(rs.randn(10, 4).astype(np.float32))
+
+        tx = optax.sgd(0.1)
+        upd, _ = tx.update({"t": g}, tx.init({"t": t}), {"t": t})
+        ref = optax.apply_updates({"t": t}, upd)["t"]
+        got, _ = embed.apply_dense_update("sgd", {"lr": 0.1}, t, g, {})
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-7, atol=0)
+
+        tx = optax.adam(1e-2)
+        st = tx.init({"t": t})
+        upd, _ = tx.update({"t": g}, st, {"t": t})
+        ref = optax.apply_updates({"t": t}, upd)["t"]
+        got, new_st = embed.apply_dense_update(
+            "adam", {"lr": 1e-2, "b1": 0.9, "b2": 0.999, "eps": 1e-8},
+            t, g, embed.init_row_state("adam", t))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-6, atol=1e-7)
+        assert int(new_st["count"]) == 1
+
+    def test_unknown_kind_raises(self):
+        t = jnp.zeros((4, 2))
+        with pytest.raises(ValueError, match="sparse row update"):
+            embed.init_row_state("rmsprop", t)
+        with pytest.raises(ValueError, match="sparse row update"):
+            embed.apply_dense_update("rmsprop", {"lr": 0.1}, t, t, {})
+
+
+class TestValidateIds:
+    @pytest.fixture(autouse=True)
+    def _restore_mode(self):
+        yield
+        global_config().unset("data.validate_ids")
+
+    def test_raise_mode_raises_on_eager_oob(self):
+        global_config().set("data.validate_ids", "raise")
+        with pytest.raises(ValueError, match="out of range"):
+            embed.validate_ids(jnp.asarray([0, 5, 99]), 10)
+        # in-range ids pass through
+        out = embed.validate_ids(jnp.asarray([0, 5, 9]), 10)
+        np.testing.assert_array_equal(np.asarray(out), [0, 5, 9])
+
+    def test_count_mode_clamps_and_counts(self):
+        global_config().set("data.validate_ids", "count")
+        before = embed._M_OOB.value()
+        out = embed.validate_ids(jnp.asarray([-1, 5, 99]), 10)
+        jax.effects_barrier()
+        np.testing.assert_array_equal(np.asarray(out), [0, 5, 9])
+        assert embed._M_OOB.value() == before + 2
+
+    def test_clamp_mode_stays_silent(self):
+        global_config().set("data.validate_ids", "clamp")
+        before = embed._M_OOB.value()
+        out = embed.validate_ids(jnp.asarray([-1, 99]), 10)
+        jax.effects_barrier()
+        np.testing.assert_array_equal(np.asarray(out), [0, 9])
+        assert embed._M_OOB.value() == before
+
+    def test_allow_negative_keeps_padding_ids(self):
+        global_config().set("data.validate_ids", "raise")
+        out = embed.validate_ids(jnp.asarray([-1, 3, 9]), 10,
+                                 allow_negative=True)
+        np.testing.assert_array_equal(np.asarray(out), [-1, 3, 9])
+        with pytest.raises(ValueError, match="out of range"):
+            embed.validate_ids(jnp.asarray([-1, 99]), 10,
+                               allow_negative=True)
+
+    def test_bad_mode_rejected(self):
+        global_config().set("data.validate_ids", "never")
+        with pytest.raises(ValueError, match="data.validate_ids"):
+            embed.validate_ids(jnp.asarray([1]), 10)
+
+
+class TestColdTier:
+    def test_fetch_roundtrip_and_masking(self):
+        tier = embed.HostColdTier(8, 4, name="t_fetch")
+        try:
+            vals = np.arange(32, dtype=np.float32).reshape(8, 4)
+            tier.fill(vals)
+            out = tier.fetch(np.asarray([2, -1, 7, 99]))
+            np.testing.assert_array_equal(out[0], vals[2])
+            np.testing.assert_array_equal(out[1], 0.0)
+            np.testing.assert_array_equal(out[2], vals[7])
+            np.testing.assert_array_equal(out[3], 0.0)
+        finally:
+            tier.close()
+
+    def test_cold_hits_counter(self):
+        tier = embed.HostColdTier(8, 4, name="t_hits")
+        try:
+            before = embed._M_COLD_HITS.value()
+            tier.fetch(np.asarray([1, 2, -1]))
+            assert embed._M_COLD_HITS.value() == before + 2
+        finally:
+            tier.close()
+
+    def test_backward_trains_the_slab(self):
+        tier = embed.HostColdTier(8, 4, name="t_train", lr=0.5)
+        try:
+            vals = np.ones((8, 4), dtype=np.float32)
+            tier.fill(vals)
+            rel = jnp.asarray([1, 3, -1], dtype=jnp.int32)
+            anchor = jnp.float32(0.0)
+
+            @jax.jit
+            def loss(a):
+                rows = embed.cold_lookup(tier, rel, a)
+                return jnp.sum(rows ** 2)
+
+            jax.grad(loss)(anchor)
+            jax.effects_barrier()
+            # d/drow sum(row^2) = 2*row = 2 -> row - 0.5*2 = 0
+            np.testing.assert_array_equal(tier.view[1], 0.0)
+            np.testing.assert_array_equal(tier.view[3], 0.0)
+            np.testing.assert_array_equal(tier.view[0], 1.0)  # untouched
+        finally:
+            tier.close()
+
+    def test_save_load_roundtrip(self, tmp_path):
+        tier = embed.HostColdTier(4, 2, name="t_save")
+        tier2 = embed.HostColdTier(4, 2, name="t_load")
+        try:
+            vals = np.random.RandomState(0).randn(4, 2).astype(np.float32)
+            tier.fill(vals)
+            p = str(tmp_path / "cold.npy")
+            tier.save(p)
+            tier2.load(p)
+            np.testing.assert_array_equal(tier2.view, vals)
+        finally:
+            tier.close()
+            tier2.close()
+
+    def test_close_releases_bytes_and_is_idempotent(self):
+        g0 = embed._M_COLD_BYTES.value()
+        tier = embed.HostColdTier(8, 4, name="t_close")
+        assert embed._M_COLD_BYTES.value() > g0
+        tier.close()
+        tier.close()
+        assert embed._M_COLD_BYTES.value() == g0
+
+
+class TestPlumbing:
+    def test_pop_stashed_rows_strips_and_preserves_structure(self):
+        state = {
+            "emb": {embed.ROWS_PREFIX + "embeddings": jnp.zeros((2, 3)),
+                    "other": jnp.ones(())},
+            "emb2": {embed.ROWS_PREFIX + "embeddings": jnp.zeros((2, 3))},
+            "bn": {"mean": jnp.zeros((4,))},
+            "scalar": jnp.ones(()),
+        }
+        rows, clean = embed.pop_stashed_rows(state)
+        assert set(rows) == {"emb", "emb2"}
+        assert set(rows["emb"]) == {"embeddings"}
+        assert set(clean) == {"emb", "bn", "scalar"}  # emb2 emptied
+        assert set(clean["emb"]) == {"other"}
+
+    def test_trace_bytes_accumulator(self, ctx):
+        spec = _spec(ctx)
+        table = _table(spec)
+        ids = jnp.asarray(np.zeros(64, np.int32))
+        embed.reset_trace_bytes()
+
+        @jax.jit
+        def step(t):
+            rows, blob = embed.sharded_lookup(t, ids, spec)
+            return jnp.sum(rows)
+
+        step(table)  # trace happens here
+        ex, gr = embed.take_trace_bytes()
+        assert ex > 0
+        assert embed.take_trace_bytes() == (0, 0)  # drained
+
+    def test_exchange_cost_dwarfed_by_dense_grad(self, ctx):
+        spec = embed.make_shard_spec(1 << 16, 64, mesh=ctx.mesh)
+        cost = embed.exchange_cost_bytes(spec, 4096)
+        assert cost["dense_grad_bytes"] > cost["grad_bytes"]
+        assert cost["forward_bytes"] > 0
