@@ -17,9 +17,25 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..keras.layers.attention import _layer_norm, _layer_norm_params
-from ..ops.attention import flash_attention
+from ..ops.attention import (flash_attention, fused_short_applicable,
+                             fused_short_attention)
 from ..ops.decode import (beam_generate, cached_attention,
-                          greedy_generate, init_kv_cache, sample_generate)
+                          greedy_generate, init_kv_cache, init_slot_cache,
+                          sample_generate, slot_attention)
+
+#: prefill length buckets: prompts are right-padded to the smallest bucket
+#: that fits, so ONE compiled prefill program per bucket covers every
+#: prompt length — both for ``generate()`` and for slot joins in the
+#: continuous-batching scheduler (serving/server.py)
+PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512)
+
+
+def prefill_bucket(length: int, max_len: int) -> int:
+    """Smallest prefill bucket >= ``length`` (capped at ``max_len``)."""
+    for b in PREFILL_BUCKETS:
+        if length <= b <= max_len:
+            return b
+    return max_len
 
 
 class TransformerLM:
@@ -115,6 +131,71 @@ class TransformerLM:
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
         return jnp.mean(nll)
 
+    # -- generative prefill + slot decode (continuous batching) ---------------
+
+    def _prefill_attn(self, q, k, v):
+        """Causal attention for the prefill forward: the fused short-seq
+        kernel when the shape qualifies (TPU, bucketed length <= 512), the
+        flash path otherwise — the same cutover the training step uses."""
+        if fused_short_applicable(q.shape[-2], k.shape[-2], True):
+            return fused_short_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=True)
+
+    def prefill_kv(self, params, tokens):
+        """Causal forward over a right-padded prompt block ``[B, Tb]``
+        capturing every block's K/V projections ``[B, H, Tb, D]``.
+
+        This is THE prefill path: ``generate()`` and the slot scheduler
+        both call it with bucket-padded prompts, so a prompt prefilled
+        serially and one joining a slot run the identical compiled program
+        and land bit-identical K/V. Causality keeps real positions
+        independent of the right-padding; the padded tail's K/V is written
+        but never visible (decode masks by per-slot length and overwrites
+        it token by token)."""
+        tokens = tokens.astype(jnp.int32)
+        s = tokens.shape[1]
+        x = params["embed"][tokens] + params["pos"][None, :s]
+        kvs = []
+        for p in params["blocks"]:
+            holder = {}
+
+            def kv_fn(q, k, v, holder=holder):
+                holder["kv"] = (k, v)
+                return self._prefill_attn(q, k, v)
+            x = self._block(p, x, kv_fn)
+            kvs.append(holder["kv"])
+        return kvs
+
+    def init_slot_caches(self, slots: int):
+        """One slot-batched K/V cache per block (float32 — decode parity
+        with the serial ``generate()`` caches)."""
+        return [init_slot_cache(slots, self.n_head, self.max_len,
+                                self._head_dim, jnp.float32)
+                for _ in range(self.n_block)]
+
+    def slot_step(self, params, tokens, lengths, caches):
+        """One decode step over ALL slots: feed ``tokens`` [S] (one per
+        slot), write each slot's K/V at its own ``lengths[s]`` position and
+        attend against its visible prefix. Returns ``(next-token logits
+        [S, V], updated caches)``. Pure and shape-static: slot occupancy
+        and lengths are DATA, so the scheduler jits this once and never
+        recompiles as streams join and leave."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        x = (params["embed"][tokens][:, None]
+             + params["pos"][lengths][:, None])
+        new_caches = []
+        for p, cache in zip(params["blocks"], caches):
+            holder = {}
+
+            def kv_fn(q, k, v, cache=cache, holder=holder):
+                ctx, holder["cache"] = slot_attention(q, k, v, cache,
+                                                      lengths)
+                return ctx
+            x = self._block(p, x, kv_fn)
+            new_caches.append(holder["cache"])
+        x = _layer_norm(params["ln_f"], x)
+        return (x[:, -1] @ params["embed"].T), new_caches
+
     # -- public surface -------------------------------------------------------
 
     def fit(self, tokens, batch_size: int = 32, epochs: int = 1, **kw):
@@ -183,8 +264,23 @@ class TransformerLM:
             x = _layer_norm(params["ln_f"], x)
             return (x[:, -1] @ params["embed"].T), new_caches
 
-        if s > 1:  # prefill everything except the last prompt token
-            _, caches = run(params, prompt[:, :-1], caches)
+        if s > 1:
+            # prefill everything except the last prompt token through the
+            # SAME bucketed causal-forward path the continuous-batching
+            # scheduler uses (fused short-seq kernel on TPU) — one compile
+            # per length bucket instead of re-attending the whole prompt
+            # through the incremental cache per request
+            tb = prefill_bucket(s - 1, self.max_len)
+            padded = jnp.zeros((b, tb), jnp.int32)
+            padded = jax.lax.dynamic_update_slice(padded, prompt[:, :-1],
+                                                  (0, 0))
+            kvs = self.prefill_kv(params, padded)
+            caches = [{"k": c["k"].at[:, :, :tb, :].set(
+                           k.astype(c["k"].dtype)),
+                       "v": c["v"].at[:, :, :tb, :].set(
+                           v.astype(c["v"].dtype)),
+                       "length": jnp.asarray(s - 1, jnp.int32)}
+                      for c, (k, v) in zip(caches, kvs)]
 
         def step_fn(params, token, caches):
             return run(params, token[:, None], caches)

@@ -123,6 +123,10 @@ REGISTRY: Dict[str, Site] = {
     "serving.reload": Site(
         "hot model reload, once per reload attempt — a failed reload "
         "must roll back to the serving model with zero dropped requests"),
+    "serving.decode_step": Site(
+        "generative scheduler, once per fused decode step — a failed step "
+        "must error every active stream (their one terminal result) and "
+        "keep the scheduler serving new requests"),
 }
 
 

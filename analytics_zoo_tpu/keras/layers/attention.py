@@ -120,7 +120,7 @@ class MultiHeadAttention(Layer):
             ctx = fused_short_attention(
                 q, k, v, key_bias=key_bias,
                 dropout_rate=self.attn_drop if drop_rng is not None else 0.0,
-                dropout_rng=drop_rng)
+                dropout_rng=drop_rng, causal=self.causal)
         elif drop_rng is not None:
             # short sequences: the materialized prob matrix is small and the
             # fused-softmax path wins; long ones: streaming + per-block

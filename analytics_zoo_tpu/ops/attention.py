@@ -686,7 +686,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
 # the backward so the recomputed mask matches the forward's).
 
 
-def _fused_short_fwd_kernel(*refs, has_bias: bool, rate: float):
+def _fused_short_fwd_kernel(*refs, has_bias: bool, rate: float,
+                            causal: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -710,6 +711,12 @@ def _fused_short_fwd_kernel(*refs, has_bias: bool, rate: float):
         # pre-broadcast [G, s, s] bf16, already in exp2 units (gridded
         # sub-3D broadcasts crash Mosaic's layout pass)
         s_ = s_ + bias_ref[...].astype(jnp.float32)
+    if causal:
+        # diagonal stays visible, so no row is ever fully masked and the
+        # running max below stays finite
+        row = jax.lax.broadcasted_iota(jnp.int32, s_.shape[1:], 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, s_.shape[1:], 1)
+        s_ = jnp.where((col > row)[None], _NEG_INF, s_)
     m = jnp.max(s_, axis=-1, keepdims=True)
     p = jnp.exp2(s_ - m)
     p = p / jnp.sum(p, axis=-1, keepdims=True)
@@ -725,7 +732,7 @@ def _fused_short_fwd_kernel(*refs, has_bias: bool, rate: float):
 
 
 def _fused_short_bwd_kernel(*refs, scale2: float, has_bias: bool,
-                            rate: float):
+                            rate: float, causal: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -747,6 +754,12 @@ def _fused_short_bwd_kernel(*refs, scale2: float, has_bias: bool,
         preferred_element_type=jnp.float32)  # [G, s, s]
     if bias_ref is not None:
         s_ = s_ + bias_ref[...].astype(jnp.float32)  # [G, s, s], exp2 units
+    if causal:
+        # masking the recomputed scores suffices for the whole backward:
+        # p = 0 above the diagonal, so ds, dv and dk contributions vanish
+        row = jax.lax.broadcasted_iota(jnp.int32, s_.shape[1:], 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, s_.shape[1:], 1)
+        s_ = jnp.where((col > row)[None], _NEG_INF, s_)
     m = jnp.max(s_, axis=-1, keepdims=True)
     p = jnp.exp2(s_ - m)
     p = p / jnp.sum(p, axis=-1, keepdims=True)  # pre-dropout probabilities
@@ -784,8 +797,8 @@ def _fused_short_bwd_kernel(*refs, scale2: float, has_bias: bool,
         preferred_element_type=jnp.float32) * _LN2).astype(dk_ref.dtype)
 
 
-def _fused_short_call(q, k, v, key_bias, scale, rate, seed, fwd=True,
-                      do=None):
+def _fused_short_call(q, k, v, key_bias, scale, rate, seed, causal=False,
+                      fwd=True, do=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -829,14 +842,14 @@ def _fused_short_call(q, k, v, key_bias, scale, rate, seed, fwd=True,
     if fwd:
         out = pl.pallas_call(
             functools.partial(_fused_short_fwd_kernel,
-                              has_bias=has_bias, rate=rate),
+                              has_bias=has_bias, rate=rate, causal=causal),
             out_shape=_vma_struct((bh, s, d), q.dtype, q),
             grid=(bh // G,), in_specs=in_specs, out_specs=tile,
             compiler_params=compiler_params)(*operands)
         return out.reshape(b, h, s, d)
     dq, dk, dv = pl.pallas_call(
         functools.partial(_fused_short_bwd_kernel, scale2=scale,
-                          has_bias=has_bias, rate=rate),
+                          has_bias=has_bias, rate=rate, causal=causal),
         out_shape=(_vma_struct((bh, s, d), q.dtype, q),
                    _vma_struct((bh, s, d), k.dtype, k),
                    _vma_struct((bh, s, d), v.dtype, v)),
@@ -848,20 +861,22 @@ def _fused_short_call(q, k, v, key_bias, scale, rate, seed, fwd=True,
 
 # seed rides as a (traced) int32 array argument — it cannot be a
 # nondiff_argnum (those must be static) — and gets a None cotangent
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
-def _fused_short(q, k, v, key_bias, seed, scale, rate):
-    return _fused_short_call(q, k, v, key_bias, scale, rate, seed, fwd=True)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _fused_short(q, k, v, key_bias, seed, scale, rate, causal):
+    return _fused_short_call(q, k, v, key_bias, scale, rate, seed,
+                             causal=causal, fwd=True)
 
 
-def _fused_short_fwd(q, k, v, key_bias, seed, scale, rate):
-    out = _fused_short_call(q, k, v, key_bias, scale, rate, seed, fwd=True)
+def _fused_short_fwd(q, k, v, key_bias, seed, scale, rate, causal):
+    out = _fused_short_call(q, k, v, key_bias, scale, rate, seed,
+                            causal=causal, fwd=True)
     return out, (q, k, v, key_bias, seed)
 
 
-def _fused_short_bwd(scale, rate, residuals, g):
+def _fused_short_bwd(scale, rate, causal, residuals, g):
     q, k, v, key_bias, seed = residuals
     dq, dk, dv = _fused_short_call(q, k, v, key_bias, scale, rate, seed,
-                                   fwd=False, do=g)
+                                   causal=causal, fwd=False, do=g)
     dbias = None if key_bias is None else jnp.zeros_like(key_bias)
     return dq, dk, dv, dbias, None
 
@@ -877,16 +892,19 @@ def fused_short_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           key_bias: Optional[jax.Array] = None,
                           scale: Optional[float] = None,
                           dropout_rate: float = 0.0,
-                          dropout_rng: Optional[jax.Array] = None
-                          ) -> jax.Array:
-    """Exact (non-streaming) fused attention for short NON-CAUSAL
-    sequences: probabilities never leave VMEM in either direction, and the
-    backward is a single kernel emitting dq/dk/dv. ``key_bias``: optional
-    ``[batch, kv_len]`` additive per-key bias (padding mask). Attention
-    dropout runs in-kernel on the TPU PRNG, deterministically re-seeded in
-    the backward pass. The bias is a PADDING MASK, not a trained quantity —
-    its gradient is zero (same contract as the flash key-bias path); use
-    the XLA paths for trainable biases."""
+                          dropout_rng: Optional[jax.Array] = None,
+                          causal: bool = False) -> jax.Array:
+    """Exact (non-streaming) fused attention for short sequences:
+    probabilities never leave VMEM in either direction, and the backward is
+    a single kernel emitting dq/dk/dv. ``key_bias``: optional
+    ``[batch, kv_len]`` additive per-key bias (padding mask). ``causal``
+    applies the in-kernel lower-triangular mask (the generative prefill
+    path — the whole score block is already resident, so the mask is one
+    VPU select, not a second kernel). Attention dropout runs in-kernel on
+    the TPU PRNG, deterministically re-seeded in the backward pass. The
+    bias is a PADDING MASK, not a trained quantity — its gradient is zero
+    (same contract as the flash key-bias path); use the XLA paths for
+    trainable biases."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     seed = jnp.zeros((), jnp.int32)
     rate = 0.0
@@ -894,11 +912,12 @@ def fused_short_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         rate = float(dropout_rate)
         seed = jax.random.randint(dropout_rng, (), 0, 2 ** 31 - 1,
                                   dtype=jnp.int32)
-    return _fused_short(q, k, v, key_bias, seed, scale, rate)
+    return _fused_short(q, k, v, key_bias, seed, scale, rate, causal)
 
 
 def fused_short_applicable(q_len: int, kv_len: int, causal: bool) -> bool:
-    return (_on_tpu() and not causal and q_len == kv_len
+    del causal  # the kernel masks in-VMEM since the generative-serving PR
+    return (_on_tpu() and q_len == kv_len
             and kv_len <= FUSED_SHORT_MAX_SEQ)
 
 

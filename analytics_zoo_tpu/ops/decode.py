@@ -77,6 +77,100 @@ def cached_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     return ctx.astype(q.dtype), new_cache
 
 
+# -- slot-based cache for continuous batching -------------------------------
+#
+# The generative scheduler (serving/server.py GenerativeServing) keeps S
+# independent streams resident in ONE device-shaped cache so a single fused
+# step advances every occupied slot. All shapes are static: joining,
+# stepping and evicting only move traced indices/masks around, so the step
+# program compiles exactly once (plus one prefill program per length
+# bucket) no matter how streams come and go.
+
+SlotCache = Dict[str, Any]
+
+
+def init_slot_cache(slots: int, heads: int, max_len: int, head_dim: int,
+                    dtype=jnp.float32) -> SlotCache:
+    """Per-block K/V buffers ``[S, H, max_len, D]`` for S decode slots.
+
+    Unlike :func:`init_kv_cache` there is no scalar write position: slots
+    advance independently, so per-slot lengths live in the scheduler-wide
+    slot STATE (:func:`init_slot_state`) shared across blocks."""
+    return {"k": jnp.zeros((slots, heads, max_len, head_dim), dtype),
+            "v": jnp.zeros((slots, heads, max_len, head_dim), dtype)}
+
+
+def init_slot_state(slots: int) -> Dict[str, jax.Array]:
+    """Scheduler-wide occupancy: per-slot fed-token counts + active mask."""
+    return {"length": jnp.zeros((slots,), jnp.int32),
+            "active": jnp.zeros((slots,), bool)}
+
+
+def slot_join(state: Dict[str, jax.Array], slot, length
+              ) -> Dict[str, jax.Array]:
+    """Mark ``slot`` occupied with ``length`` tokens already fed. Both
+    arguments may be traced values — joins never trigger a recompile."""
+    length = jnp.asarray(length, jnp.int32)
+    return {"length": state["length"].at[slot].set(length),
+            "active": state["active"].at[slot].set(True)}
+
+
+def slot_evict(state: Dict[str, jax.Array], mask) -> Dict[str, jax.Array]:
+    """Vacate every slot where ``mask`` [S] is True — one vectorized call
+    evicts any number of finished/expired slots per step."""
+    mask = jnp.asarray(mask)
+    return {"length": jnp.where(mask, 0, state["length"]),
+            "active": state["active"] & ~mask}
+
+
+def slot_insert(cache: SlotCache, slot, k_new: jax.Array, v_new: jax.Array
+                ) -> SlotCache:
+    """Write a prefilled K/V block ``[H, T, D]`` into ``slot`` at position
+    0. ``slot`` may be traced; T is static (length-bucketed by the caller)
+    so one compile per bucket covers every join at that bucket."""
+    k_buf = lax.dynamic_update_slice(
+        cache["k"], k_new[None].astype(cache["k"].dtype), (slot, 0, 0, 0))
+    v_buf = lax.dynamic_update_slice(
+        cache["v"], v_new[None].astype(cache["v"].dtype), (slot, 0, 0, 0))
+    return {"k": k_buf, "v": v_buf}
+
+
+def slot_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
+                   cache: SlotCache, lengths: jax.Array,
+                   scale: Optional[float] = None
+                   ) -> Tuple[jax.Array, SlotCache]:
+    """One decode step over ALL slots: write each slot's new K/V at its own
+    ``lengths[s]`` position, then attend each slot's query against its
+    visible prefix. Mirrors :func:`cached_attention` arithmetic exactly —
+    same contractions, mask and softmax — which is what keeps slot-batched
+    token streams bit-identical to serial decode rows.
+
+    ``q``/``k_new``/``v_new``: ``[S, H, 1, D]``; ``lengths``: [S] int32
+    (tokens fed so far = this step's write position). Returns
+    ``(ctx [S, H, 1, D], updated cache)``; the CALLER advances lengths once
+    after every block has attended (all blocks see pre-increment lengths).
+    """
+    _, _, t, d = q.shape
+    max_len = cache["k"].shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    write = jax.vmap(
+        lambda buf, new, pos: lax.dynamic_update_slice(buf, new,
+                                                       (0, pos, 0)))
+    k_buf = write(cache["k"], k_new.astype(cache["k"].dtype), lengths)
+    v_buf = write(cache["v"], v_new.astype(cache["v"].dtype), lengths)
+    s = jnp.einsum("bhtd,bhkd->bhtk", q, k_buf,
+                   preferred_element_type=jnp.float32) * scale
+    # visibility per slot: prefix [0, length] inclusive — the just-written
+    # position IS visible, exactly as cached_attention's t=1 decode row
+    key_pos = lax.broadcasted_iota(jnp.int32, (t, max_len), 1)
+    visible = key_pos[None] <= lengths[:, None, None]   # [S, 1, max_len]
+    s = jnp.where(visible[:, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum("bhtk,bhkd->bhtd", p.astype(v_buf.dtype), v_buf,
+                     preferred_element_type=jnp.float32)
+    return ctx.astype(q.dtype), {"k": k_buf, "v": v_buf}
+
+
 def _decode_loop(step_fn, params, cache, prompt_last_token,
                  max_new_tokens, eos_id, select_fn, xs) -> jax.Array:
     """Shared scan scaffolding for greedy/sampled decoding: feed a token,
@@ -189,20 +283,18 @@ def beam_generate(step_fn: Callable, params: Any, cache: Any,
     return seqbuf, scores
 
 
-def sample_generate(step_fn: Callable, params: Any, cache: Any,
-                    prompt_last_token: jax.Array, max_new_tokens: int,
-                    rng: jax.Array, temperature: float = 1.0,
-                    top_k: Optional[int] = None,
-                    top_p: Optional[float] = None,
-                    eos_id: Optional[int] = None) -> jax.Array:
-    """Stochastic decoding (temperature / top-k / nucleus), one scan
-    dispatch — same ``step_fn`` contract as :func:`greedy_generate`.
+def make_logit_filter(temperature: float = 1.0, top_k: Optional[int] = None,
+                      top_p: Optional[float] = None
+                      ) -> Callable[[jax.Array], jax.Array]:
+    """Build the sampling logit filter shared by :func:`sample_generate`
+    and the slot-batched generative scheduler (serving/server.py).
 
     Filters compose in the standard order: temperature scales logits,
     ``top_k`` keeps the k highest, ``top_p`` keeps the smallest prefix of
     the sorted distribution with cumulative probability >= top_p; sampling
-    renormalizes over what survives. Finished rows keep emitting
-    ``eos_id``.
+    renormalizes over what survives. Both decode paths composing THIS
+    filter (not a re-implementation) is part of what keeps slot-batched
+    sampled streams bit-identical to serial runs.
     """
     if temperature <= 0:
         raise ValueError("temperature must be > 0 (use greedy_generate "
@@ -229,6 +321,22 @@ def sample_generate(step_fn: Callable, params: Any, cache: Any,
             cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
             logits = jnp.where(logits < cutoff, _NEG_INF, logits)
         return logits
+
+    return filter_logits
+
+
+def sample_generate(step_fn: Callable, params: Any, cache: Any,
+                    prompt_last_token: jax.Array, max_new_tokens: int,
+                    rng: jax.Array, temperature: float = 1.0,
+                    top_k: Optional[int] = None,
+                    top_p: Optional[float] = None,
+                    eos_id: Optional[int] = None) -> jax.Array:
+    """Stochastic decoding (temperature / top-k / nucleus), one scan
+    dispatch — same ``step_fn`` contract as :func:`greedy_generate`.
+    Filter semantics: :func:`make_logit_filter`. Finished rows keep
+    emitting ``eos_id``.
+    """
+    filter_logits = make_logit_filter(temperature, top_k, top_p)
 
     def select(logits, step_rng):
         return jax.random.categorical(

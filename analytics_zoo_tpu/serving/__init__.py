@@ -4,4 +4,5 @@ batched TPU inference → result write-back with backpressure."""
 from .client import InputQueue, OutputQueue  # noqa: F401
 from .config import ServingConfig  # noqa: F401
 from .queues import FileQueue, QueueBackend, RedisQueue, make_queue  # noqa: F401
-from .server import ClusterServing, ModelReloadError  # noqa: F401
+from .server import (ClusterServing, GenerativeServing,  # noqa: F401
+                     ModelReloadError)

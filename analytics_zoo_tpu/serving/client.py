@@ -61,6 +61,24 @@ class InputQueue(_API):
             uri, self._stamp({"tensor": np.asarray(tensor).tolist()},
                              deadline_ms))
 
+    def enqueue_prompt(self, uri: str, tokens,
+                       deadline_ms: Optional[int] = None,
+                       max_new_tokens: Optional[int] = None,
+                       seed: Optional[int] = None) -> None:
+        """Generative request: ``tokens`` is the int prompt sequence.
+        ``max_new_tokens`` caps this stream (else the server's config
+        budget applies); ``seed`` makes sampled decoding reproducible
+        per-request. With a ``deadline_ms``, the budget is enforced PER
+        TOKEN — an expired stream is evicted mid-flight with a deadline
+        error as its one terminal result."""
+        payload: Dict[str, Any] = {
+            "prompt": [int(t) for t in np.asarray(tokens).reshape(-1)]}
+        if max_new_tokens is not None:
+            payload["max_new_tokens"] = int(max_new_tokens)
+        if seed is not None:
+            payload["seed"] = int(seed)
+        self.queue.enqueue(uri, self._stamp(payload, deadline_ms))
+
 
 class OutputQueue(_API):
     def query(self, uri: str, timeout_s: float = 0.0
@@ -85,3 +103,40 @@ class OutputQueue(_API):
             return self.queue.all_results()
         raise NotImplementedError(
             "dequeue-all needs the file queue; use query(uri) with redis")
+
+    def stream(self, uri: str, timeout_s: float = 30.0):
+        """Yield a generative stream's tokens as the server posts them.
+
+        The scheduler overwrites ``uri``'s result with growing partials
+        (``{"stream": [...], "done": false}``) and finally the terminal
+        (``{"value": [...], "done": true}`` or ``{"error": ...}``); this
+        generator polls that single idempotent record and yields each NEW
+        token exactly once, in order. Raises ``RuntimeError`` on an error
+        terminal (shed / deadline / step failure) and ``TimeoutError``
+        after ``timeout_s`` with no progress — progress resets the clock,
+        so a long stream only has to keep moving, not finish fast."""
+        seen = 0
+        deadline = time.monotonic() + timeout_s
+        sleep_s = 0.005
+        while True:
+            res = self.queue.get_result(uri)
+            if res is not None:
+                if "error" in res:
+                    raise RuntimeError(f"stream {uri!r}: {res['error']}")
+                done = bool(res.get("done", True))
+                tokens = res.get("value" if done else "stream") or []
+                if len(tokens) > seen:
+                    for t in tokens[seen:]:
+                        yield t
+                    seen = len(tokens)
+                    deadline = time.monotonic() + timeout_s
+                    sleep_s = 0.005
+                if done:
+                    return
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"stream {uri!r}: no progress in {timeout_s}s "
+                    f"({seen} tokens received)")
+            time.sleep(min(sleep_s, remaining))
+            sleep_s = min(sleep_s * 2, 0.25)

@@ -35,6 +35,15 @@ class ServingConfig:
     #   absorbs before surfacing the backend as dead
     health_path: Optional[str] = None  # periodic + terminal health.json
     health_interval_s: float = 1.0  # min seconds between health writes
+    # -- generative serving (continuous batching) -----------------------------
+    slots: int = 8  # resident decode slots (device batch of the step loop)
+    max_new_tokens: int = 64  # per-stream budget when the request omits one
+    eos_id: Optional[int] = None  # stop token; None = run out the budget
+    stream_interval: int = 1  # post a partial result every N tokens
+    temperature: Optional[float] = None  # sampling knobs: any set => the
+    top_k: Optional[int] = None          # scheduler samples through the
+    top_p: Optional[float] = None        # shared make_logit_filter; all
+    #   None => greedy argmax decoding
 
     @staticmethod
     def from_yaml(path: str) -> "ServingConfig":
@@ -77,6 +86,19 @@ class ServingConfig:
             cfg.shed_wait_ms = int(params["shed_wait_ms"])
         cfg.claim_retries = int(params.get("claim_retries",
                                            cfg.claim_retries))
+        cfg.slots = int(params.get("slots", cfg.slots))
+        cfg.max_new_tokens = int(params.get("max_new_tokens",
+                                            cfg.max_new_tokens))
+        if params.get("eos_id") is not None:
+            cfg.eos_id = int(params["eos_id"])
+        cfg.stream_interval = int(params.get("stream_interval",
+                                             cfg.stream_interval))
+        if params.get("temperature") is not None:
+            cfg.temperature = float(params["temperature"])
+        if params.get("top_k") is not None:
+            cfg.top_k = int(params["top_k"])
+        if params.get("top_p") is not None:
+            cfg.top_p = float(params["top_p"])
         cfg.log_dir = raw.get("log_dir", cfg.log_dir)
         cfg.health_path = raw.get("health_path", cfg.health_path)
         if raw.get("health_interval_s") is not None:

@@ -86,6 +86,17 @@ _M_IN_FLIGHT = _metrics.gauge(
 _M_CLAIM_AGE = _metrics.gauge(
     "serving.claim_age_seconds", "Seconds since the last successful claim.",
     labels=("server",))
+#: generative (continuous-batching) serving telemetry
+_M_TTFT = _metrics.histogram(
+    "serving.ttft_seconds",
+    "Enqueue-to-first-token latency of generative streams.",
+    labels=("server",))
+_M_TOKENS = _metrics.counter(
+    "serving.tokens_total",
+    "Tokens decoded across all generative streams.", labels=("server",))
+_M_SLOTS = _metrics.gauge(
+    "serving.slots_occupied",
+    "Decode slots currently holding an active stream.", labels=("server",))
 
 _instance_ids = itertools.count()
 
@@ -918,6 +929,600 @@ class ClusterServing:
             self._terminal_state = "stopped"
         self._write_health()
         self.check_health()
+
+
+class GenerativeServing:
+    """Token-level continuous batching for ``TransformerLM`` generation.
+
+    ``ClusterServing`` is one-request-one-predict: a full decode occupies
+    the device while other requests queue, so utilization collapses under
+    load. This scheduler keeps ``config.slots`` streams RESIDENT in one
+    slot-batched KV cache (``ops/decode.py``) and advances all of them
+    with ONE fused device step per token; requests join free slots and
+    finished/expired streams are evicted EVERY step, not between requests.
+    All device shapes are static — slot indices, lengths and occupancy are
+    data — so the step program compiles once and prefill compiles once per
+    length bucket (``capture/lm.py PREFILL_BUCKETS``).
+
+    The PR 4 SLO invariant carries over per token: every claimed request
+    gets exactly one terminal result (``{"value": tokens}`` or an error),
+    deadlines are checked every step (an expired stream is evicted
+    mid-flight with a deadline error), overload sheds by the estimated
+    queue wait at the CURRENT smoothed tokens/s, and ``drain()`` stops
+    admitting but finishes in-flight streams. Partial results
+    (``{"stream": [...], "done": false}``) are idempotent overwrites of
+    the same result record — they are progress, not terminals — and
+    ``OutputQueue.stream()`` turns them into a client-side generator.
+
+    Decode parity: slot-batched streams are BIT-IDENTICAL to serial
+    ``TransformerLM.generate()`` runs — both paths share the bucketed
+    prefill (``prefill_kv``), the ``make_logit_filter`` sampling chain and
+    the ``cached_attention``-mirroring ``slot_attention`` arithmetic
+    (tests/test_generative_serving.py holds the line).
+    """
+
+    SHED_INTERVAL_S = 0.05
+
+    def __init__(self, config: ServingConfig, lm,
+                 queue: Optional[QueueBackend] = None):
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.decode import (init_slot_state, make_logit_filter,
+                                  slot_evict, slot_insert, slot_join)
+
+        self.config = config
+        self.lm = lm
+        self.queue = (queue if queue is not None
+                      else make_queue(config.data_src))
+        if config.slots < 1:
+            raise ValueError(f"slots must be >= 1, got {config.slots}")
+        self.slots = int(config.slots)
+        self._sampling = (config.temperature is not None
+                          or config.top_k is not None
+                          or config.top_p is not None)
+        filter_logits = None
+        if self._sampling:
+            filter_logits = make_logit_filter(
+                config.temperature if config.temperature is not None
+                else 1.0, config.top_k, config.top_p)
+        # -- device state: per-block slot caches + ONE shared occupancy ---
+        self._params = lm.params
+        self._caches = lm.init_slot_caches(self.slots)
+        self._state = init_slot_state(self.slots)
+
+        def _step(params, tokens, keys, state, caches):
+            logits, caches = lm.slot_step(params, tokens, state["length"],
+                                          caches)
+            if filter_logits is None:
+                nxt = jnp.argmax(logits, axis=-1)
+            else:
+                filt = filter_logits(logits.astype(jnp.float32))
+                nxt = jax.vmap(lambda kk, row: jax.random.categorical(
+                    kk, row, axis=-1))(keys, filt)
+            # lengths advance ONCE, after every block attended with the
+            # pre-increment value (write-then-attend, as serial decode)
+            state = {"length": (state["length"]
+                                + state["active"].astype(jnp.int32)),
+                     "active": state["active"]}
+            return nxt, state, caches
+
+        def _prefill(params, padded, caches, state, slot, length):
+            kvs = lm.prefill_kv(params, padded)
+            caches = [slot_insert(c, slot, k[0], v[0])
+                      for c, (k, v) in zip(caches, kvs)]
+            return caches, slot_join(state, slot, length)
+
+        self._step_fn = jax.jit(_step)
+        self._prefill_fn = jax.jit(_prefill)  # one compile per bucket
+        self._join_fn = jax.jit(slot_join)    # T==1 prompts: no prefill
+        self._evict_fn = jax.jit(slot_evict)
+        self._split = lambda seed, n: np.asarray(
+            jax.random.split(jax.random.PRNGKey(seed), n))
+        # -- host-side per-slot bookkeeping (scheduler-thread private) ----
+        s = self.slots
+        self._uri: List[Optional[str]] = [None] * s
+        self._tokens: List[Optional[List[int]]] = [None] * s
+        self._budget = [0] * s
+        self._expires: List[Optional[float]] = [None] * s
+        self._enqueue_t = [0.0] * s
+        self._first_t: List[Optional[float]] = [None] * s
+        self._streamed = [0] * s
+        self._keys: List[Optional[np.ndarray]] = [None] * s
+        self._next_tokens = np.zeros(s, np.int32)
+        self._active_host = np.zeros(s, bool)
+        # -- SLO bookkeeping (same registry families as ClusterServing) ---
+        self.metrics_label = f"srv{next(_instance_ids)}"
+        self._m = {key: fam.labels(server=self.metrics_label)
+                   for key, fam in _M_COUNTERS.items()}
+        self._m_records = _M_RECORDS.labels(server=self.metrics_label)
+        self._m_latency = _M_LATENCY.labels(server=self.metrics_label)
+        self._m_depth = _M_QUEUE_DEPTH.labels(server=self.metrics_label)
+        self._m_in_flight = _M_IN_FLIGHT.labels(server=self.metrics_label)
+        self._m_claim_age = _M_CLAIM_AGE.labels(server=self.metrics_label)
+        self._m_ttft = _M_TTFT.labels(server=self.metrics_label)
+        self._m_tokens = _M_TOKENS.labels(server=self.metrics_label)
+        self._m_slots = _M_SLOTS.labels(server=self.metrics_label)
+        self._counter_lock = threading.Lock()
+        self._in_flight = 0
+        self._meta: Dict[str, Tuple[float, Optional[int]]] = {}
+        self._ewma_token_s = 0.0  # smoothed wall seconds per decoded token
+        self._last_claim_m: Optional[float] = None
+        self._last_health_m = -1e18
+        self._last_shed_m = -1e18
+        self._claim_fail_streak = 0
+        self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._loop_running = False
+        self._terminal_state: Optional[str] = None
+
+    # -- terminal accounting (ClusterServing's exactly-one-terminal rule) --
+
+    @property
+    def counters(self) -> Dict[str, int]:
+        return {key: int(c.value()) for key, c in self._m.items()}
+
+    def _count(self, key: str, n: int = 1) -> None:
+        self._m[key].inc(n)
+        if key in ("shed", "expired"):
+            _profiler.on_slo_breach(key)
+
+    def _expiry(self, rec: Dict[str, Any]) -> Optional[float]:
+        deadline_ms = (rec.get("deadline_ms")
+                       or self.config.default_deadline_ms)
+        if not deadline_ms:
+            return None
+        t0 = rec.get("enqueue_t")
+        base = float(t0) if t0 is not None else time.time()
+        return base + float(deadline_ms) / 1000.0
+
+    def _post_terminal(self, uri: str, value: Dict[str, Any]) -> None:
+        """Every claimed request funnels its ONE terminal result (value or
+        error) through here — partial ``stream`` records do NOT."""
+        try:
+            self.queue.put_result(uri, value)
+        except Exception:
+            logger.exception("posting result for %s failed", uri)
+        with self._counter_lock:
+            self._in_flight = max(0, self._in_flight - 1)
+            in_flight = self._in_flight
+            meta = self._meta.pop(uri, None)
+        self._m_in_flight.set(in_flight)
+        if meta is not None:
+            t0, flow_id = meta
+            self._m_latency.observe(max(time.time() - t0, 0.0))
+            _trace.flow_point(flow_id, "serving.result", "f")
+
+    def _retire(self, slot: int, value: Dict[str, Any],
+                counter: Optional[str] = None) -> None:
+        """Terminal-result a slot's stream and free its host bookkeeping
+        (the DEVICE evict is the caller's one vectorized ``_evict_slots``)."""
+        self._post_terminal(self._uri[slot], value)
+        if counter is not None:
+            self._count(counter)
+        elif "value" in value:
+            self._m_records.inc()
+        self._uri[slot] = None
+        self._tokens[slot] = None
+        self._keys[slot] = None
+        self._expires[slot] = None
+        self._first_t[slot] = None
+        self._streamed[slot] = 0
+        self._active_host[slot] = False
+
+    # -- device hot path (policed by scripts/check_hot_path_syncs.py) ------
+
+    def _dispatch_step(self, tokens, keys):
+        # chaos site: a failed fused step must error every active stream
+        # (their one terminal result) and keep the scheduler serving
+        faults.inject("serving.decode_step")
+        t0 = time.perf_counter()
+        out = self._step_fn(self._params, tokens, keys, self._state,
+                            self._caches)
+        _profiler.record_phase("serving", "dispatch",
+                               time.perf_counter() - t0, start=t0)
+        return out
+
+    def _insert_request_device(self, padded, slot, length):
+        self._caches, self._state = self._prefill_fn(
+            self._params, padded, self._caches, self._state, slot, length)
+
+    def _evict_slots(self, mask):
+        self._state = self._evict_fn(self._state, mask)
+
+    def _fetch_tokens(self, nxt) -> np.ndarray:
+        # the one host sync per step, deliberately OUTSIDE the policed
+        # dispatch body: everything queued ahead of it stays async
+        t0 = time.perf_counter()
+        out = np.asarray(nxt)
+        _profiler.record_phase("serving", "fetch",
+                               time.perf_counter() - t0, start=t0)
+        return out
+
+    # -- admission -----------------------------------------------------------
+
+    def _shed(self) -> None:
+        """Admission control at TOKEN granularity: a queued request waits
+        for a free slot, and slots free up at ``slots / (budget x smoothed
+        per-token seconds)`` streams per second — shed the backlog down to
+        what answers within ``shed_wait_ms`` at the CURRENT decode rate."""
+        now = time.monotonic()
+        if now - self._last_shed_m < self.SHED_INTERVAL_S:
+            return
+        self._last_shed_m = now
+        cfg = self.config
+        allowed = cfg.max_pending
+        if cfg.shed_wait_ms and self._ewma_token_s > 0:
+            stream_s = cfg.max_new_tokens * self._ewma_token_s
+            allowed = min(allowed, max(
+                self.slots,
+                int(cfg.shed_wait_ms / 1000.0 / stream_s * self.slots)))
+        try:
+            dropped = self.queue.shed(allowed, reason=SHED_ERROR)
+        except OSError as e:
+            logger.warning("shed pass failed (transient): %r", e)
+            return
+        if dropped:
+            self._count("shed", len(dropped))
+            logger.warning(
+                "overload: shed %d oldest streams with error results "
+                "(allowed depth %d)", len(dropped), allowed)
+
+    def _join(self, slot: int, uri: str, rec: Dict[str, Any],
+              now: float) -> bool:
+        """Validate a claimed request and prefill it into ``slot``. Returns
+        False (slot stays free) when the request terminates immediately
+        (bad prompt, over-budget, already expired)."""
+        from ..capture.lm import prefill_bucket
+
+        cfg = self.config
+        prompt = rec.get("prompt")
+        if not prompt:
+            self._post_terminal(uri, {"error": "empty prompt"})
+            self._count("errors")
+            return False
+        budget = int(rec.get("max_new_tokens") or cfg.max_new_tokens)
+        t = len(prompt)
+        if budget < 1 or t + budget > self.lm.max_len:
+            self._post_terminal(uri, {
+                "error": f"prompt ({t}) + max_new_tokens ({budget}) "
+                         f"out of range for max_len={self.lm.max_len}"})
+            self._count("errors")
+            return False
+        exp = self._expiry(rec)
+        if exp is not None and now >= exp:
+            self._post_terminal(uri, {"error": DEADLINE_ERROR})
+            self._count("expired")
+            return False
+        t0 = time.perf_counter()
+        if t > 1:
+            # right-pad prompt[:-1] to its length bucket: the SAME compiled
+            # prefill program serial generate() uses (bit-parity anchor)
+            tb = prefill_bucket(t - 1, self.lm.max_len)
+            padded = np.zeros((1, tb), np.int32)
+            padded[0, :t - 1] = prompt[:-1]
+            self._insert_request_device(padded, np.int32(slot),
+                                        np.int32(t - 1))
+        else:
+            self._state = self._join_fn(self._state, np.int32(slot),
+                                        np.int32(0))
+        _profiler.record_phase("serving", "host_input",
+                               time.perf_counter() - t0, start=t0)
+        self._uri[slot] = uri
+        self._tokens[slot] = []
+        self._budget[slot] = budget
+        self._expires[slot] = exp
+        self._enqueue_t[slot] = float(rec.get("enqueue_t") or now)
+        self._first_t[slot] = None
+        self._streamed[slot] = 0
+        self._next_tokens[slot] = int(prompt[-1])
+        if self._sampling:
+            seed = rec.get("seed")
+            if seed is None:  # fresh entropy: repeated requests differ
+                seed = int(np.random.SeedSequence().entropy % (2 ** 31))
+            # the FULL per-request key schedule, precomputed once: step i
+            # uses key [i] — identical to serial sample_generate's
+            # split(PRNGKey(seed), budget) schedule
+            self._keys[slot] = self._split(int(seed), budget)
+        self._active_host[slot] = True
+        return True
+
+    def _admit(self) -> None:
+        free = [i for i in range(self.slots) if not self._active_host[i]]
+        if not free:
+            return
+        self._shed()
+        try:
+            got = self.queue.claim_batch(len(free))
+            self._claim_fail_streak = 0
+        except OSError as e:
+            self._count("claim_faults")
+            self._claim_fail_streak += 1
+            if self._claim_fail_streak > self.config.claim_retries:
+                raise  # dead backend, not a flaky one: surface it
+            logger.warning("transient claim failure (%d/%d): %r",
+                           self._claim_fail_streak,
+                           self.config.claim_retries, e)
+            return
+        if not got:
+            return
+        self._last_claim_m = time.monotonic()
+        now = time.time()
+        with self._counter_lock:
+            self._in_flight += len(got)
+            in_flight = self._in_flight
+            for uri, rec in got:
+                self._meta[uri] = (float(rec.get("enqueue_t") or now),
+                                   rec.get("trace_id"))
+        self._m_in_flight.set(in_flight)
+        if _trace.tracing():
+            for uri, rec in got:
+                _trace.flow_point(rec.get("trace_id"), "serving.claim", "t")
+        for uri, rec in got:
+            slot = free.pop(0)
+            if not self._join(slot, uri, rec, now):
+                free.insert(0, slot)
+
+    # -- the step loop -------------------------------------------------------
+
+    def _expire_slots(self) -> None:
+        """Per-token deadline check: an expired stream is evicted
+        MID-FLIGHT — its one terminal result is the deadline error (the
+        partials it already streamed are not terminals)."""
+        now = time.time()
+        mask = np.zeros(self.slots, bool)
+        for i in range(self.slots):
+            if (self._active_host[i] and self._expires[i] is not None
+                    and now >= self._expires[i]):
+                mask[i] = True
+                self._retire(i, {"error": DEADLINE_ERROR}, counter="expired")
+        if mask.any():
+            self._evict_slots(mask)
+
+    def _fail_active(self, message: str) -> None:
+        mask = np.zeros(self.slots, bool)
+        for i in range(self.slots):
+            if self._active_host[i]:
+                mask[i] = True
+                self._retire(i, {"error": message}, counter="errors")
+        if mask.any():
+            self._evict_slots(mask)
+
+    def _post_tokens(self, nxt: np.ndarray) -> None:
+        """Fold one step's tokens into every active stream: TTFT on the
+        first token, partial results every ``stream_interval`` tokens,
+        terminal value + evict on eos / budget exhaustion."""
+        now = time.time()
+        cfg = self.config
+        finished = np.zeros(self.slots, bool)
+        n_tok = 0
+        for i in range(self.slots):
+            if not self._active_host[i]:
+                continue
+            tok = int(nxt[i])
+            self._tokens[i].append(tok)
+            self._next_tokens[i] = tok
+            n_tok += 1
+            if self._first_t[i] is None:
+                self._first_t[i] = now
+                self._m_ttft.observe(max(now - self._enqueue_t[i], 0.0))
+            if (len(self._tokens[i]) >= self._budget[i]
+                    or (cfg.eos_id is not None and tok == cfg.eos_id)):
+                finished[i] = True
+                self._retire(i, {"value": list(self._tokens[i]),
+                                 "done": True})
+            elif (cfg.stream_interval > 0
+                  and (len(self._tokens[i]) - self._streamed[i]
+                       >= cfg.stream_interval)):
+                try:
+                    self.queue.put_result(
+                        self._uri[i], {"stream": list(self._tokens[i]),
+                                       "done": False})
+                    self._streamed[i] = len(self._tokens[i])
+                except Exception:
+                    logger.exception("partial result for %s failed",
+                                     self._uri[i])
+        if n_tok:
+            self._m_tokens.inc(n_tok)
+        if finished.any():
+            self._evict_slots(finished)
+
+    def serve_step(self) -> int:
+        """One scheduler step: evict expired streams, admit new requests
+        into free slots (shed + bucketed prefill), run ONE fused decode
+        step over every occupied slot, stream/terminate per token. Returns
+        the number of streams stepped — the single-step form tests and
+        the bench drive directly; :meth:`run` loops it."""
+        self._maybe_write_health()
+        self._expire_slots()
+        if not self._draining.is_set():
+            self._admit()
+        n_active = int(np.sum(self._active_host))
+        self._m_slots.set(n_active)
+        if n_active == 0:
+            return 0
+        tokens = np.ascontiguousarray(self._next_tokens)
+        keys = np.zeros((self.slots, 2), np.uint32)
+        if self._sampling:
+            for i in range(self.slots):
+                if self._active_host[i]:
+                    keys[i] = self._keys[i][len(self._tokens[i])]
+        t_step = time.perf_counter()
+        try:
+            nxt, state, caches = self._dispatch_step(tokens, keys)
+            nxt_host = self._fetch_tokens(nxt)
+        except Exception as e:
+            logger.exception("decode step failed for %d streams", n_active)
+            self._fail_active(repr(e))
+            return 0
+        self._state, self._caches = state, caches
+        per = (time.perf_counter() - t_step) / n_active
+        self._ewma_token_s = (per if self._ewma_token_s == 0.0
+                              else 0.8 * self._ewma_token_s + 0.2 * per)
+        self._post_tokens(nxt_host)
+        return n_active
+
+    # -- lifecycle (mirrors ClusterServing) ----------------------------------
+
+    def run(self, poll_interval_s: float = 0.005) -> None:
+        logger.info("generative serving started (src=%s slots=%d)",
+                    self.config.data_src, self.slots)
+        self._terminal_state = None
+        self._loop_running = True
+        self._last_shed_m = -1e18
+        try:
+            while not self._stop.is_set():
+                stepped = self.serve_step()
+                if self._draining.is_set() and stepped == 0:
+                    return  # drained: every in-flight stream finished
+                if stepped == 0:
+                    time.sleep(poll_interval_s)
+        finally:
+            self._loop_running = False
+            if self._stop.is_set():
+                self._fail_active(SHUTDOWN_ERROR)
+            self._maybe_write_health()
+
+    def start(self) -> "GenerativeServing":
+        self._stop.clear()
+        self._draining.clear()
+        self._terminal_state = None
+        self._background_error: Optional[BaseException] = None
+
+        def _run() -> None:
+            try:
+                self.run()
+            except BaseException as e:
+                logger.exception("generative serving loop died")
+                self._background_error = e
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+        return self
+
+    def check_health(self) -> None:
+        err = getattr(self, "_background_error", None)
+        if err is not None:
+            raise RuntimeError(
+                "generative serving loop died in the background") from err
+
+    def drain(self, timeout_s: float = 30.0) -> None:
+        """Stop ADMITTING, finish every in-flight stream (each runs out
+        its budget / eos / deadline), then write terminal health."""
+        self._draining.set()
+        if self._loop_running and self._thread is None:
+            return  # foreground run(): the loop finalizes itself
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout_s)
+            if t.is_alive():
+                raise RuntimeError(
+                    f"drain did not complete within {timeout_s}s "
+                    f"({int(np.sum(self._active_host))} streams active)")
+            self._thread = None
+        if self._terminal_state is None:
+            self._terminal_state = "drained"
+        self._write_health()
+        self.check_health()
+
+    def stop(self) -> None:
+        """Hard stop: active streams are answered with explicit shutdown
+        errors (never silently dropped). Use :meth:`drain` for deploys."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            if self._thread.is_alive():
+                self._thread = None
+                raise RuntimeError(
+                    "generative serving loop did not shut down within 10s "
+                    "(queue backend wedged?); thread leaked")
+            self._thread = None
+        else:
+            self._fail_active(SHUTDOWN_ERROR)
+        if self._terminal_state is None:
+            self._terminal_state = "stopped"
+        self._write_health()
+        self.check_health()
+
+    # -- deep health ---------------------------------------------------------
+
+    def health_snapshot(self) -> Dict[str, Any]:
+        """Generative twin of ``ClusterServing.health_snapshot``: lifecycle
+        state, queue depth, slots occupied, tokens decoded, TTFT/latency
+        percentiles and the SLO counters — a per-instance view of the
+        shared metrics registry."""
+        with self._counter_lock:
+            in_flight = self._in_flight
+
+        def _pct(fam, p: float) -> Optional[float]:
+            v = fam.percentile(p)
+            return None if v is None else round(v * 1e3, 3)
+
+        err = getattr(self, "_background_error", None)
+        if self._terminal_state is not None:
+            state = self._terminal_state
+        elif err is not None:
+            state = "crashed"
+        elif self._draining.is_set():
+            state = "draining"
+        elif self._loop_running or (self._thread is not None
+                                    and self._thread.is_alive()):
+            state = "running"
+        else:
+            state = "idle"
+        try:
+            pending = self.queue.pending_count()
+        except Exception:
+            pending = None
+        if pending is not None:
+            self._m_depth.set(pending)
+        self._m_in_flight.set(in_flight)
+        now_m = time.monotonic()
+        claim_age = (round(now_m - self._last_claim_m, 3)
+                     if self._last_claim_m is not None else None)
+        if claim_age is not None:
+            self._m_claim_age.set(claim_age)
+        return {
+            "state": state,
+            "time": time.time(),
+            "queue_pending": pending,
+            "in_flight": in_flight,
+            "slots": self.slots,
+            "slots_occupied": int(np.sum(self._active_host)),
+            "tokens_total": int(self._m_tokens.value()),
+            "tokens_per_sec_ewma": (round(1.0 / self._ewma_token_s, 1)
+                                    if self._ewma_token_s > 0 else None),
+            "last_claim_age_s": claim_age,
+            "ttft_ms": {"p50": _pct(self._m_ttft, 0.50),
+                        "p99": _pct(self._m_ttft, 0.99),
+                        "window": self._m_ttft.count()},
+            "latency_ms": {"p50": _pct(self._m_latency, 0.50),
+                           "p99": _pct(self._m_latency, 0.99),
+                           "window": self._m_latency.count()},
+            "counters": self.counters,
+            "error": repr(err) if err is not None else None,
+        }
+
+    def _write_health(self) -> None:
+        path = self.config.health_path
+        if not path:
+            return
+        tmp = path + ".tmp"
+        try:
+            with file_io.fopen(tmp, "w") as f:
+                f.write(json.dumps(self.health_snapshot()))
+            file_io.replace(tmp, path)
+        except OSError:
+            logger.warning("health write to %s failed", path)
+
+    def _maybe_write_health(self) -> None:
+        if not self.config.health_path:
+            return
+        now = time.monotonic()
+        if now - self._last_health_m >= self.config.health_interval_s:
+            self._last_health_m = now
+            self._write_health()
 
 
 def main() -> None:

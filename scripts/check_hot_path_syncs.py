@@ -36,6 +36,20 @@ the test run at collection time instead (``tests/test_hot_path_lint.py``).
    a one-hot matmul densifies the [vocab, dim] gradient the segment-sum
    backward exists to avoid. The ``one_hot`` ban applies to every
    policed function above, not just the embedding bodies.
+
+5. **Generative decode step loop** (continuous-batching scheduler): the
+   slot-cache ops (``ops/decode.py``: ``init_slot_cache``/``slot_join``/
+   ``slot_evict``/``slot_insert``/``slot_attention``) and the
+   scheduler's device hot path (``serving/server.py GenerativeServing``:
+   ``_dispatch_step``/``_insert_request_device``/``_evict_slots``) must
+   stay pure vectorized jitted dispatches — no host syncs, no per-slot
+   Python loops, no per-token shape changes (a recompile per token is
+   the regression the fixed-shape slot cache exists to prevent). The
+   ``TransformerLM`` step fns (``capture/lm.py``: ``slot_step``/
+   ``prefill_kv``) are policed for syncs only — their per-BLOCK loop is
+   constant-trip tracing, not per-record work. The scheduler's single
+   host fetch per step lives in the deliberately-unpoliced
+   ``_fetch_tokens``.
 """
 from __future__ import annotations
 
@@ -53,9 +67,15 @@ DEVICE_FEED_PY = os.path.join(_REPO, "analytics_zoo_tpu", "feature",
                               "device_feed.py")
 EMBEDDING_PY = os.path.join(_REPO, "analytics_zoo_tpu", "parallel",
                             "embedding.py")
+DECODE_PY = os.path.join(_REPO, "analytics_zoo_tpu", "ops", "decode.py")
+LM_PY = os.path.join(_REPO, "analytics_zoo_tpu", "capture", "lm.py")
+SERVER_PY = os.path.join(_REPO, "analytics_zoo_tpu", "serving", "server.py")
 
 EMBED_BODIES = ("_routing", "_lookup_body", "_lookup_bwd_body",
                 "_update_body")
+
+SLOT_OPS = ("init_slot_cache", "slot_join", "slot_evict", "slot_insert",
+            "slot_attention")
 
 HOT_FUNCS = ("evaluate", "_evaluate_direct", "_evaluate_direct_exact",
              "predict")
@@ -75,6 +95,12 @@ _CHECKS: List[Tuple[str, Optional[str], Sequence[str], Sequence[str],
      "loops"),
     (DEVICE_FEED_PY, None, ("_produce",), (), False, "loops"),
     (EMBEDDING_PY, None, EMBED_BODIES, (), True, "body"),
+    (DECODE_PY, None, SLOT_OPS, (), True, "body"),
+    (LM_PY, "TransformerLM", ("slot_step", "prefill_kv"), (), False,
+     "body"),
+    (SERVER_PY, "GenerativeServing",
+     ("_dispatch_step", "_insert_request_device", "_evict_slots"), (),
+     True, "body"),
 ]
 
 

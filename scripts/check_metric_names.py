@@ -47,9 +47,10 @@ _UNIT_SUFFIX = {"counter": "_total", "histogram": "_seconds"}
 
 #: gauges must say what they measure; any of these suffixes qualifies
 _GAUGE_UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio", "_depth")
-#: gauges that are genuinely unitless: a live request count and the
+#: gauges that are genuinely unitless: live request/slot counts and the
 #: info-style constant-1 build gauge (labels carry the payload)
-_GAUGE_UNITLESS_OK = {"serving.in_flight", "build.info"}
+_GAUGE_UNITLESS_OK = {"serving.in_flight", "serving.slots_occupied",
+                      "build.info"}
 
 
 def _is_registration(node: ast.Call) -> bool:

@@ -29,11 +29,15 @@ class TestRatioMode:
         for impl_key, _value_key in bench._RATIO_PLAN.values():
             assert impl_key in bench._RATIO_IMPLS
 
-    @pytest.mark.parametrize("name", sorted(bench._RATIO_PLAN))
+    @pytest.mark.parametrize("name", [
+        pytest.param(n, marks=pytest.mark.slow) if n == "generate" else n
+        for n in sorted(bench._RATIO_PLAN)])
     def test_every_workload_lands_a_valid_record(self, name, ctx):
         """The outage contract: with no accelerator at all, each workload
         still produces one schema-valid ratio record. Impl results are
-        memoized, so the 13 parametrizations run 7 actual probes."""
+        memoized, so the parametrizations run one actual probe per impl
+        key. The ``generate`` probe decodes 32 serial reference streams
+        (minutes of wall time) and runs in the slow tier."""
         rec = bench._run_ratio(name)
         assert bench._validate_record(rec) == []
         assert rec["metric"] == f"{name}_cpu_ratio"
