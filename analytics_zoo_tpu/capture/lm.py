@@ -18,10 +18,12 @@ import numpy as np
 
 from ..keras.layers.attention import _layer_norm, _layer_norm_params
 from ..ops.attention import (flash_attention, fused_short_applicable,
-                             fused_short_attention)
+                             fused_short_attention, masked_context)
 from ..ops.decode import (beam_generate, cached_attention,
-                          greedy_generate, init_kv_cache, init_slot_cache,
-                          sample_generate, slot_attention)
+                          greedy_generate, init_kv_cache, init_paged_pool,
+                          init_slot_cache, paged_attention, paged_insert,
+                          paged_verify_attention, sample_generate,
+                          slot_attention, slot_insert, speculative_generate)
 
 #: prefill length buckets: prompts are right-padded to the smallest bucket
 #: that fits, so ONE compiled prefill program per bucket covers every
@@ -196,6 +198,95 @@ class TransformerLM:
         x = _layer_norm(params["ln_f"], x)
         return (x[:, -1] @ params["embed"].T), new_caches
 
+    # -- paged decode + speculative verify ------------------------------------
+
+    def init_paged_caches(self, num_pages: int, page_len: int,
+                          int8: bool = False):
+        """One paged KV pool per block (page 0 is the shared null page)."""
+        if self.max_len % page_len:
+            raise ValueError(f"page_len {page_len} must divide "
+                             f"max_len {self.max_len}")
+        return [init_paged_pool(num_pages, self.n_head, page_len,
+                                self._head_dim, jnp.float32, int8=int8)
+                for _ in range(self.n_block)]
+
+    def paged_slot_step(self, params, tokens, lengths, table, caches):
+        """``slot_step`` against the paged pool: same contract, but each
+        slot's K/V lives in the pages its ``table`` row names instead of a
+        private ``max_len`` rectangle. Bit-identical to ``slot_step`` (the
+        gathered buffer differs from the contiguous one only at
+        masked-to-exact-zero positions)."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        x = (params["embed"][tokens][:, None]
+             + params["pos"][lengths][:, None])
+        new_caches = []
+        for p, cache in zip(params["blocks"], caches):
+            holder = {}
+
+            def kv_fn(q, k, v, cache=cache, holder=holder):
+                ctx, holder["cache"] = paged_attention(
+                    q, k, v, cache, table, lengths, self.max_len)
+                return ctx
+            x = self._block(p, x, kv_fn)
+            new_caches.append(holder["cache"])
+        x = _layer_norm(params["ln_f"], x)
+        return (x[:, -1] @ params["embed"].T), new_caches
+
+    def verify_step(self, params, blocks, lengths, table, caches):
+        """Speculative verify: feed ``blocks`` [S, T] (last committed token
+        + T-1 drafts per slot) through the paged cache in ONE batched pass
+        and return FULL logits [S, T, V] plus updated caches. Row ``j``
+        attends causally at position ``lengths + j``; K/V is written at the
+        same positions, so a later round's re-write over rejected drafts
+        lands at identical offsets (no rollback copy needed)."""
+        blocks = jnp.asarray(blocks, jnp.int32)
+        t = blocks.shape[1]
+        positions = lengths[:, None] + jnp.arange(t, dtype=jnp.int32)[None]
+        x = (params["embed"][blocks]
+             + params["pos"][jnp.minimum(positions, self.max_len - 1)])
+        new_caches = []
+        for p, cache in zip(params["blocks"], caches):
+            holder = {}
+
+            def kv_fn(q, k, v, cache=cache, holder=holder):
+                ctx, holder["cache"] = paged_verify_attention(
+                    q, k, v, cache, table, lengths)
+                return ctx
+            x = self._block(p, x, kv_fn)
+            new_caches.append(holder["cache"])
+        x = _layer_norm(params["ln_f"], x)
+        return (x @ params["embed"].T), new_caches
+
+    def prefill_kv_suffix(self, params, tokens, prefix_kvs, prefix_len):
+        """Causal forward over a right-padded SUFFIX block [B, Tb] whose
+        positions start at static ``prefix_len``, attending over the
+        already-materialised prefix K/V (``prefix_kvs``: per-block
+        ``(k, v)`` [B, H, prefix_len, D]) plus the causal suffix. This is
+        the shared-prefix join path: the common prompt's K/V comes from
+        refcounted pages prefilled once, and only the divergent suffix
+        burns a prefill forward."""
+        tokens = tokens.astype(jnp.int32)
+        s = tokens.shape[1]
+        x = (params["embed"][tokens]
+             + params["pos"][None, prefix_len:prefix_len + s])
+        row_pos = jnp.arange(s, dtype=jnp.int32)
+        kvs = []
+        for p, (pk, pv) in zip(params["blocks"], prefix_kvs):
+            holder = {}
+
+            def kv_fn(q, k, v, pk=pk, pv=pv, holder=holder):
+                holder["kv"] = (k, v)
+                k_buf = jnp.concatenate([pk.astype(k.dtype), k], axis=2)
+                v_buf = jnp.concatenate([pv.astype(v.dtype), v], axis=2)
+                key_pos = jnp.arange(prefix_len + s, dtype=jnp.int32)
+                visible = (key_pos[None, None, None, :]
+                           <= prefix_len + row_pos[None, None, :, None])
+                scale = 1.0 / (q.shape[-1] ** 0.5)
+                return masked_context(q, k_buf, v_buf, visible, scale)
+            x = self._block(p, x, kv_fn)
+            kvs.append(holder["kv"])
+        return kvs
+
     # -- public surface -------------------------------------------------------
 
     def fit(self, tokens, batch_size: int = 32, epochs: int = 1, **kw):
@@ -301,3 +392,87 @@ class TransformerLM:
         return np.asarray(greedy_generate(
             step_fn, params, caches, prompt[:, -1], max_new_tokens,
             eos_id=eos_id))
+
+    def generate_speculative(self, prompt, draft_lm: "TransformerLM",
+                             max_new_tokens: int, spec_k: int = 4,
+                             eos_id: Optional[int] = None,
+                             temperature: Optional[float] = None,
+                             top_k: Optional[int] = None,
+                             top_p: Optional[float] = None,
+                             seed: Optional[int] = None,
+                             page_len: int = 16) -> np.ndarray:
+        """Speculative continuation of ``prompt`` [B, S] through the PAGED
+        target cache: ``draft_lm`` proposes ``spec_k`` tokens per round off
+        its contiguous slot cache, the target verifies the whole block in
+        one batched ``verify_step``, and the standard accept rule keeps the
+        longest agreeing run. Greedy output is token-identical to
+        ``generate()``; sampled output follows the Leviathan accept/resample
+        rule (exact target distribution). Both prompts are prefilled through
+        the same bucketed path as ``generate()``."""
+        sampling = (temperature is not None or top_k is not None
+                    or top_p is not None)
+        prompt = jnp.asarray(np.asarray(prompt), jnp.int32)
+        b, s = prompt.shape
+        if spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        if s + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_len={self.max_len}")
+        if s + max_new_tokens + spec_k > draft_lm.max_len:
+            raise ValueError(
+                f"draft max_len={draft_lm.max_len} too short for prompt "
+                f"({s}) + max_new_tokens ({max_new_tokens}) + spec_k "
+                f"({spec_k}) transient draft positions")
+        if self.max_len % page_len:
+            raise ValueError(f"page_len {page_len} must divide "
+                             f"max_len {self.max_len}")
+        params, dparams = self.params, draft_lm.params
+        pl = page_len
+        # statically assigned private pages per row, wide enough for the
+        # prompt, the budget, and the transient spec_k overshoot
+        per_row = (s + max_new_tokens + spec_k + pl - 1) // pl
+        width = (self.max_len + spec_k + pl - 1) // pl
+        table_host = np.zeros((b, width), np.int32)
+        for r in range(b):
+            table_host[r, :per_row] = 1 + r * per_row + np.arange(per_row)
+        table = jnp.asarray(table_host)
+        caches = self.init_paged_caches(b * per_row + 1, pl)
+        dcaches = draft_lm.init_slot_caches(b)
+        lengths0 = jnp.full((b,), s - 1, jnp.int32)
+        if s > 1:
+            tb = prefill_bucket(s - 1, self.max_len)
+            padded = jnp.zeros((b, tb), jnp.int32)
+            padded = jax.lax.dynamic_update_slice(padded, prompt[:, :-1],
+                                                  (0, 0))
+            kvs = self.prefill_kv(params, padded)
+            for r in range(b):
+                caches = [paged_insert(c, table[r], k[r], v[r])
+                          for c, (k, v) in zip(caches, kvs)]
+            dtb = prefill_bucket(s - 1, draft_lm.max_len)
+            dpadded = jnp.zeros((b, dtb), jnp.int32)
+            dpadded = jax.lax.dynamic_update_slice(dpadded, prompt[:, :-1],
+                                                   (0, 0))
+            dkvs = draft_lm.prefill_kv(dparams, dpadded)
+            for r in range(b):
+                dcaches = [slot_insert(c, r, k[r], v[r])
+                           for c, (k, v) in zip(dcaches, dkvs)]
+
+        def draft_step_fn(dp, toks, ln, dc):
+            return draft_lm.slot_step(dp, toks, ln, dc)
+
+        def verify_fn(tp, block, ln, tc):
+            return self.verify_step(tp, block, ln, table, tc)
+
+        rng = None
+        if sampling:
+            if seed is None:
+                seed = int(np.random.SeedSequence().entropy % (2 ** 31))
+            rng = jax.random.PRNGKey(seed)
+        out = speculative_generate(
+            draft_step_fn, verify_fn, dparams, params, dcaches, caches,
+            prompt[:, -1], lengths0, max_new_tokens, spec_k, eos_id=eos_id,
+            rng=rng,
+            temperature=temperature if temperature is not None else 1.0,
+            top_k=top_k, top_p=top_p)
+        return np.asarray(out)

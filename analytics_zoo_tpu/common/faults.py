@@ -127,6 +127,11 @@ REGISTRY: Dict[str, Site] = {
         "generative scheduler, once per fused decode step — a failed step "
         "must error every active stream (their one terminal result) and "
         "keep the scheduler serving new requests"),
+    "serving.page_alloc": Site(
+        "paged KV allocator, at stream join — simulates pool exhaustion; "
+        "the request must be SHED with a terminal page-shed error while "
+        "every resident stream keeps decoding (no crash, no stall)",
+        kind="flag"),
 }
 
 

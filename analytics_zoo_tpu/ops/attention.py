@@ -52,6 +52,33 @@ def _largest_divisor_leq(n: int, cap: int) -> int:
     return 1
 
 
+def masked_context(q: jax.Array, k_buf: jax.Array, v_buf: jax.Array,
+                   visible: jax.Array, scale: float) -> jax.Array:
+    """THE decode-cache attention arithmetic, shared verbatim by every KV
+    engine (``ops/decode.py``: ``cached_attention``, ``slot_attention``,
+    ``paged_attention`` and the speculative verify path).
+
+    ``softmax(q k^T * scale  masked to `visible`) v`` with f32 score/context
+    accumulation. One shared body is what makes the engines' bit-identity
+    guarantees structural rather than coincidental: invisible positions are
+    forced to exactly ``_NEG_INF`` so their softmax probability underflows
+    to exactly 0.0 — the masked tail contributes exact-zero terms to the
+    context sum, which is why buffers that differ only in masked positions
+    (contiguous garbage vs paged-pool garbage vs right-padding) still
+    produce bit-identical contexts.
+
+    ``q``: ``[B, H, T, D]``; ``k_buf``/``v_buf``: ``[B, H, K, D]``;
+    ``visible`` broadcasts against scores ``[B, H, T, K]``.
+    """
+    s = jnp.einsum("bhtd,bhkd->bhtk", q, k_buf,
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(visible, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum("bhtk,bhkd->bhtd", p.astype(v_buf.dtype), v_buf,
+                     preferred_element_type=jnp.float32)
+    return ctx.astype(q.dtype)
+
+
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           bias: Optional[jax.Array] = None,
                           causal: bool = False,

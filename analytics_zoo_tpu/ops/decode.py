@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .attention import _NEG_INF
+from .attention import _NEG_INF, masked_context
+from .int8_dataflow import next_amax, quant_int8, scale_of_amax
 
 KVCache = Dict[str, Any]
 
@@ -51,8 +52,11 @@ def cached_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     start = cache["length"]
     # capacity guard: under eager execution (concrete length) overflowing
-    # the static buffer raises here; under jit the caller owns the budget
-    # (max_len - length tokens remain) — overflow would silently corrupt
+    # the static buffer raises here; under jit ``length`` is a Tracer so
+    # this check SILENTLY SKIPS — the caller owns the budget (max_len -
+    # length tokens remain) and overflow would silently corrupt the tail.
+    # Use :func:`checked_cached_attention` where the write position is
+    # traced and a runtime-checkable guard is wanted.
     import jax.core as _core
     if not isinstance(start, _core.Tracer) and int(start) + t > max_len:
         raise ValueError(
@@ -62,19 +66,49 @@ def cached_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
                                      (0, 0, start, 0))
     v_buf = lax.dynamic_update_slice(cache["v"], v_new.astype(cache["v"].dtype),
                                      (0, 0, start, 0))
-    s = jnp.einsum("bhtd,bhkd->bhtk", q, k_buf,
-                   preferred_element_type=jnp.float32) * scale
     # visibility: cached prefix [0, start) plus the causal part of the new
     # block [start, start+t)
     key_pos = lax.broadcasted_iota(jnp.int32, (t, max_len), 1)
     row_pos = start + lax.broadcasted_iota(jnp.int32, (t, max_len), 0)
     visible = key_pos <= row_pos
-    s = jnp.where(visible[None, None], s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    ctx = jnp.einsum("bhtk,bhkd->bhtd", p.astype(v_buf.dtype), v_buf,
-                     preferred_element_type=jnp.float32)
+    ctx = masked_context(q, k_buf, v_buf, visible[None, None], scale)
     new_cache = {"k": k_buf, "v": v_buf, "length": start + t}
-    return ctx.astype(q.dtype), new_cache
+    return ctx, new_cache
+
+
+def checked_cached_attention(q: jax.Array, k_new: jax.Array,
+                             v_new: jax.Array, cache: KVCache,
+                             scale: Optional[float] = None
+                             ) -> Tuple[jax.Array, KVCache]:
+    """:func:`cached_attention` with a RUNTIME-checkable capacity guard.
+
+    The eager guard in :func:`cached_attention` is skipped whenever
+    ``cache["length"]`` is a tracer (i.e. under ``jit`` — exactly where
+    every production decode loop runs), so an overflowing write silently
+    wraps into ``dynamic_update_slice``'s clamped behavior and corrupts
+    the newest cache tail. This variant stages a ``checkify`` predicate
+    that travels THROUGH jit and fires at runtime with the offending
+    position. Use it by functionalizing the error with
+    ``jax.experimental.checkify``::
+
+        from jax.experimental import checkify
+        step = jax.jit(checkify.checkify(decode_step))
+        err, (ctx, cache) = step(q, k_new, v_new, cache)
+        err.throw()   # raises on overflow, no-op otherwise
+
+    The check is metadata riding the jitted program — the decode math and
+    cache layout are bit-identical to :func:`cached_attention`.
+    """
+    from jax.experimental import checkify
+    t = q.shape[2]
+    max_len = cache["k"].shape[2]
+    checkify.check(
+        cache["length"] + t <= max_len,
+        "KV cache overflow: writing {t} tokens at position {start} "
+        "exceeds max_len={max_len}",
+        t=jnp.asarray(t, jnp.int32), start=cache["length"],
+        max_len=jnp.asarray(max_len, jnp.int32))
+    return cached_attention(q, k_new, v_new, cache, scale)
 
 
 # -- slot-based cache for continuous batching -------------------------------
@@ -158,17 +192,244 @@ def slot_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
                                                        (0, pos, 0)))
     k_buf = write(cache["k"], k_new.astype(cache["k"].dtype), lengths)
     v_buf = write(cache["v"], v_new.astype(cache["v"].dtype), lengths)
-    s = jnp.einsum("bhtd,bhkd->bhtk", q, k_buf,
-                   preferred_element_type=jnp.float32) * scale
     # visibility per slot: prefix [0, length] inclusive — the just-written
     # position IS visible, exactly as cached_attention's t=1 decode row
     key_pos = lax.broadcasted_iota(jnp.int32, (t, max_len), 1)
     visible = key_pos[None] <= lengths[:, None, None]   # [S, 1, max_len]
-    s = jnp.where(visible[:, None], s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    ctx = jnp.einsum("bhtk,bhkd->bhtd", p.astype(v_buf.dtype), v_buf,
-                     preferred_element_type=jnp.float32)
-    return ctx.astype(q.dtype), {"k": k_buf, "v": v_buf}
+    ctx = masked_context(q, k_buf, v_buf, visible[:, None], scale)
+    return ctx, {"k": k_buf, "v": v_buf}
+
+
+# -- paged KV cache (block-granular allocation + per-slot page tables) ------
+#
+# The slot engine above reserves a contiguous [S, H, max_len, D] rectangle
+# per block: HBM pays for max_len whether a stream uses it or not. The paged
+# engine (vLLM's PagedAttention transplanted onto the traced-index slot
+# machinery) replaces the rectangles with ONE global pool of fixed-size
+# pages [P, H, page_len, D] plus a per-slot page TABLE [S, W] of pool
+# indices in logical order — a stream only holds the pages its prompt +
+# budget actually need, and identical prompt prefixes can share refcounted
+# pages (copy-on-write, managed by the scheduler in serving/server.py).
+#
+# Page 0 is the NULL page: never allocated to a stream, it absorbs the
+# writes of inactive slots and of positions past a slot's allocation (the
+# same way inactive slots harmlessly write into their own rectangle in the
+# contiguous engine). Bit-identity with the slot engine holds because
+# attention gathers a slot's pages back into logical [max_len] order and
+# runs the SAME masked_context arithmetic: garbage beyond a slot's length —
+# null-page junk here, stale rectangle tail there — is masked to exactly
+# _NEG_INF and contributes exact-zero terms either way.
+#
+# All shapes are static: tables, lengths and page ids are DATA, so joins,
+# evictions and CoW copies never recompile the step program. The int8
+# variant stores the pool as int8 plus a per-token-position f32 scale
+# ([P, page_len]) using the delayed-scaling recipe from ops/int8_dataflow
+# (quantize with the RUNNING amax — no max pass on the decode hot path).
+
+PagedCache = Dict[str, Any]
+
+
+def init_paged_pool(num_pages: int, heads: int, page_len: int,
+                    head_dim: int, dtype=jnp.float32,
+                    int8: bool = False) -> PagedCache:
+    """Global K/V page pool ``[P, H, page_len, D]`` (per transformer
+    block). Page 0 is reserved as the null page — allocators hand out ids
+    ``1..P-1``. With ``int8=True`` the pool stores int8 payloads plus a
+    per-position f32 scale ``[P, page_len]`` and per-pool running amax
+    scalars (delayed scaling, seeded at 1.0 so the cold-start scale is
+    sane for layer-normed activations)."""
+    if num_pages < 2:
+        raise ValueError(f"num_pages must be >= 2 (page 0 is the reserved "
+                         f"null page), got {num_pages}")
+    if page_len < 1:
+        raise ValueError(f"page_len must be >= 1, got {page_len}")
+    if int8:
+        return {"k": jnp.zeros((num_pages, heads, page_len, head_dim),
+                               jnp.int8),
+                "v": jnp.zeros((num_pages, heads, page_len, head_dim),
+                               jnp.int8),
+                "scale_k": jnp.zeros((num_pages, page_len), jnp.float32),
+                "scale_v": jnp.zeros((num_pages, page_len), jnp.float32),
+                "amax_k": jnp.ones((), jnp.float32),
+                "amax_v": jnp.ones((), jnp.float32)}
+    return {"k": jnp.zeros((num_pages, heads, page_len, head_dim), dtype),
+            "v": jnp.zeros((num_pages, heads, page_len, head_dim), dtype)}
+
+
+def page_table_set(table: jax.Array, slot, row: jax.Array) -> jax.Array:
+    """Install ``row`` [W] as ``slot``'s page table. Both may be traced —
+    joins never recompile."""
+    return lax.dynamic_update_slice(table, row[None].astype(table.dtype),
+                                    (slot, 0))
+
+
+def page_table_clear(table: jax.Array, mask) -> jax.Array:
+    """Zero (→ null page) every table row where ``mask`` [S] is True — the
+    paged twin of :func:`slot_evict`, one vectorized call for any number
+    of evictions."""
+    return jnp.where(jnp.asarray(mask)[:, None], 0, table)
+
+
+def page_copy(cache: PagedCache, src, dst) -> PagedCache:
+    """Copy page ``src`` into page ``dst`` (copy-on-write: a stream that
+    would append into a shared, partially-filled prefix tail page gets a
+    private copy instead). Indices may be traced."""
+    new = {"k": cache["k"].at[dst].set(cache["k"][src]),
+           "v": cache["v"].at[dst].set(cache["v"][src])}
+    if "scale_k" in cache:
+        new["scale_k"] = cache["scale_k"].at[dst].set(cache["scale_k"][src])
+        new["scale_v"] = cache["scale_v"].at[dst].set(cache["scale_v"][src])
+        new["amax_k"] = cache["amax_k"]
+        new["amax_v"] = cache["amax_v"]
+    return new
+
+
+def _page_positions(table: jax.Array, positions: jax.Array, page_len: int
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Map logical token ``positions`` [S, T] through per-slot ``table``
+    [S, W] rows to (pool page ids, in-page offsets). Positions past a
+    table's width land on the null page (id 0)."""
+    w = table.shape[1]
+    idx = positions // page_len
+    page = jnp.take_along_axis(table, jnp.minimum(idx, w - 1), axis=1)
+    page = jnp.where(idx < w, page, 0)
+    return page, positions % page_len
+
+
+def _paged_write(cache: PagedCache, pages: jax.Array, offs: jax.Array,
+                 k_rows: jax.Array, v_rows: jax.Array,
+                 inline_amax: bool) -> PagedCache:
+    """Scatter token rows (``[..., H, D]``, leading dims matching
+    ``pages``/``offs``) into the pool. int8 pools quantize on the way in:
+    ``inline_amax=True`` (prefill/join path, off the token hot loop) folds
+    the block's own amax into the scale; ``inline_amax=False`` (decode hot
+    path) uses the DELAYED running scale — no max pass over the write."""
+    if "scale_k" not in cache:
+        return {"k": cache["k"].at[pages, :, offs, :].set(
+                    k_rows.astype(cache["k"].dtype)),
+                "v": cache["v"].at[pages, :, offs, :].set(
+                    v_rows.astype(cache["v"].dtype))}
+    kf = k_rows.astype(jnp.float32)
+    vf = v_rows.astype(jnp.float32)
+    seen_k = jnp.max(jnp.abs(kf))
+    seen_v = jnp.max(jnp.abs(vf))
+    amax_k = (jnp.maximum(cache["amax_k"], seen_k) if inline_amax
+              else cache["amax_k"])
+    amax_v = (jnp.maximum(cache["amax_v"], seen_v) if inline_amax
+              else cache["amax_v"])
+    sk = scale_of_amax(amax_k)
+    sv = scale_of_amax(amax_v)
+    return {"k": cache["k"].at[pages, :, offs, :].set(quant_int8(kf, sk)),
+            "v": cache["v"].at[pages, :, offs, :].set(quant_int8(vf, sv)),
+            "scale_k": cache["scale_k"].at[pages, offs].set(
+                jnp.broadcast_to(sk, pages.shape)),
+            "scale_v": cache["scale_v"].at[pages, offs].set(
+                jnp.broadcast_to(sv, pages.shape)),
+            "amax_k": next_amax(cache["amax_k"], seen_k),
+            "amax_v": next_amax(cache["amax_v"], seen_v)}
+
+
+def paged_gather(cache: PagedCache, table: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Gather per-slot pages back into logical order: ``table`` [S, C] →
+    K/V ``[S, H, C*page_len, D]`` (dequantized to f32 for int8 pools).
+    This materializes the logical view as a TRANSIENT activation — the
+    persistent HBM footprint is the pool; a production TPU kernel would
+    fuse the gather into the attention read (pallas follow-up)."""
+    k = jnp.take(cache["k"], table, axis=0)   # [S, C, H, page_len, D]
+    v = jnp.take(cache["v"], table, axis=0)
+    if "scale_k" in cache:
+        sk = jnp.take(cache["scale_k"], table, axis=0)  # [S, C, page_len]
+        sv = jnp.take(cache["scale_v"], table, axis=0)
+        k = k.astype(jnp.float32) * sk[:, :, None, :, None]
+        v = v.astype(jnp.float32) * sv[:, :, None, :, None]
+    s, c, h, pl, d = k.shape
+    k = k.transpose(0, 2, 1, 3, 4).reshape(s, h, c * pl, d)
+    v = v.transpose(0, 2, 1, 3, 4).reshape(s, h, c * pl, d)
+    return k, v
+
+
+def paged_insert(cache: PagedCache, table_row: jax.Array, k_new: jax.Array,
+                 v_new: jax.Array, start: int = 0) -> PagedCache:
+    """Write a prefilled K/V block ``[H, T, D]`` into the pages named by
+    ``table_row`` [W] at logical positions ``start..start+T-1`` — the
+    paged twin of :func:`slot_insert`. T is static (length-bucketed), so
+    one compile per bucket covers every join; positions past the row's
+    width (bucket padding beyond the stream's allocation) fall onto the
+    null page. ``start`` is a static offset for shared-prefix suffix
+    prefills."""
+    t = k_new.shape[1]
+    positions = start + lax.broadcasted_iota(jnp.int32, (1, t), 1)
+    pages, offs = _page_positions(table_row[None], positions,
+                                  cache["k"].shape[2])
+    return _paged_write(cache, pages, offs,
+                        k_new.transpose(1, 0, 2)[None],
+                        v_new.transpose(1, 0, 2)[None], inline_amax=True)
+
+
+def paged_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
+                    cache: PagedCache, table: jax.Array,
+                    lengths: jax.Array, max_len: int,
+                    scale: Optional[float] = None
+                    ) -> Tuple[jax.Array, PagedCache]:
+    """One decode step over ALL slots through the page pool — the paged
+    twin of :func:`slot_attention`, bit-identical to it: write each slot's
+    new K/V at its own ``lengths[s]`` position (scattered to the owning
+    page), gather the first ``max_len // page_len`` table columns back
+    into a logical ``[S, H, max_len, D]`` view, then run the SAME
+    :func:`~..attention.masked_context` arithmetic over the SAME key
+    length and visibility mask.
+
+    ``q``/``k_new``/``v_new``: ``[S, H, 1, D]``; ``lengths``: [S] int32.
+    The caller advances lengths once after every block attended, exactly
+    as with the contiguous engine."""
+    _, _, t, d = q.shape
+    page_len = cache["k"].shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    pages, offs = _page_positions(table, lengths[:, None], page_len)
+    cache = _paged_write(cache, pages, offs, k_new.transpose(0, 2, 1, 3),
+                         v_new.transpose(0, 2, 1, 3), inline_amax=False)
+    k_buf, v_buf = paged_gather(cache, table[:, :max_len // page_len])
+    key_pos = lax.broadcasted_iota(jnp.int32, (t, max_len), 1)
+    visible = key_pos[None] <= lengths[:, None, None]   # [S, 1, max_len]
+    ctx = masked_context(q, k_buf, v_buf, visible[:, None], scale)
+    return ctx, cache
+
+
+def paged_verify_attention(q: jax.Array, k_new: jax.Array,
+                           v_new: jax.Array, cache: PagedCache,
+                           table: jax.Array, lengths: jax.Array,
+                           scale: Optional[float] = None
+                           ) -> Tuple[jax.Array, PagedCache]:
+    """Speculative VERIFY step: feed T = k+1 tokens per slot in one pass —
+    write their K/V at logical positions ``lengths[s]..lengths[s]+T-1``
+    (crossing page boundaries as needed; transient positions past the
+    allocation fall onto the null page) and attend causally within the new
+    block on top of each slot's visible prefix. Same masked_context
+    arithmetic as everywhere else; the extra gathered slack columns past
+    ``max_len`` are masked to exact zeros. Per-row contexts match serial
+    decode rows to float-reduction tolerance (the T-batched matmul may
+    vectorize differently than T=1), which is why speculative parity is a
+    TOKEN-identity guarantee, not a bit-identity one.
+
+    ``q``/``k_new``/``v_new``: ``[S, H, T, D]``. Lengths advance by the
+    caller-side ACCEPTED count, not T — rejected positions hold stale K/V
+    that the next round overwrites at the same positions."""
+    _, _, t, d = q.shape
+    page_len = cache["k"].shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    positions = (lengths[:, None]
+                 + lax.broadcasted_iota(jnp.int32, (q.shape[0], t), 1))
+    pages, offs = _page_positions(table, positions, page_len)
+    cache = _paged_write(cache, pages, offs, k_new.transpose(0, 2, 1, 3),
+                         v_new.transpose(0, 2, 1, 3), inline_amax=False)
+    k_buf, v_buf = paged_gather(cache, table)
+    kcols = table.shape[1] * page_len
+    key_pos = lax.broadcasted_iota(jnp.int32, (t, kcols), 1)
+    row_pos = lax.broadcasted_iota(jnp.int32, (t, kcols), 0)
+    visible = key_pos[None] <= lengths[:, None, None] + row_pos[None]
+    ctx = masked_context(q, k_buf, v_buf, visible[:, None], scale)
+    return ctx, cache
 
 
 def _decode_loop(step_fn, params, cache, prompt_last_token,
@@ -345,3 +606,165 @@ def sample_generate(step_fn: Callable, params: Any, cache: Any,
     return _decode_loop(step_fn, params, cache, prompt_last_token,
                         max_new_tokens, eos_id, select,
                         jax.random.split(rng, max_new_tokens))
+
+
+# -- speculative decoding (draft proposes k, target verifies in one pass) ---
+#
+# Leviathan et al.: the decode step is memory-bandwidth-bound, so a small
+# DRAFT model proposes k tokens serially and the TARGET verifies all k in
+# ONE batched pass through its (paged) cache — one target dispatch emits
+# between 1 and k+1 tokens. The accept/resample rule preserves the target
+# distribution exactly; with greedy decoding it degenerates to "accept
+# while the draft matches the target argmax", which makes speculative
+# greedy TOKEN-IDENTICAL to serial greedy (the parity anchor the tests
+# hold). Rejected draft positions leave stale K/V past the accepted
+# length — invisible under the length mask and overwritten at the same
+# positions next round, so the cache never needs a rollback copy.
+
+
+def spec_accept_greedy(drafts: jax.Array, target_logits: jax.Array
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Greedy accept rule. ``drafts`` [S, k] are the draft proposals;
+    ``target_logits`` [S, k+1, V] are the verify-pass logits (row j
+    predicts the token AFTER feeding draft j). Returns ``(emitted [S,
+    k+1], n [S])``: the target argmax row per position and how many lead
+    entries are valid — ``n = 1 + (leading draft/argmax matches)``, so a
+    fully-accepted round emits k+1 tokens (the free "bonus" token)."""
+    g = jnp.argmax(target_logits, axis=-1)              # [S, k+1]
+    match = (drafts == g[:, :-1]).astype(jnp.int32)
+    lead = jnp.cumprod(match, axis=1)
+    n = 1 + jnp.sum(lead, axis=1)
+    return g, n
+
+
+def _spec_accept_sampled(drafts, draft_logits, target_logits, key,
+                         filter_logits):
+    """Standard stochastic accept/resample rule: accept draft token d_i
+    with probability min(1, p_i(d_i)/q_i(d_i)); at the first rejection
+    resample from norm(max(p - q, 0)); when every draft survives, sample
+    the bonus token from the target's k-th distribution (q := 0 there, so
+    the residual IS p). Output-distribution-preserving, not run-identical
+    to a serial sampled run (different rng consumption)."""
+    s, k = drafts.shape
+    p = jax.nn.softmax(filter_logits(target_logits.astype(jnp.float32)),
+                       axis=-1)                          # [S, k+1, V]
+    q = jax.nn.softmax(filter_logits(draft_logits.astype(jnp.float32)),
+                       axis=-1)                          # [S, k, V]
+    pd = jnp.take_along_axis(p[:, :k], drafts[..., None], axis=-1)[..., 0]
+    qd = jnp.take_along_axis(q, drafts[..., None], axis=-1)[..., 0]
+    key_u, key_x = jax.random.split(key)
+    u = jax.random.uniform(key_u, (s, k))
+    accept = (u * qd < pd).astype(jnp.int32)
+    m = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)     # [S] in [0, k]
+    q_pad = jnp.concatenate([q, jnp.zeros_like(p[:, :1])], axis=1)
+    sel = m[:, None, None]
+    pm = jnp.take_along_axis(p, jnp.broadcast_to(sel, (s, 1, p.shape[-1])),
+                             axis=1)[:, 0]               # p_{m}  [S, V]
+    qm = jnp.take_along_axis(q_pad,
+                             jnp.broadcast_to(sel, (s, 1, p.shape[-1])),
+                             axis=1)[:, 0]
+    resid = jnp.maximum(pm - qm, 0.0)
+    total = jnp.sum(resid, axis=-1, keepdims=True)
+    resid = jnp.where(total > 0, resid, pm)  # p == q: residual undefined
+    x = jax.random.categorical(
+        key_x, jnp.where(resid > 0, jnp.log(resid), _NEG_INF), axis=-1)
+    j = lax.broadcasted_iota(jnp.int32, (s, k + 1), 1)
+    drafts_pad = jnp.concatenate(
+        [drafts, jnp.zeros((s, 1), drafts.dtype)], axis=1)
+    emitted = jnp.where(j < m[:, None], drafts_pad,
+                        jnp.where(j == m[:, None], x[:, None].astype(
+                            drafts.dtype), 0))
+    return emitted, m + 1
+
+
+def speculative_generate(draft_step_fn: Callable, verify_fn: Callable,
+                         draft_params: Any, target_params: Any,
+                         draft_cache: Any, target_cache: Any,
+                         prompt_last_token: jax.Array, lengths: jax.Array,
+                         max_new_tokens: int, spec_k: int,
+                         eos_id: Optional[int] = None,
+                         rng: Optional[jax.Array] = None,
+                         temperature: float = 1.0,
+                         top_k: Optional[int] = None,
+                         top_p: Optional[float] = None) -> jax.Array:
+    """Speculative decoding driver: one ``lax.scan`` of at most
+    ``max_new_tokens`` rounds, each round = ``spec_k`` serial DRAFT steps
+    + ONE batched target VERIFY + vectorized accept.
+
+    Contracts (lengths are PER-ROW, slot/paged style):
+
+    - ``draft_step_fn(draft_params, tokens [B], lengths [B], draft_cache)
+      -> (logits [B, V], draft_cache)``
+    - ``verify_fn(target_params, block [B, k+1], lengths [B],
+      target_cache) -> (logits [B, k+1, V], target_cache)``
+
+    Greedy when ``rng is None`` (token-identical to serial greedy);
+    otherwise samples with the standard accept/resample rule through the
+    shared :func:`make_logit_filter` chain. Finished rows (eos / budget)
+    freeze and the output pads with ``eos_id``. Returns ``[B,
+    max_new_tokens]``."""
+    b = prompt_last_token.shape[0]
+    sampling = rng is not None
+    filter_logits = (make_logit_filter(temperature, top_k, top_p)
+                     if sampling else None)
+
+    def round_body(carry, key):
+        last, lengths, dcache, tcache, out, cursor, done = carry
+        if sampling:
+            subkeys = jax.random.split(key, spec_k + 1)
+
+        def draft_body(c, i):
+            tok, ln, dc = c
+            logits, dc = draft_step_fn(draft_params, tok, ln, dc)
+            if sampling:
+                nxt = jax.random.categorical(
+                    subkeys[i], filter_logits(logits.astype(jnp.float32)),
+                    axis=-1)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            nxt = nxt.astype(tok.dtype)
+            return (nxt, ln + 1, dc), (nxt, logits)
+
+        (_, _, dcache), (drafts, dlogits) = lax.scan(
+            draft_body, (last, lengths, dcache), jnp.arange(spec_k))
+        drafts = jnp.swapaxes(drafts, 0, 1)              # [B, k]
+        block = jnp.concatenate([last[:, None], drafts], axis=1)
+        tlogits, tcache = verify_fn(target_params, block, lengths, tcache)
+        if sampling:
+            emitted, n = _spec_accept_sampled(
+                drafts, jnp.swapaxes(dlogits, 0, 1), tlogits,
+                subkeys[spec_k], filter_logits)
+        else:
+            emitted, n = spec_accept_greedy(drafts, tlogits)
+        emitted = emitted.astype(last.dtype)
+        n = jnp.where(done, 0, n)
+        n = jnp.minimum(n, max_new_tokens - cursor)       # budget clamp
+        j = lax.broadcasted_iota(jnp.int32, (b, spec_k + 1), 1)
+        if eos_id is not None:
+            iseos = (emitted == eos_id) & (j < n[:, None])
+            first = jnp.min(jnp.where(iseos, j, spec_k + 1), axis=1)
+            n = jnp.minimum(n, first + 1)
+            done = done | jnp.any(iseos, axis=1)
+        valid = j < n[:, None]
+        pos = jnp.where(valid, cursor[:, None] + j, max_new_tokens)
+        rows = lax.broadcasted_iota(jnp.int32, (b, spec_k + 1), 0)
+        out = out.at[rows, pos].set(emitted, mode="drop")
+        last = jnp.where(
+            n > 0,
+            jnp.take_along_axis(emitted, jnp.maximum(n - 1, 0)[:, None],
+                                axis=1)[:, 0],
+            last)
+        lengths = lengths + n
+        cursor = cursor + n
+        done = done | (cursor >= max_new_tokens)
+        return (last, lengths, dcache, tcache, out, cursor, done), n
+
+    fill = eos_id if eos_id is not None else 0
+    out0 = jnp.full((b, max_new_tokens), fill, prompt_last_token.dtype)
+    carry0 = (prompt_last_token, jnp.asarray(lengths, jnp.int32),
+              draft_cache, target_cache, out0,
+              jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool))
+    xs = (jax.random.split(rng, max_new_tokens) if sampling
+          else jnp.zeros((max_new_tokens,), jnp.uint32))
+    (_, _, _, _, out, _, _), _ = lax.scan(round_body, carry0, xs)
+    return out

@@ -73,6 +73,17 @@ def _scale_of(running_amax: jax.Array) -> jax.Array:
     return jnp.maximum(running_amax, 1e-6) / 127.0
 
 
+#: Public aliases for the delayed-scaling recipe. The paged int8 KV cache
+#: (``ops/decode.py``) reuses these on the bandwidth-bound decode read path:
+#: same symmetric quantizer, same fast-rise/slow-decay running amax, applied
+#: per cached token position instead of per inter-layer activation — so the
+#: ResNet dataflow and the KV cache stay one quantization story.
+quant_int8 = _quant
+dequant_int8 = _deq
+next_amax = _next_amax
+scale_of_amax = _scale_of
+
+
 def _quantize_weight_pc(w: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """HWIO kernel → per-O-channel symmetric int8 (computed per step from
     the float master; weight tensors are ~100x smaller than activations)."""

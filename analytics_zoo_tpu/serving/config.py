@@ -44,6 +44,15 @@ class ServingConfig:
     top_k: Optional[int] = None          # scheduler samples through the
     top_p: Optional[float] = None        # shared make_logit_filter; all
     #   None => greedy argmax decoding
+    # -- paged KV engine -------------------------------------------------------
+    kv_pages: Optional[int] = None  # pool size in pages; None = contiguous
+    #   per-slot rectangles (the PR 8 engine). Page 0 is the null page, so
+    #   kv_pages - 1 pages are allocatable.
+    kv_page_len: int = 16  # tokens per page; must divide the LM's max_len
+    #   and be a power of two <= 16 (so it divides every prefill bucket)
+    kv_int8: bool = False  # int8 KV pool (delayed-scaling quantization)
+    spec_k: int = 0  # speculative decoding: draft tokens per verify round;
+    #   0 = disabled. Requires kv_pages and a draft_lm, greedy-only.
 
     @staticmethod
     def from_yaml(path: str) -> "ServingConfig":
@@ -99,6 +108,11 @@ class ServingConfig:
             cfg.top_k = int(params["top_k"])
         if params.get("top_p") is not None:
             cfg.top_p = float(params["top_p"])
+        if params.get("kv_pages") is not None:
+            cfg.kv_pages = int(params["kv_pages"])
+        cfg.kv_page_len = int(params.get("kv_page_len", cfg.kv_page_len))
+        cfg.kv_int8 = bool(params.get("kv_int8", cfg.kv_int8))
+        cfg.spec_k = int(params.get("spec_k", cfg.spec_k))
         cfg.log_dir = raw.get("log_dir", cfg.log_dir)
         cfg.health_path = raw.get("health_path", cfg.health_path)
         if raw.get("health_interval_s") is not None:

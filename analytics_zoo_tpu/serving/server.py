@@ -42,6 +42,7 @@ logger = logging.getLogger("analytics_zoo_tpu.serving")
 
 #: canonical terminal error texts (clients match on these)
 SHED_ERROR = "shed: queue overloaded"
+PAGE_SHED_ERROR = "shed: kv page pool exhausted"
 DEADLINE_ERROR = "deadline exceeded"
 SHUTDOWN_ERROR = "serving shut down before this request completed"
 
@@ -97,6 +98,19 @@ _M_TOKENS = _metrics.counter(
 _M_SLOTS = _metrics.gauge(
     "serving.slots_occupied",
     "Decode slots currently holding an active stream.", labels=("server",))
+#: paged KV engine + speculative decoding telemetry
+_M_PAGES_FREE = _metrics.gauge(
+    "serving.kv_pages_free",
+    "Allocatable pages remaining in the paged KV pool (0 = joins shed).",
+    labels=("server",))
+_M_PAGE_EVICT = _metrics.counter(
+    "serving.kv_page_evictions_total",
+    "KV pages returned to the pool by stream retirement.",
+    labels=("server",))
+_M_SPEC_ACCEPT = _metrics.gauge(
+    "serving.spec_accept_ratio",
+    "Mean fraction of draft tokens accepted in the last verify round.",
+    labels=("server",))
 
 _instance_ids = itertools.count()
 
@@ -959,17 +973,33 @@ class GenerativeServing:
     prefill (``prefill_kv``), the ``make_logit_filter`` sampling chain and
     the ``cached_attention``-mirroring ``slot_attention`` arithmetic
     (tests/test_generative_serving.py holds the line).
-    """
+
+    Paged KV engine (``config.kv_pages``): per-slot ``max_len``
+    rectangles are replaced by a global page pool + per-slot page tables
+    (``ops/decode.py`` paged ops) — HBM is paid per ALLOCATED page, not
+    per slot, so concurrency scales with actual stream lengths. Joins
+    allocate pages (shedding with ``PAGE_SHED_ERROR`` on exhaustion — the
+    ``serving.page_alloc`` fault site), retirement refcounts them back.
+    ``register_prefix()`` shares a common prompt's pages across streams
+    with copy-on-write tails; ``config.kv_int8`` stores the pool in int8
+    with delayed scaling; ``config.spec_k`` + a ``draft_lm`` switches the
+    step to speculative draft/verify rounds (greedy-only,
+    token-identical to serial greedy). Paged greedy/sampled decode stays
+    bit-identical to the contiguous engine
+    (tests/test_paged_serving.py)."""
 
     SHED_INTERVAL_S = 0.05
 
     def __init__(self, config: ServingConfig, lm,
-                 queue: Optional[QueueBackend] = None):
+                 queue: Optional[QueueBackend] = None, draft_lm=None):
         import jax
         import jax.numpy as jnp
 
         from ..ops.decode import (init_slot_state, make_logit_filter,
-                                  slot_evict, slot_insert, slot_join)
+                                  page_copy, page_table_clear,
+                                  page_table_set, paged_gather, paged_insert,
+                                  slot_evict, slot_insert, slot_join,
+                                  spec_accept_greedy)
 
         self.config = config
         self.lm = lm
@@ -986,20 +1016,73 @@ class GenerativeServing:
             filter_logits = make_logit_filter(
                 config.temperature if config.temperature is not None
                 else 1.0, config.top_k, config.top_p)
+        # -- paged KV engine + speculative decoding flags -----------------
+        self._paged = config.kv_pages is not None
+        self._spec = draft_lm is not None and config.spec_k > 0
+        if self._spec and not self._paged:
+            raise ValueError("speculative decoding rides the paged KV "
+                             "engine: set kv_pages alongside spec_k")
+        if self._spec and self._sampling:
+            raise ValueError("speculative decoding in the scheduler is "
+                             "greedy-only (per-request sampled accept is a "
+                             "follow-up); unset temperature/top_k/top_p")
+        self._spec_k = int(config.spec_k) if self._spec else 0
         # -- device state: per-block slot caches + ONE shared occupancy ---
         self._params = lm.params
-        self._caches = lm.init_slot_caches(self.slots)
+        if self._paged:
+            pl = int(config.kv_page_len)
+            num_pages = int(config.kv_pages)
+            if pl < 1 or (pl & (pl - 1)) or pl > 16:
+                raise ValueError(f"kv_page_len must be a power of two "
+                                 f"<= 16 (divides every prefill bucket), "
+                                 f"got {pl}")
+            if lm.max_len % pl:
+                raise ValueError(f"kv_page_len {pl} must divide the LM's "
+                                 f"max_len {lm.max_len}")
+            if num_pages < 2:
+                raise ValueError(f"kv_pages must be >= 2 (page 0 is the "
+                                 f"null page), got {num_pages}")
+            self.page_len = pl
+            self.num_pages = num_pages
+            # table rows carry slack columns for the transient spec_k
+            # overshoot past max_len (those writes land on real pages the
+            # stream owns only within its allocation; beyond it, the null
+            # page absorbs them)
+            self._table_w = (lm.max_len + self._spec_k + pl - 1) // pl
+            self._caches = lm.init_paged_caches(num_pages, pl,
+                                                int8=config.kv_int8)
+            self._table = jnp.zeros((self.slots, self._table_w), jnp.int32)
+            # host-side allocator: free-page stack, refcounts, and the
+            # pages each slot holds (shared prefix pages appear in many)
+            self._free_pages = list(range(num_pages - 1, 0, -1))
+            self._page_refs = np.zeros(num_pages, np.int64)
+            self._slot_pages: List[List[int]] = [[] for _ in
+                                                 range(self.slots)]
+            self._prefixes: List[Dict[str, Any]] = []
+        else:
+            self._caches = lm.init_slot_caches(self.slots)
         self._state = init_slot_state(self.slots)
+        if self._spec:
+            self.draft_lm = draft_lm
+            self._dparams = draft_lm.params
+            self._dcaches = draft_lm.init_slot_caches(self.slots)
+            if draft_lm.max_len < lm.max_len + self._spec_k:
+                raise ValueError(
+                    f"draft max_len={draft_lm.max_len} must cover "
+                    f"max_len={lm.max_len} + spec_k={self._spec_k} "
+                    f"transient draft positions")
+
+        def _select(logits, keys):
+            if filter_logits is None:
+                return jnp.argmax(logits, axis=-1)
+            filt = filter_logits(logits.astype(jnp.float32))
+            return jax.vmap(lambda kk, row: jax.random.categorical(
+                kk, row, axis=-1))(keys, filt)
 
         def _step(params, tokens, keys, state, caches):
             logits, caches = lm.slot_step(params, tokens, state["length"],
                                           caches)
-            if filter_logits is None:
-                nxt = jnp.argmax(logits, axis=-1)
-            else:
-                filt = filter_logits(logits.astype(jnp.float32))
-                nxt = jax.vmap(lambda kk, row: jax.random.categorical(
-                    kk, row, axis=-1))(keys, filt)
+            nxt = _select(logits, keys)
             # lengths advance ONCE, after every block attended with the
             # pre-increment value (write-then-attend, as serial decode)
             state = {"length": (state["length"]
@@ -1007,14 +1090,105 @@ class GenerativeServing:
                      "active": state["active"]}
             return nxt, state, caches
 
+        def _step_paged(params, tokens, keys, state, table, caches):
+            logits, caches = lm.paged_slot_step(params, tokens,
+                                                state["length"], table,
+                                                caches)
+            nxt = _select(logits, keys)
+            state = {"length": (state["length"]
+                                + state["active"].astype(jnp.int32)),
+                     "active": state["active"]}
+            return nxt, state, caches
+
+        spec_k = self._spec_k
+
+        def _step_spec(params, dparams, tokens, state, table, caches,
+                       dcaches):
+            """One speculative round: spec_k chained draft steps, one
+            batched verify through the paged cache, longest-agreeing-run
+            accept. Lengths advance by each slot's ACCEPTED count."""
+            lengths = state["length"]
+            active = state["active"]
+
+            def draft_body(carry, _):
+                tok, ln, dc = carry
+                dlogits, dc = draft_lm.slot_step(dparams, tok, ln, dc)
+                nd = jnp.argmax(dlogits, axis=-1).astype(tok.dtype)
+                return (nd, ln + active.astype(jnp.int32), dc), nd
+
+            (_, _, dcaches), drafts = jax.lax.scan(
+                draft_body, (tokens, lengths, dcaches), None, length=spec_k)
+            drafts = jnp.swapaxes(drafts, 0, 1)          # [S, k]
+            block = jnp.concatenate([tokens[:, None], drafts], axis=1)
+            tlogits, caches = lm.verify_step(params, block, lengths, table,
+                                             caches)
+            emitted, n = spec_accept_greedy(drafts, tlogits)
+            n = n * active.astype(n.dtype)
+            state = {"length": lengths + n, "active": active}
+            return emitted, n, state, caches, dcaches
+
         def _prefill(params, padded, caches, state, slot, length):
             kvs = lm.prefill_kv(params, padded)
             caches = [slot_insert(c, slot, k[0], v[0])
                       for c, (k, v) in zip(caches, kvs)]
             return caches, slot_join(state, slot, length)
 
-        self._step_fn = jax.jit(_step)
-        self._prefill_fn = jax.jit(_prefill)  # one compile per bucket
+        def _prefill_paged(params, padded, caches, state, table, row, slot,
+                           length):
+            kvs = lm.prefill_kv(params, padded)
+            caches = [paged_insert(c, row, k[0], v[0])
+                      for c, (k, v) in zip(caches, kvs)]
+            return (caches, slot_join(state, slot, length),
+                    page_table_set(table, slot, row))
+
+        def _prefill_spec(params, dparams, padded, dpadded, caches, dcaches,
+                          state, table, row, slot, length):
+            kvs = lm.prefill_kv(params, padded)
+            caches = [paged_insert(c, row, k[0], v[0])
+                      for c, (k, v) in zip(caches, kvs)]
+            dkvs = draft_lm.prefill_kv(dparams, dpadded)
+            dcaches = [slot_insert(c, slot, k[0], v[0])
+                       for c, (k, v) in zip(dcaches, dkvs)]
+            return (caches, dcaches, slot_join(state, slot, length),
+                    page_table_set(table, slot, row))
+
+        def _prefill_suffix(params, padded, caches, state, table, row, prow,
+                            slot, length, plen):
+            # gather the shared prefix K/V (refcounted pages, prefilled
+            # once) and run only the divergent suffix forward
+            pref = [paged_gather(c, prow[None]) for c in caches]
+            pref = [(k[:, :, :plen], v[:, :, :plen]) for k, v in pref]
+            kvs = lm.prefill_kv_suffix(params, padded, pref, plen)
+            caches = [paged_insert(c, row, k[0], v[0], start=plen)
+                      for c, (k, v) in zip(caches, kvs)]
+            return (caches, slot_join(state, slot, length),
+                    page_table_set(table, slot, row))
+
+        def _prefill_prefix(params, padded, caches, row):
+            kvs = lm.prefill_kv(params, padded)
+            return [paged_insert(c, row, k[0], v[0])
+                    for c, (k, v) in zip(caches, kvs)]
+
+        def _copy_pages(caches, src, dst):
+            return [page_copy(c, src, dst) for c in caches]
+
+        if self._spec:
+            self._step_fn = jax.jit(_step_spec)
+            self._prefill_spec_fn = jax.jit(_prefill_spec)
+        elif self._paged:
+            self._step_fn = jax.jit(_step_paged)
+        else:
+            self._step_fn = jax.jit(_step)
+        if self._paged:
+            self._prefill_paged_fn = jax.jit(_prefill_paged)
+            self._prefill_suffix_fn = jax.jit(_prefill_suffix,
+                                              static_argnames=("plen",))
+            self._prefill_prefix_fn = jax.jit(_prefill_prefix)
+            self._copy_fn = jax.jit(_copy_pages)
+            self._table_set_fn = jax.jit(page_table_set)
+            self._table_clear_fn = jax.jit(page_table_clear)
+        else:
+            self._prefill_fn = jax.jit(_prefill)  # one compile per bucket
         self._join_fn = jax.jit(slot_join)    # T==1 prompts: no prefill
         self._evict_fn = jax.jit(slot_evict)
         self._split = lambda seed, n: np.asarray(
@@ -1043,6 +1217,12 @@ class GenerativeServing:
         self._m_ttft = _M_TTFT.labels(server=self.metrics_label)
         self._m_tokens = _M_TOKENS.labels(server=self.metrics_label)
         self._m_slots = _M_SLOTS.labels(server=self.metrics_label)
+        self._m_pages_free = _M_PAGES_FREE.labels(server=self.metrics_label)
+        self._m_page_evict = _M_PAGE_EVICT.labels(server=self.metrics_label)
+        self._m_spec_accept = _M_SPEC_ACCEPT.labels(
+            server=self.metrics_label)
+        if self._paged:
+            self._m_pages_free.set(len(self._free_pages))
         self._counter_lock = threading.Lock()
         self._in_flight = 0
         self._meta: Dict[str, Tuple[float, Optional[int]]] = {}
@@ -1103,6 +1283,8 @@ class GenerativeServing:
             self._count(counter)
         elif "value" in value:
             self._m_records.inc()
+        if self._paged:
+            self._release_pages(slot)
         self._uri[slot] = None
         self._tokens[slot] = None
         self._keys[slot] = None
@@ -1111,6 +1293,21 @@ class GenerativeServing:
         self._streamed[slot] = 0
         self._active_host[slot] = False
 
+    def _release_pages(self, slot: int) -> None:
+        """Decrement every page the slot holds; refcount-0 pages return to
+        the free stack (shared prefix pages outlive the stream via the
+        registry's own reference)."""
+        pages, self._slot_pages[slot] = self._slot_pages[slot], []
+        freed = 0
+        for p in pages:
+            self._page_refs[p] -= 1
+            if self._page_refs[p] == 0:
+                self._free_pages.append(p)
+                freed += 1
+        if freed:
+            self._m_page_evict.inc(freed)
+        self._m_pages_free.set(len(self._free_pages))
+
     # -- device hot path (policed by scripts/check_hot_path_syncs.py) ------
 
     def _dispatch_step(self, tokens, keys):
@@ -1118,8 +1315,16 @@ class GenerativeServing:
         # (their one terminal result) and keep the scheduler serving
         faults.inject("serving.decode_step")
         t0 = time.perf_counter()
-        out = self._step_fn(self._params, tokens, keys, self._state,
-                            self._caches)
+        if self._spec:
+            out = self._step_fn(self._params, self._dparams, tokens,
+                                self._state, self._table, self._caches,
+                                self._dcaches)
+        elif self._paged:
+            out = self._step_fn(self._params, tokens, keys, self._state,
+                                self._table, self._caches)
+        else:
+            out = self._step_fn(self._params, tokens, keys, self._state,
+                                self._caches)
         _profiler.record_phase("serving", "dispatch",
                                time.perf_counter() - t0, start=t0)
         return out
@@ -1128,8 +1333,31 @@ class GenerativeServing:
         self._caches, self._state = self._prefill_fn(
             self._params, padded, self._caches, self._state, slot, length)
 
+    def _insert_request_paged(self, padded, row, slot, length):
+        self._caches, self._state, self._table = self._prefill_paged_fn(
+            self._params, padded, self._caches, self._state, self._table,
+            row, slot, length)
+
+    def _insert_request_spec(self, padded, dpadded, row, slot, length):
+        (self._caches, self._dcaches, self._state,
+         self._table) = self._prefill_spec_fn(
+            self._params, self._dparams, padded, dpadded, self._caches,
+            self._dcaches, self._state, self._table, row, slot, length)
+
+    def _insert_suffix_paged(self, padded, row, prow, slot, length, plen):
+        self._caches, self._state, self._table = self._prefill_suffix_fn(
+            self._params, padded, self._caches, self._state, self._table,
+            row, prow, slot, length, plen=plen)
+
+    def _copy_page_device(self, src, dst):
+        # copy-on-write: a private copy of a shared prefix tail page
+        self._caches = self._copy_fn(self._caches, np.int32(src),
+                                     np.int32(dst))
+
     def _evict_slots(self, mask):
         self._state = self._evict_fn(self._state, mask)
+        if self._paged:
+            self._table = self._table_clear_fn(self._table, mask)
 
     def _fetch_tokens(self, nxt) -> np.ndarray:
         # the one host sync per step, deliberately OUTSIDE the policed
@@ -1169,6 +1397,134 @@ class GenerativeServing:
                 "overload: shed %d oldest streams with error results "
                 "(allowed depth %d)", len(dropped), allowed)
 
+    # -- paged join: page allocation + shared-prefix attach ----------------
+
+    def _match_prefix(self, prompt) -> Optional[Dict[str, Any]]:
+        """Longest registered prefix that ``prompt`` strictly extends (the
+        last prompt token is never prefilled, so the prompt must be longer
+        than the prefix)."""
+        best = None
+        for pfx in self._prefixes:
+            n = pfx["len"]
+            if (len(prompt) > n and list(prompt[:n]) == pfx["tokens"]
+                    and (best is None or n > best["len"])):
+                best = pfx
+        return best
+
+    def register_prefix(self, tokens) -> int:
+        """Prefill a shared prompt prefix ONCE into refcounted pool pages.
+        Every later join whose prompt extends it references those pages
+        (full pages shared in place; a partially-filled tail page gets a
+        private copy-on-write duplicate, since the stream appends into it)
+        and prefills only its divergent suffix. The registry holds a
+        permanent reference, so the pages survive every stream's
+        retirement. Admin-plane call — register before ``start()`` or
+        between steps, not concurrently with the loop."""
+        if not self._paged:
+            raise RuntimeError("shared prefixes require the paged KV "
+                               "engine (set kv_pages)")
+        if self._spec:
+            raise RuntimeError("shared prefixes are not wired into the "
+                               "speculative scheduler yet (the draft "
+                               "cache is contiguous)")
+        from ..capture.lm import prefill_bucket
+        toks = [int(x) for x in tokens]
+        n = len(toks)
+        if n < 1 or n >= self.lm.max_len:
+            raise ValueError(f"prefix length {n} out of range for "
+                             f"max_len={self.lm.max_len}")
+        npages = -(-n // self.page_len)
+        if len(self._free_pages) < npages:
+            raise RuntimeError(
+                f"kv page pool exhausted: prefix needs {npages} pages, "
+                f"{len(self._free_pages)} free")
+        pages = [self._free_pages.pop() for _ in range(npages)]
+        for p in pages:
+            self._page_refs[p] = 1  # the registry's permanent hold
+        row = np.zeros(self._table_w, np.int32)
+        row[:npages] = pages
+        tb = prefill_bucket(n, self.lm.max_len)
+        padded = np.zeros((1, tb), np.int32)
+        padded[0, :n] = toks
+        self._caches = self._prefill_prefix_fn(self._params, padded,
+                                               self._caches, row)
+        self._prefixes.append({"tokens": toks, "len": n, "pages": pages})
+        self._m_pages_free.set(len(self._free_pages))
+        return len(self._prefixes) - 1
+
+    def _join_paged(self, slot: int, uri: str, prompt, t: int,
+                    budget: int) -> bool:
+        """Allocate pages for a validated request and prefill it into
+        ``slot``. Pool exhaustion (or the armed ``serving.page_alloc``
+        fault) SHEDS the request — its one terminal result is the page
+        shed error — and every resident stream keeps decoding."""
+        from ..capture.lm import prefill_bucket
+        pl = self.page_len
+        pfx = self._match_prefix(prompt) if not self._spec else None
+        plen = pfx["len"] if pfx else 0
+        full = plen // pl       # whole shared pages
+        rem = plen % pl         # prefix tokens on the shared tail page
+        fed = t - 1             # positions prefilled before decode starts
+        tb = (prefill_bucket(fed - plen, self.lm.max_len)
+              if fed > plen else 0)
+        # highest position the stream may WRITE within its allocation:
+        # bucket padding past the suffix, the decode budget, and the
+        # transient spec_k overshoot all need real (owned) pages
+        high = max(plen + tb, t + budget + self._spec_k)
+        # bucket padding past the table width is never visible and never
+        # decoded over — the null page absorbs it; no page needed
+        fresh_needed = min(-(-high // pl), self._table_w) - full
+        # chaos site: pool exhaustion at join → shed-or-evict, not a crash
+        if (faults.inject("serving.page_alloc")
+                or len(self._free_pages) < fresh_needed):
+            self._post_terminal(uri, {"error": PAGE_SHED_ERROR})
+            self._count("shed")
+            logger.warning(
+                "kv page pool exhausted: shed %s (need %d pages, %d free)",
+                uri, fresh_needed, len(self._free_pages))
+            return False
+        fresh = [self._free_pages.pop() for _ in range(fresh_needed)]
+        shared = [int(p) for p in pfx["pages"][:full]] if pfx else []
+        row = np.zeros(self._table_w, np.int32)
+        row[:full] = shared
+        row[full:full + fresh_needed] = fresh
+        for p in shared:
+            self._page_refs[p] += 1
+        for p in fresh:
+            self._page_refs[p] = 1
+        self._slot_pages[slot] = shared + fresh
+        self._m_pages_free.set(len(self._free_pages))
+        if pfx and rem:
+            # CoW: the stream appends into logical page ``full``, which
+            # still holds shared prefix tail tokens — give it a private
+            # copy (fresh[0] occupies that table position)
+            self._copy_page_device(pfx["pages"][full], fresh[0])
+        if fed > plen:
+            padded = np.zeros((1, tb), np.int32)
+            padded[0, :fed - plen] = prompt[plen:fed]
+            if pfx:
+                prow = np.asarray(pfx["pages"], np.int32)
+                self._insert_suffix_paged(padded, row, prow,
+                                          np.int32(slot), np.int32(fed),
+                                          plen)
+            elif self._spec:
+                dtb = prefill_bucket(fed, self.draft_lm.max_len)
+                dpadded = np.zeros((1, dtb), np.int32)
+                dpadded[0, :fed] = prompt[:fed]
+                self._insert_request_spec(padded, dpadded, row,
+                                          np.int32(slot), np.int32(fed))
+            else:
+                self._insert_request_paged(padded, row, np.int32(slot),
+                                           np.int32(fed))
+        else:
+            # nothing to prefill (one-token prompt, or the prompt is
+            # prefix + one token): join + install the table row
+            self._state = self._join_fn(self._state, np.int32(slot),
+                                        np.int32(fed))
+            self._table = self._table_set_fn(self._table, np.int32(slot),
+                                             row)
+        return True
+
     def _join(self, slot: int, uri: str, rec: Dict[str, Any],
               now: float) -> bool:
         """Validate a claimed request and prefill it into ``slot``. Returns
@@ -1196,7 +1552,12 @@ class GenerativeServing:
             self._count("expired")
             return False
         t0 = time.perf_counter()
-        if t > 1:
+        if self._paged:
+            if not self._join_paged(slot, uri, prompt, t, budget):
+                _profiler.record_phase("serving", "host_input",
+                                       time.perf_counter() - t0, start=t0)
+                return False
+        elif t > 1:
             # right-pad prompt[:-1] to its length bucket: the SAME compiled
             # prefill program serial generate() uses (bit-parity anchor)
             tb = prefill_bucket(t - 1, self.lm.max_len)
@@ -1328,6 +1689,55 @@ class GenerativeServing:
         if finished.any():
             self._evict_slots(finished)
 
+    def _post_tokens_spec(self, emitted: np.ndarray,
+                          n_acc: np.ndarray) -> None:
+        """Fold one speculative round's ACCEPTED tokens into every active
+        stream — same TTFT/stream/terminal rules as ``_post_tokens``, but
+        up to ``spec_k + 1`` tokens land per stream per round. The budget
+        clamp and eos truncation are host-side; a stream they cut short is
+        retired in the same pass, so the device's over-advanced length
+        never feeds another step."""
+        now = time.time()
+        cfg = self.config
+        finished = np.zeros(self.slots, bool)
+        n_tok = 0
+        for i in range(self.slots):
+            if not self._active_host[i]:
+                continue
+            take = min(int(n_acc[i]),
+                       self._budget[i] - len(self._tokens[i]))
+            toks = [int(x) for x in emitted[i, :take]]
+            if cfg.eos_id is not None and cfg.eos_id in toks:
+                toks = toks[:toks.index(cfg.eos_id) + 1]
+            if not toks:
+                continue
+            self._tokens[i].extend(toks)
+            self._next_tokens[i] = toks[-1]
+            n_tok += len(toks)
+            if self._first_t[i] is None:
+                self._first_t[i] = now
+                self._m_ttft.observe(max(now - self._enqueue_t[i], 0.0))
+            if (len(self._tokens[i]) >= self._budget[i]
+                    or (cfg.eos_id is not None and toks[-1] == cfg.eos_id)):
+                finished[i] = True
+                self._retire(i, {"value": list(self._tokens[i]),
+                                 "done": True})
+            elif (cfg.stream_interval > 0
+                  and (len(self._tokens[i]) - self._streamed[i]
+                       >= cfg.stream_interval)):
+                try:
+                    self.queue.put_result(
+                        self._uri[i], {"stream": list(self._tokens[i]),
+                                       "done": False})
+                    self._streamed[i] = len(self._tokens[i])
+                except Exception:
+                    logger.exception("partial result for %s failed",
+                                     self._uri[i])
+        if n_tok:
+            self._m_tokens.inc(n_tok)
+        if finished.any():
+            self._evict_slots(finished)
+
     def serve_step(self) -> int:
         """One scheduler step: evict expired streams, admit new requests
         into free slots (shed + bucketed prefill), run ONE fused decode
@@ -1350,13 +1760,29 @@ class GenerativeServing:
                     keys[i] = self._keys[i][len(self._tokens[i])]
         t_step = time.perf_counter()
         try:
-            nxt, state, caches = self._dispatch_step(tokens, keys)
-            nxt_host = self._fetch_tokens(nxt)
+            if self._spec:
+                emitted, n_acc, state, caches, dcaches = \
+                    self._dispatch_step(tokens, keys)
+                em_host = self._fetch_tokens(emitted)
+                n_host = self._fetch_tokens(n_acc)
+            else:
+                nxt, state, caches = self._dispatch_step(tokens, keys)
+                nxt_host = self._fetch_tokens(nxt)
         except Exception as e:
             logger.exception("decode step failed for %d streams", n_active)
             self._fail_active(repr(e))
             return 0
         self._state, self._caches = state, caches
+        if self._spec:
+            self._dcaches = dcaches
+            n_emitted = int(np.sum(n_host[self._active_host]))
+            per = (time.perf_counter() - t_step) / max(n_emitted, 1)
+            self._ewma_token_s = (per if self._ewma_token_s == 0.0
+                                  else 0.8 * self._ewma_token_s + 0.2 * per)
+            self._m_spec_accept.set(float(np.mean(np.maximum(
+                n_host[self._active_host] - 1, 0))) / self._spec_k)
+            self._post_tokens_spec(em_host, n_host)
+            return n_active
         per = (time.perf_counter() - t_step) / n_active
         self._ewma_token_s = (per if self._ewma_token_s == 0.0
                               else 0.8 * self._ewma_token_s + 0.2 * per)
@@ -1493,6 +1919,11 @@ class GenerativeServing:
             "tokens_total": int(self._m_tokens.value()),
             "tokens_per_sec_ewma": (round(1.0 / self._ewma_token_s, 1)
                                     if self._ewma_token_s > 0 else None),
+            "kv_pages_free": (len(self._free_pages) if self._paged
+                              else None),
+            "spec_accept_ratio": (
+                round(float(self._m_spec_accept.value()), 4)
+                if self._spec else None),
             "last_claim_age_s": claim_age,
             "ttft_ms": {"p50": _pct(self._m_ttft, 0.50),
                         "p99": _pct(self._m_ttft, 0.99),

@@ -1337,14 +1337,28 @@ def bench_serving_slo(requests: int = 360, batch_size: int = 16):
                         "max_pending=4 batches"})
 
 
+def _kv_pool_hbm_gb(lm, num_pages: int, page_len: int,
+                    int8: bool = False) -> float:
+    """Paged KV pool HBM footprint across all blocks, in GB (int8 pools
+    add the per-position f32 scale sidecar)."""
+    elems = num_pages * lm.n_head * page_len * (lm.hidden // lm.n_head)
+    payload = elems * (1 if int8 else 4)
+    if int8:
+        payload += num_pages * page_len * 4 * 2  # scale_k + scale_v
+    return lm.n_block * 2 * payload / 1e9
+
+
 def bench_generate(streams=(8, 32, 128), max_new_tokens: int = 32,
-                   prompt_len: int = 9):
+                   prompt_len: int = 9, paged_streams: int = 512):
     """Token-level continuous batching through the generative scheduler:
     N concurrent streams share a fixed pool of 32 KV slots, joining and
     leaving the fused decode step as they start/finish. Reports end-to-end
     tokens/s and p99 TTFT at 8/32/128 concurrent streams — the 128 level
     exercises mid-stream joins (4 generations of requests through the same
-    slots), which is the scheduler's whole point vs static batching."""
+    slots). A final 512-stream level runs the PAGED KV engine (512
+    resident slots backed by a page pool sized to actual stream lengths,
+    not 512 x max_len rectangles) and reports the headline HBM-efficiency
+    figure ``tokens_per_s_per_hbm_gb`` (baseline-tracked)."""
     import tempfile
 
     from analytics_zoo_tpu.capture.lm import TransformerLM
@@ -1362,8 +1376,9 @@ def bench_generate(streams=(8, 32, 128), max_new_tokens: int = 32,
                         max_new_tokens=max_new_tokens)
     srv = GenerativeServing(cfg, lm)
     inq, outq = InputQueue(src), OutputQueue(src)
+    n_prompts = max(max(streams), paged_streams)
     prompts = [rs.randint(0, 512, (prompt_len,)).tolist()
-               for _ in range(max(streams))]
+               for _ in range(n_prompts)]
     # warm the prefill bucket + the fused step compile before timing
     inq.enqueue_prompt("warm", prompts[0])
     srv.start()
@@ -1388,10 +1403,44 @@ def bench_generate(streams=(8, 32, 128), max_new_tokens: int = 32,
     snap = srv.health_snapshot()
     detail["tokens_total"] = snap["tokens_total"]
     detail["terminal_state"] = snap["state"]
+    # -- paged KV level: every stream resident at once, pool-backed -------
+    page_len = 16
+    per_stream = -(-max(16, prompt_len + max_new_tokens) // page_len)
+    kv_pages = paged_streams * per_stream + 1
+    psrc = f"dir://{tempfile.mkdtemp(prefix='zoo_bench_paged_')}"
+    pcfg = ServingConfig(data_src=psrc, slots=paged_streams,
+                         max_new_tokens=max_new_tokens,
+                         kv_pages=kv_pages, kv_page_len=page_len)
+    psrv = GenerativeServing(pcfg, lm)
+    pinq, poutq = InputQueue(psrc), OutputQueue(psrc)
+    pinq.enqueue_prompt("warm", prompts[0])
+    psrv.start()
+    assert poutq.query("warm", timeout_s=600) is not None
+    c = paged_streams
+    t0 = time.perf_counter()
+    for i in range(c):
+        pinq.enqueue_prompt(f"p{i}", prompts[i])
+    for i in range(c):
+        assert poutq.query(f"p{i}", timeout_s=600) is not None
+    wall = time.perf_counter() - t0
+    psnap = psrv.health_snapshot()
+    psrv.drain(timeout_s=60)
+    hbm_gb = _kv_pool_hbm_gb(lm, kv_pages, page_len)
+    detail[f"tokens_per_sec_c{c}"] = round(c * max_new_tokens / wall, 1)
+    detail[f"ttft_p99_ms_c{c}"] = psnap["ttft_ms"]["p99"]
+    detail["paged_streams"] = c
+    detail["kv_pages"] = kv_pages
+    detail["kv_page_len"] = page_len
+    detail["kv_pool_hbm_gb"] = round(hbm_gb, 6)
+    detail["tokens_per_s_per_hbm_gb"] = round(
+        detail[f"tokens_per_sec_c{c}"] / hbm_gb, 1)
     detail["note"] = ("end-to-end over the file queue (enqueue → slot "
                       "join → fused decode step → partial stream → "
                       "terminal); ttft_p99 per level reads the rolling "
-                      "histogram window after that level")
+                      "histogram window after that level; the 512 level "
+                      "runs the paged KV engine with every stream "
+                      "resident and tokens_per_s_per_hbm_gb divides its "
+                      "throughput by the page-pool footprint")
     return _BenchResult(
         metric="generate_tokens_per_sec",
         value=detail.get(f"tokens_per_sec_c{streams[1]}"),
@@ -2478,14 +2527,137 @@ def _ratio_generate():
     batched_out = batched()
     batched_s = time.perf_counter() - t0
     total = streams * new_tokens
-    return {"decode_streams": streams,
-            "new_tokens_per_stream": new_tokens,
-            "serial_tokens_per_sec": round(total / serial_s, 1),
-            "batched_tokens_per_sec": round(total / batched_s, 1),
-            "decode_parity_ok": bool(np.array_equal(serial_out,
-                                                    batched_out)),
-            "batched_vs_serial_tokens_ratio":
-                round(serial_s / max(batched_s, 1e-9), 2)}
+    out = {"decode_streams": streams,
+           "new_tokens_per_stream": new_tokens,
+           "serial_tokens_per_sec": round(total / serial_s, 1),
+           "batched_tokens_per_sec": round(total / batched_s, 1),
+           "decode_parity_ok": bool(np.array_equal(serial_out,
+                                                   batched_out)),
+           "batched_vs_serial_tokens_ratio":
+               round(serial_s / max(batched_s, 1e-9), 2)}
+    out.update(_ratio_paged(lm, rs, new_tokens, plen))
+    return out
+
+
+def _ratio_paged(lm, rs, new_tokens: int, plen: int, pstreams: int = 512,
+                 page_len: int = 16):
+    """Paged-512 vs contiguous-capacity at EQUAL KV HBM: 512 resident
+    streams on a page pool holding one page each (their actual length)
+    vs the number of contiguous ``max_len`` rectangles the same bytes
+    buy. Both engines decode the same prompts; the shared rows are
+    asserted bit-identical before the efficiency ratio is published —
+    this is the CPU stand-in for the real-chip 512-stream bench level,
+    so outage rounds still land a ``tokens_per_s_per_hbm_gb``."""
+    import jax
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.capture.lm import prefill_bucket
+    from analytics_zoo_tpu.ops.decode import (_page_positions, _paged_write,
+                                              init_slot_state)
+
+    params = lm.params
+    pl = page_len
+    assert plen - 1 + new_tokens <= pl, "one page per stream by design"
+    pool_pages = pstreams + 1
+    # same KV bytes as `contig_cap` contiguous max_len rectangles
+    contig_cap = max(1, (pool_pages - 1) * pl // lm.max_len)
+    prompts = rs.randint(0, 64, (pstreams, plen))
+    tb = prefill_bucket(plen - 1, lm.max_len)
+    padded = np.zeros((pstreams, tb), np.int32)
+    padded[:, :plen - 1] = prompts[:, :-1]
+    width = lm.max_len // pl
+    table = np.zeros((pstreams, width), np.int32)
+    table[:, 0] = 1 + np.arange(pstreams)
+    table = jnp.asarray(table)
+
+    @jax.jit
+    def prefill_paged(caches, kvs):
+        positions = jnp.broadcast_to(
+            jnp.arange(tb, dtype=jnp.int32)[None], (pstreams, tb))
+        pages, offs = _page_positions(table, positions, pl)
+        return [_paged_write(c, pages, offs, k.transpose(0, 2, 1, 3),
+                             v.transpose(0, 2, 1, 3), True)
+                for c, (k, v) in zip(caches, kvs)]
+
+    @jax.jit
+    def pstep(tokens, state, caches):
+        logits, caches = lm.paged_slot_step(params, tokens,
+                                            state["length"], table, caches)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        state = {"length": state["length"]
+                 + state["active"].astype(jnp.int32),
+                 "active": state["active"]}
+        return nxt, state, caches
+
+    @jax.jit
+    def cstep(tokens, state, caches):
+        logits, caches = lm.slot_step(params, tokens, state["length"],
+                                      caches)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        state = {"length": state["length"]
+                 + state["active"].astype(jnp.int32),
+                 "active": state["active"]}
+        return nxt, state, caches
+
+    def run_paged():
+        caches = lm.init_paged_caches(pool_pages, pl)
+        kvs = lm.prefill_kv(params, jnp.asarray(padded))
+        caches = prefill_paged(caches, kvs)
+        state = init_slot_state(pstreams)
+        state = {"length": jnp.full((pstreams,), plen - 1, jnp.int32),
+                 "active": jnp.ones((pstreams,), state["active"].dtype)}
+        tokens = jnp.asarray(prompts[:, -1].astype(np.int32))
+        outs = []
+        for _ in range(new_tokens):
+            tokens, state, caches = pstep(tokens, state, caches)
+            outs.append(np.asarray(tokens))
+        return np.stack(outs, axis=1)
+
+    def run_contig():
+        n = contig_cap
+        caches = lm.init_slot_caches(n)
+        kvs = lm.prefill_kv(params, jnp.asarray(padded[:n]))
+        caches = [{"k": c["k"].at[:, :, :tb, :].set(
+                       k.astype(c["k"].dtype)),
+                   "v": c["v"].at[:, :, :tb, :].set(
+                       v.astype(c["v"].dtype))}
+                  for c, (k, v) in zip(caches, kvs)]
+        state = init_slot_state(n)
+        state = {"length": jnp.full((n,), plen - 1, jnp.int32),
+                 "active": jnp.ones((n,), state["active"].dtype)}
+        tokens = jnp.asarray(prompts[:n, -1].astype(np.int32))
+        outs = []
+        for _ in range(new_tokens):
+            tokens, state, caches = cstep(tokens, state, caches)
+            outs.append(np.asarray(tokens))
+        return np.stack(outs, axis=1)
+
+    run_paged()  # compile both engines before timing
+    run_contig()
+    t0 = time.perf_counter()
+    paged_out = run_paged()
+    paged_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    contig_out = run_contig()
+    contig_s = time.perf_counter() - t0
+    head_dim = lm.hidden // lm.n_head
+    paged_gb = (lm.n_block * 2 * pool_pages * lm.n_head * pl
+                * head_dim * 4 / 1e9)
+    contig_gb = (lm.n_block * 2 * contig_cap * lm.n_head * lm.max_len
+                 * head_dim * 4 / 1e9)
+    paged_eff = pstreams * new_tokens / paged_s / paged_gb
+    contig_eff = contig_cap * new_tokens / contig_s / contig_gb
+    return {"paged_streams": pstreams,
+            "contiguous_capacity_streams": contig_cap,
+            "paged_parity_ok": bool(np.array_equal(
+                paged_out[:contig_cap], contig_out)),
+            "paged_tokens_per_sec": round(
+                pstreams * new_tokens / paged_s, 1),
+            "contig_tokens_per_sec": round(
+                contig_cap * new_tokens / contig_s, 1),
+            "kv_pool_hbm_gb": round(paged_gb, 6),
+            "tokens_per_s_per_hbm_gb": round(paged_eff, 1),
+            "paged_vs_contig_hbm_efficiency_ratio": round(
+                paged_eff / max(contig_eff, 1e-9), 2)}
 
 
 _RATIO_IMPLS = {
@@ -2627,7 +2799,8 @@ def _load_baseline() -> dict:
 #: bytes-roofline fractions regress silently otherwise (a fast kernel
 #: swap can hold samples/s while doubling HBM traffic)
 _BASELINE_DETAIL_KEYS = {
-    "generate": ("tokens_per_sec_c32", "ttft_p99_ms_c32"),
+    "generate": ("tokens_per_sec_c32", "ttft_p99_ms_c32",
+                 "tokens_per_s_per_hbm_gb"),
     "widedeep": ("hbm_roofline_fraction",),
     "widedeep_sharded": ("hbm_roofline_fraction",
                          "sharded_vs_dense_samples_ratio"),
@@ -2729,7 +2902,8 @@ _COMPACT_KEYS = {
     "serving": ("bert_records_per_sec", "device_records_per_sec"),
     "serving_slo": ("p50_ms", "shed_rate", "deadline_miss_rate"),
     "generate": ("tokens_per_sec_c8", "tokens_per_sec_c128",
-                 "ttft_p99_ms_c32"),
+                 "tokens_per_sec_c512", "ttft_p99_ms_c32",
+                 "tokens_per_s_per_hbm_gb"),
     "obs_overhead": ("overhead_under_2pct", "flow_chain_ok", "trace_pids"),
     "pipeline": (),
     "recovery": ("restore_ms", "recovery_vs_step", "parity_ok"),

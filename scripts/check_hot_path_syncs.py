@@ -50,6 +50,24 @@ the test run at collection time instead (``tests/test_hot_path_lint.py``).
    constant-trip tracing, not per-record work. The scheduler's single
    host fetch per step lives in the deliberately-unpoliced
    ``_fetch_tokens``.
+
+6. **Paged KV + speculative decode bodies**: the page gather/scatter ops
+   (``ops/decode.py``: ``init_paged_pool``/``page_table_set``/
+   ``page_table_clear``/``page_copy``/``_page_positions``/
+   ``_paged_write``/``paged_gather``/``paged_insert``/``paged_attention``/
+   ``paged_verify_attention`` and the speculative accept rules
+   ``spec_accept_greedy``/``_spec_accept_sampled``) must stay pure
+   vectorized advanced-indexing scatters/gathers — no host syncs, no
+   per-PAGE Python loops (a loop over table columns re-serializes the
+   gather the pool exists to batch), no ``one_hot`` densification of
+   page ids. The ``TransformerLM`` draft/verify step fns
+   (``capture/lm.py``: ``paged_slot_step``/``verify_step``/
+   ``prefill_kv_suffix``) and the scheduler's paged device methods
+   (``serving/server.py``: ``_insert_request_paged``/
+   ``_insert_request_spec``/``_insert_suffix_paged``/
+   ``_copy_page_device``) are policed like their contiguous twins —
+   syncs banned everywhere, with the constant-trip per-BLOCK loop
+   exemption for the lm step fns only.
 """
 from __future__ import annotations
 
@@ -77,6 +95,11 @@ EMBED_BODIES = ("_routing", "_lookup_body", "_lookup_bwd_body",
 SLOT_OPS = ("init_slot_cache", "slot_join", "slot_evict", "slot_insert",
             "slot_attention")
 
+PAGED_OPS = ("init_paged_pool", "page_table_set", "page_table_clear",
+             "page_copy", "_page_positions", "_paged_write", "paged_gather",
+             "paged_insert", "paged_attention", "paged_verify_attention",
+             "spec_accept_greedy", "_spec_accept_sampled")
+
 HOT_FUNCS = ("evaluate", "_evaluate_direct", "_evaluate_direct_exact",
              "predict")
 
@@ -96,11 +119,14 @@ _CHECKS: List[Tuple[str, Optional[str], Sequence[str], Sequence[str],
     (DEVICE_FEED_PY, None, ("_produce",), (), False, "loops"),
     (EMBEDDING_PY, None, EMBED_BODIES, (), True, "body"),
     (DECODE_PY, None, SLOT_OPS, (), True, "body"),
-    (LM_PY, "TransformerLM", ("slot_step", "prefill_kv"), (), False,
-     "body"),
+    (DECODE_PY, None, PAGED_OPS, (), True, "body"),
+    (LM_PY, "TransformerLM",
+     ("slot_step", "prefill_kv", "paged_slot_step", "verify_step",
+      "prefill_kv_suffix"), (), False, "body"),
     (SERVER_PY, "GenerativeServing",
-     ("_dispatch_step", "_insert_request_device", "_evict_slots"), (),
-     True, "body"),
+     ("_dispatch_step", "_insert_request_device", "_insert_request_paged",
+      "_insert_request_spec", "_insert_suffix_paged", "_copy_page_device",
+      "_evict_slots"), (), True, "body"),
 ]
 
 

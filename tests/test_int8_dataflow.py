@@ -97,6 +97,7 @@ class TestBackbone:
         assert abs(mi - mf) / max(mf, 1e-6) < 0.15
         assert _cos(fi.astype(jnp.float32), ff) > 0.95
 
+    @pytest.mark.slow
     def test_grads_correlate_with_float_late_layers(self, built):
         """STE grads vs the float mirror: late layers must match tightly;
         early layers accumulate quantization noise through depth (expected
@@ -137,6 +138,7 @@ class TestBackbone:
 
 
 class TestConvergenceParity:
+    @pytest.mark.slow
     def test_tracks_float_mirror_training(self):
         """Train the SAME architecture from the SAME init on the SAME data
         twice — once through the int8 dataflow, once through the float
@@ -202,6 +204,7 @@ class TestConvergenceParity:
 
 
 class TestEstimatorIntegration:
+    @pytest.mark.slow
     def test_train_descends_and_predicts(self):
         from analytics_zoo_tpu.estimator import Estimator
         from analytics_zoo_tpu.feature import FeatureSet

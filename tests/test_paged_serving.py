@@ -1,0 +1,334 @@
+"""Paged KV scheduler: parity with the contiguous engine and with serial
+generate, copy-on-write shared prefixes (prefilled ONCE), speculative
+draft/verify token-identity, page-pool exhaustion chaos
+(``serving.page_alloc``), and the paged metrics plane.
+
+Op-level paged invariants live in tests/test_paged_kv.py; the contiguous
+scheduler's own parity suite is tests/test_generative_serving.py.
+"""
+import uuid
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import faults
+from analytics_zoo_tpu.common import metrics as _metrics
+from analytics_zoo_tpu.serving import GenerativeServing, ServingConfig
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.server import PAGE_SHED_ERROR
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+pytestmark = pytest.mark.slow  # scheduler-level suite; tier-1 covers the op layer
+
+_LM_CACHE = {}
+
+
+def _lm(max_len=32, seed=0):
+    lm = _LM_CACHE.get((max_len, seed))
+    if lm is None:
+        from analytics_zoo_tpu.capture.lm import TransformerLM
+        rs = np.random.RandomState(seed)
+        lm = TransformerLM(vocab_size=16, hidden=16, n_block=2, n_head=2,
+                           max_len=max_len, seed=seed)
+        lm.fit(rs.randint(0, 16, (32, 12)), batch_size=8, epochs=1)
+        _LM_CACHE[(max_len, seed)] = lm
+    return lm
+
+
+def _src(tmp_path):
+    return f"dir://{tmp_path}/{uuid.uuid4().hex[:8]}"
+
+
+def _drive(srv, steps=200):
+    idle = 0
+    for _ in range(steps):
+        if srv.serve_step() == 0:
+            idle += 1
+            if idle >= 3:
+                return
+        else:
+            idle = 0
+
+
+def _paged_cfg(src, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_new_tokens", 8)
+    kw.setdefault("kv_pages", 16)
+    kw.setdefault("kv_page_len", 8)
+    return ServingConfig(data_src=src, **kw)
+
+
+class TestPagedParity:
+    @pytest.mark.slow
+    def test_greedy_bit_identical_with_midstream_joins(self, ctx, tmp_path):
+        # 5 requests through 2 slots: the page pool sees mid-stream joins
+        # reusing pages freed by earlier retirements
+        lm = _lm()
+        rs = np.random.RandomState(3)
+        prompts = [rs.randint(0, 16, (n,)).tolist() for n in (4, 1, 6, 3, 5)]
+        serial = [lm.generate(np.asarray([p]), max_new_tokens=8)[0].tolist()
+                  for p in prompts]
+        src = _src(tmp_path)
+        srv = GenerativeServing(_paged_cfg(src), lm)
+        inq, outq = InputQueue(src), OutputQueue(src)
+        for i, p in enumerate(prompts):
+            inq.enqueue_prompt(f"r{i}", p)
+        _drive(srv)
+        for i, want in enumerate(serial):
+            res = outq.query(f"r{i}", timeout_s=5)
+            assert res is not None and res.get("done") is True
+            assert res["value"] == want, f"stream r{i} diverged"
+        snap = srv.health_snapshot()
+        assert snap["slots_occupied"] == 0
+        # every page returned to the pool after the last retirement
+        assert snap["kv_pages_free"] == 15
+
+    @pytest.mark.slow
+    def test_sampled_bit_identical_per_request_seed(self, ctx, tmp_path):
+        lm = _lm()
+        rs = np.random.RandomState(4)
+        prompts = [rs.randint(0, 16, (n,)).tolist() for n in (5, 2, 1, 7)]
+        seeds = [11, 22, 33, 44]
+        serial = [lm.generate(np.asarray([p]), max_new_tokens=8,
+                              temperature=0.9, top_k=8, seed=s)[0].tolist()
+                  for p, s in zip(prompts, seeds)]
+        src = _src(tmp_path)
+        srv = GenerativeServing(
+            _paged_cfg(src, temperature=0.9, top_k=8), lm)
+        inq, outq = InputQueue(src), OutputQueue(src)
+        for i, (p, s) in enumerate(zip(prompts, seeds)):
+            inq.enqueue_prompt(f"r{i}", p, seed=s)
+        _drive(srv)
+        for i, want in enumerate(serial):
+            res = outq.query(f"r{i}", timeout_s=5)
+            assert res is not None and res["value"] == want
+
+    @pytest.mark.slow
+    def test_int8_kv_token_parity(self, ctx, tmp_path):
+        """int8 pool error (bounded at the op level) is far inside the
+        tiny model's logit margins, so the token streams stay equal."""
+        lm = _lm()
+        rs = np.random.RandomState(5)
+        prompts = [rs.randint(0, 16, (n,)).tolist() for n in (4, 6)]
+        serial = [lm.generate(np.asarray([p]), max_new_tokens=8)[0].tolist()
+                  for p in prompts]
+        src = _src(tmp_path)
+        srv = GenerativeServing(_paged_cfg(src, kv_int8=True), lm)
+        inq, outq = InputQueue(src), OutputQueue(src)
+        for i, p in enumerate(prompts):
+            inq.enqueue_prompt(f"q{i}", p)
+        _drive(srv)
+        for i, want in enumerate(serial):
+            res = outq.query(f"q{i}", timeout_s=5)
+            assert res is not None and res["value"] == want
+
+
+class TestSharedPrefixCoW:
+    @pytest.mark.slow
+    def test_prefix_prefilled_once_and_bit_identical(self, ctx, tmp_path,
+                                                     monkeypatch):
+        lm = _lm()
+        prefix = [3, 7, 2, 9, 5]                        # 5 tokens: CoW tail
+        lasts = [1, 4, 8, 12]
+        prompts = [prefix + [t] for t in lasts]
+        # serial references FIRST — the call counter below must only see
+        # the scheduler's traffic
+        serial = [lm.generate(np.asarray([p]), max_new_tokens=8)[0].tolist()
+                  for p in prompts]
+        calls = []
+        orig = lm.prefill_kv
+        monkeypatch.setattr(
+            lm, "prefill_kv",
+            lambda params, tokens: (calls.append(tokens.shape), orig(
+                params, tokens))[1])
+        src = _src(tmp_path)
+        srv = GenerativeServing(_paged_cfg(src), lm)
+        free0 = srv.health_snapshot()["kv_pages_free"]
+        srv.register_prefix(prefix)
+        assert srv.health_snapshot()["kv_pages_free"] == free0 - 1
+        # prompt = prefix + one token joins with NO suffix forward at all:
+        # decode reads the registered pages through a CoW tail copy, so
+        # the streams are bit-identical to serial generate
+        inq, outq = InputQueue(src), OutputQueue(src)
+        for i, p in enumerate(prompts):
+            inq.enqueue_prompt(f"c{i}", p)
+        _drive(srv)
+        for i, want in enumerate(serial):
+            res = outq.query(f"c{i}", timeout_s=5)
+            assert res is not None and res["value"] == want
+        # the common prefix went through the transformer EXACTLY once
+        # (register time); joins never re-prefilled it
+        assert len(calls) == 1
+        # registry keeps its permanent page across all retirements
+        assert srv.health_snapshot()["kv_pages_free"] == free0 - 1
+
+    @pytest.mark.slow
+    def test_divergent_suffixes_only_prefill_the_suffix(self, ctx, tmp_path,
+                                                        monkeypatch):
+        lm = _lm()
+        rs = np.random.RandomState(6)
+        prefix = rs.randint(0, 16, (6,)).tolist()
+        prompts = [prefix + rs.randint(0, 16, (n,)).tolist()
+                   for n in (3, 5, 2)]
+        serial = [lm.generate(np.asarray([p]), max_new_tokens=6)[0].tolist()
+                  for p in prompts]
+        calls, scalls = [], []
+        orig, sorig = lm.prefill_kv, lm.prefill_kv_suffix
+        monkeypatch.setattr(
+            lm, "prefill_kv",
+            lambda params, tokens: (calls.append(tokens.shape), orig(
+                params, tokens))[1])
+        monkeypatch.setattr(
+            lm, "prefill_kv_suffix",
+            lambda params, tokens, pref, plen: (
+                scalls.append(tokens.shape), sorig(params, tokens, pref,
+                                                   plen))[1])
+        src = _src(tmp_path)
+        srv = GenerativeServing(_paged_cfg(src, max_new_tokens=6), lm)
+        srv.register_prefix(prefix)
+        inq, outq = InputQueue(src), OutputQueue(src)
+        for i, p in enumerate(prompts):
+            inq.enqueue_prompt(f"s{i}", p)
+        _drive(srv)
+        for i, want in enumerate(serial):
+            res = outq.query(f"s{i}", timeout_s=5)
+            assert res is not None and res["value"] == want
+        assert len(calls) == 1          # the register-time prefix forward
+        assert len(scalls) >= 1         # joins ran the SUFFIX path only
+
+
+class TestSpeculative:
+    @pytest.mark.slow
+    def test_spec_token_identical_to_serial_greedy(self, ctx, tmp_path):
+        lm = _lm()
+        draft = _lm(max_len=64, seed=1)   # different weights: a REAL draft
+        rs = np.random.RandomState(7)
+        prompts = [rs.randint(0, 16, (n,)).tolist() for n in (4, 1, 6)]
+        serial = [lm.generate(np.asarray([p]), max_new_tokens=8)[0].tolist()
+                  for p in prompts]
+        src = _src(tmp_path)
+        srv = GenerativeServing(_paged_cfg(src, spec_k=3), lm,
+                                draft_lm=draft)
+        inq, outq = InputQueue(src), OutputQueue(src)
+        for i, p in enumerate(prompts):
+            inq.enqueue_prompt(f"v{i}", p)
+        _drive(srv)
+        for i, want in enumerate(serial):
+            res = outq.query(f"v{i}", timeout_s=5)
+            assert res is not None and res.get("done") is True
+            assert res["value"] == want, f"stream v{i} diverged"
+        snap = srv.health_snapshot()
+        assert snap["spec_accept_ratio"] is not None
+        assert 0.0 <= snap["spec_accept_ratio"] <= 1.0
+
+    @pytest.mark.slow
+    def test_spec_eos_terminates_streams(self, ctx, tmp_path):
+        lm = _lm()
+        draft = _lm(max_len=64, seed=1)
+        eos = 1
+        rs = np.random.RandomState(8)
+        prompts = [rs.randint(0, 16, (n,)).tolist() for n in (4, 3)]
+        serial = [lm.generate(np.asarray([p]), max_new_tokens=10,
+                              eos_id=eos)[0].tolist() for p in prompts]
+        src = _src(tmp_path)
+        srv = GenerativeServing(
+            _paged_cfg(src, max_new_tokens=10, spec_k=3, eos_id=eos), lm,
+            draft_lm=draft)
+        inq, outq = InputQueue(src), OutputQueue(src)
+        for i, p in enumerate(prompts):
+            inq.enqueue_prompt(f"e{i}", p)
+        _drive(srv)
+        for i, row in enumerate(serial):
+            want = row[:row.index(eos) + 1] if eos in row else row
+            res = outq.query(f"e{i}", timeout_s=5)
+            assert res is not None and res["value"] == want
+
+    def test_spec_requires_paged_and_greedy(self, ctx, tmp_path):
+        lm = _lm()
+        draft = _lm(max_len=64, seed=1)
+        src = _src(tmp_path)
+        with pytest.raises(ValueError, match="paged"):
+            GenerativeServing(
+                ServingConfig(data_src=src, slots=2, spec_k=2), lm,
+                draft_lm=draft)
+        with pytest.raises(ValueError, match="greedy"):
+            GenerativeServing(_paged_cfg(src, spec_k=2, temperature=0.8),
+                              lm, draft_lm=draft)
+
+
+class TestPagePoolChaos:
+    def test_page_alloc_fault_sheds_join_keeps_serving(self, ctx, tmp_path):
+        """The armed ``serving.page_alloc`` site simulates pool exhaustion
+        at join: the victim is SHED with its one terminal result and the
+        resident stream keeps decoding to its serial-identical end."""
+        lm = _lm()
+        src = _src(tmp_path)
+        srv = GenerativeServing(_paged_cfg(src), lm)
+        inq, outq = InputQueue(src), OutputQueue(src)
+        serial = lm.generate(np.asarray([[2, 3, 5]]),
+                             max_new_tokens=8)[0].tolist()
+        inq.enqueue_prompt("alive", [2, 3, 5])
+        srv.serve_step()                      # resident stream joins first
+        faults.arm("serving.page_alloc", at=1)
+        inq.enqueue_prompt("victim", [4, 1])
+        _drive(srv)
+        assert faults.fire_count("serving.page_alloc") == 1
+        res = outq.query("victim", timeout_s=5)
+        assert res is not None and res["error"] == PAGE_SHED_ERROR
+        assert srv.counters["shed"] == 1
+        # the resident stream was untouched by the shed
+        assert outq.query("alive", timeout_s=5)["value"] == serial
+        # and the NEXT request (fault budget spent) decodes normally
+        inq.enqueue_prompt("after", [2, 3, 5])
+        _drive(srv)
+        assert outq.query("after", timeout_s=5)["value"] == serial
+
+    @pytest.mark.slow
+    def test_real_exhaustion_sheds_then_recovers_after_retire(
+            self, ctx, tmp_path):
+        # 4 usable pages, 2 per stream: the third concurrent join finds
+        # an empty pool and is shed; retirement refunds the pages and the
+        # next request sails through
+        lm = _lm()
+        src = _src(tmp_path)
+        srv = GenerativeServing(_paged_cfg(src, slots=3, kv_pages=5), lm)
+        inq, outq = InputQueue(src), OutputQueue(src)
+        serial = lm.generate(np.asarray([[2, 3]]),
+                             max_new_tokens=8)[0].tolist()
+        for i in range(3):
+            inq.enqueue_prompt(f"x{i}", [2, 3])
+        _drive(srv)
+        errors = [outq.query(f"x{i}", timeout_s=5) for i in range(3)]
+        shed = [r for r in errors if r.get("error") == PAGE_SHED_ERROR]
+        done = [r for r in errors if r.get("value") == serial]
+        assert len(shed) == 1 and len(done) == 2
+        assert srv.counters["shed"] == 1
+        snap = srv.health_snapshot()
+        assert snap["kv_pages_free"] == 4   # refunded at retirement
+        inq.enqueue_prompt("x3", [2, 3])
+        _drive(srv)
+        assert outq.query("x3", timeout_s=5)["value"] == serial
+
+    def test_paged_metrics_exposed(self, ctx, tmp_path):
+        lm = _lm()
+        src = _src(tmp_path)
+        srv = GenerativeServing(_paged_cfg(src), lm)
+        inq = InputQueue(src)
+        inq.enqueue_prompt("m0", [5, 2, 8])
+        _drive(srv)
+        text = _metrics.expose_text()
+        for name in ("serving_kv_pages_free",
+                     "serving_kv_page_evictions_total",
+                     "serving_spec_accept_ratio"):
+            assert name in text
+        # the retirement refunded this stream's pages as evictions
+        snap = srv.health_snapshot()
+        assert snap["kv_pages_free"] == 15
+        assert snap["spec_accept_ratio"] is None   # not a spec server
