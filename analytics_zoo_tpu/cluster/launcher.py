@@ -121,16 +121,16 @@ class PodLauncher:
             for p in procs:
                 if p.poll() is None:
                     p.terminate()
-            deadline = time.time() + 5
+            deadline = time.monotonic() + 5
             for p in procs:
                 if p.poll() is None:
                     try:
-                        p.wait(timeout=max(0.1, deadline - time.time()))
+                        p.wait(timeout=max(0.1, deadline - time.monotonic()))
                     except subprocess.TimeoutExpired:
                         p.kill()
 
     def _wait(self, procs, logs, timeout) -> List[WorkerResult]:
-        deadline = time.time() + timeout if timeout else None
+        deadline = time.monotonic() + timeout if timeout else None
         while True:
             rcs = [p.poll() for p in procs]
             if all(rc is not None for rc in rcs):
@@ -141,16 +141,16 @@ class PodLauncher:
                 for p in procs:
                     if p.poll() is None:
                         p.terminate()
-                deadline = time.time() + 5  # reap so returncodes are real
+                deadline = time.monotonic() + 5  # reap so returncodes are real
                 for p in procs:
                     if p.poll() is None:
                         try:
-                            p.wait(timeout=max(0.1, deadline - time.time()))
+                            p.wait(timeout=max(0.1, deadline - time.monotonic()))
                         except subprocess.TimeoutExpired:
                             p.kill()
                             p.wait()
                 break
-            if deadline and time.time() > deadline:
+            if deadline and time.monotonic() > deadline:
                 for p in procs:
                     if p.poll() is None:
                         p.terminate()

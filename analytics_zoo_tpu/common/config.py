@@ -149,8 +149,8 @@ _global_config.register("data.worker_respawns", 2,
                         "replacement and resubmits the lost task; once "
                         "exhausted the consumer gets TransformWorkerError "
                         "promptly instead of hanging.")
-_global_config.register("version_check", False,
-                        "Warn on jax/libtpu version mismatches at context init "
+_global_config.register("version.check", False,
+                        "Warn on jax/jaxlib version skew at context init "
                         "(reference: spark.analytics.zoo.versionCheck).")
 _global_config.register("data.prefetch", 2, "Device-feed prefetch depth.")
 _global_config.register("data.num_workers", 0,

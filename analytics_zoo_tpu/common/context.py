@@ -89,6 +89,25 @@ def wire_compilation_cache() -> bool:
     return True
 
 
+def _version_check() -> None:
+    """Warn on jax/jaxlib version skew (the ``spark.analytics.zoo.
+    versionCheck`` analogue): a mismatched pair is the classic source of
+    silent miscompiles and ABI crashes on TPU hosts. Opt-in via the
+    ``version.check`` config key."""
+    if not global_config().get("version.check"):
+        return
+    try:
+        import jaxlib
+        jaxlib_version = getattr(jaxlib, "__version__", "unknown")
+    except ImportError:  # pragma: no cover - jaxlib always ships with jax
+        jaxlib_version = "unavailable"
+    if jaxlib_version != jax.__version__:
+        logger.warning(
+            "version.check: jax %s != jaxlib %s — upgrade the pair in "
+            "lockstep (see the JAX compatibility table)",
+            jax.__version__, jaxlib_version)
+
+
 def _build_mesh(devices: Sequence[jax.Device],
                 mesh_shape: Optional[Tuple[int, ...]] = None,
                 axis_names: Optional[Tuple[str, ...]] = None) -> Mesh:
@@ -144,6 +163,7 @@ def init_tpu_context(mesh_shape: Optional[Tuple[int, ...]] = None,
         if conf:
             for k, v in conf.items():
                 cfg.set(k, v)
+        _version_check()
         wire_compilation_cache()
         devices = jax.devices()
         mesh = _build_mesh(devices, mesh_shape, axis_names)

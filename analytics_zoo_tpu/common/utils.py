@@ -65,6 +65,17 @@ def time_it(name: str, log: bool = False) -> Iterator[None]:
             logger.info("%s: %.3fms", name, elapsed * 1e3)
 
 
+def wall_clock() -> float:
+    """Epoch seconds for stamps that CROSS process boundaries: queue lease
+    stamps, request ``enqueue_t``, ``health.json``, client-supplied
+    deadlines. Wall-clock is the only clock two hosts share, so these
+    genuinely cannot use ``time.monotonic()`` — every other interval or
+    deadline in-process must. Routing all cross-process stamps through
+    this one audited call keeps the intent explicit and grep-able (the
+    ``monotonic-clock`` zoolint pass bans bare ``time.time()``)."""
+    return time.time()  # zoolint: disable=monotonic-clock — the one audited wall-clock read; cross-process stamps need epoch time
+
+
 def tree_size_bytes(tree) -> int:
     """Total byte size of all array leaves in a pytree."""
     leaves = jax.tree_util.tree_leaves(tree)

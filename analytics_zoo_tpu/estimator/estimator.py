@@ -866,7 +866,7 @@ class Estimator:
         retry_budget = int(cfg.get("failure.retry_times"))
         retry_window = float(cfg.get("failure.retry_interval_s"))
         retries_left = retry_budget
-        last_failure = 0.0
+        last_failure = float("-inf")  # monotonic domain: no epoch-0 anchor
         history: List[float] = []
         pending: List[Any] = []  # device loss scalars, drained per epoch
         # only sync loss to host per-step when something consumes it; otherwise
@@ -961,6 +961,7 @@ class Estimator:
                         # phase instead of hiding inside the loss sync —
                         # profiling trades the async pipeline for this
                         t_x = time.perf_counter()
+                        # zoolint: disable=jit-host-sync — deliberate profiling fence (prof mode trades the async pipeline for phase attribution)
                         jax.block_until_ready(losses)
                         _P_TRAIN.add("execute", time.perf_counter() - t_x,
                                      start=t_x)
@@ -973,11 +974,13 @@ class Estimator:
 
                     if need_loss:
                         with _P_TRAIN.phase("fetch"):
-                            loss_val = float(loss)  # device sync point
+                            # device sync point
+                            # zoolint: disable=jit-host-sync — gated: runs only when a trigger/writer consumes the loss
+                            loss_val = float(loss)
                         state.loss = loss_val
                         if self._train_writer is not None:
                             lr = self.optimizer.learning_rate
-                            lr_val = (float(lr(self.global_step)) if callable(lr)
+                            lr_val = (float(lr(self.global_step)) if callable(lr)  # zoolint: disable=jit-host-sync — host-side LR schedule, evaluated behind the gated loss sync
                                       else float(lr))
                             self._train_writer.add_scalar("Loss", loss_val,
                                                           self.global_step)
@@ -1026,6 +1029,7 @@ class Estimator:
                         # point where async step failures surface so the
                         # checkpoint-retry path below can catch them, and it
                         # bounds the number of live device scalars
+                        # zoolint: disable=jit-host-sync — per-EPOCH drain, not per-step: the sanctioned pattern
                         history.extend(_flat_losses(jax.device_get(pending)))
                         pending.clear()
                         state.epoch += 1
@@ -1050,7 +1054,7 @@ class Estimator:
                     state.epoch += 1
             except Exception:
                 # elasticity: retry from newest checkpoint (Topology.scala:1180-1262)
-                now = time.time()
+                now = time.monotonic()
                 if now - last_failure > retry_window:
                     retries_left = retry_budget  # sparse failures reset budget
                 last_failure = now

@@ -1,0 +1,15 @@
+"""zoolint: the repo's unified static-analysis framework.
+
+One shared parse pass (:class:`Project`), a plugin registry of
+:class:`LintPass` checkers, per-line ``# zoolint: disable=<pass>``
+suppressions with an unused-waiver check, and text/GitHub-annotation
+output. Run it with ``python -m analytics_zoo_tpu.lint`` (or the
+``zoolint`` console script); see ``docs/linting.md``.
+"""
+from .core import (Finding, LintPass, Project, RunResult,  # noqa: F401
+                   UNUSED_SUPPRESSION_ID, all_passes, get_project,
+                   register_pass, run_passes)
+
+__all__ = ["Finding", "LintPass", "Project", "RunResult",
+           "UNUSED_SUPPRESSION_ID", "all_passes", "get_project",
+           "register_pass", "run_passes"]

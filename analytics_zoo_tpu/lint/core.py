@@ -1,0 +1,326 @@
+"""zoolint core: shared parse pass, findings, suppressions, pass registry.
+
+Every checker in this package is a *pass* over one shared :class:`Project`
+index — each file under the package, ``bench.py``, and ``tests/`` is read
+and AST-parsed exactly once per process (cached by mtime/size), no matter
+how many passes run or how many entry points (pytest collection guards,
+the ``python -m analytics_zoo_tpu.lint`` CLI, the legacy ``scripts/
+check_*.py`` shims) invoke them.
+
+Findings can be waived per line with a suppression comment::
+
+    x = time.time()  # zoolint: disable=monotonic-clock — cross-process stamp
+
+or, on its own line, applying to the next source line::
+
+    # zoolint: disable=jit-host-sync — constant-trip per-BLOCK tracing loop
+    for li, blk in enumerate(params["blocks"]):
+
+A file-level waiver (``# zoolint: disable-file=<pass>``) anywhere in a file
+waives the whole file for that pass. Every suppression MUST carry a
+justification after the pass list, and the built-in ``unused-suppression``
+check fails when a waiver no longer matches any finding — waivers cannot
+rot into silent blanket exemptions.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: repo root: analytics_zoo_tpu/lint/core.py -> repo
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+PACKAGE_DIR = os.path.join(REPO_ROOT, "analytics_zoo_tpu")
+
+UNUSED_SUPPRESSION_ID = "unused-suppression"
+
+_SUPP_RE = re.compile(
+    r"zoolint:\s*(disable|disable-file)=([A-Za-z0-9_,\-]+)\s*(.*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint result. ``file`` is absolute; ``rel()`` is repo-relative."""
+    file: str
+    line: int
+    pass_id: str
+    message: str
+    fix_hint: str = ""
+
+    def rel(self) -> str:
+        try:
+            return os.path.relpath(self.file, REPO_ROOT)
+        except ValueError:
+            return self.file
+
+    def text(self) -> str:
+        hint = f"  [fix: {self.fix_hint}]" if self.fix_hint else ""
+        return f"{self.rel()}:{self.line}: [{self.pass_id}] {self.message}{hint}"
+
+    def github(self) -> str:
+        msg = self.message.replace("%", "%25").replace("\n", "%0A")
+        return (f"::error file={self.rel()},line={self.line},"
+                f"title=zoolint/{self.pass_id}::{msg}")
+
+
+@dataclass
+class Suppression:
+    line: int                 # comment's own line number
+    pass_ids: Tuple[str, ...]
+    justification: str
+    file_level: bool
+    used: bool = False
+
+
+@dataclass
+class SourceFile:
+    path: str
+    text: str
+    _tree: Optional[ast.Module] = field(default=None, repr=False)
+    _suppressions: Optional[List[Suppression]] = field(default=None,
+                                                       repr=False)
+
+    @property
+    def tree(self) -> ast.Module:
+        """AST, parsed on first access — passes that only need raw text
+        (e.g. test-mention scans) never pay for a parse of the file."""
+        if self._tree is None:
+            self._tree = ast.parse(self.text, filename=self.path)
+        return self._tree
+
+    @property
+    def suppressions(self) -> List[Suppression]:
+        """Waiver comments, tokenized on first access (tokenize is
+        pure-Python; text-only scans shouldn't pay for it)."""
+        if self._suppressions is None:
+            self._suppressions = _parse_suppressions(self.path, self.text)
+        return self._suppressions
+
+    def _match(self, pass_id: str, line: int) -> Optional[Suppression]:
+        for s in self.suppressions:
+            if pass_id not in s.pass_ids:
+                continue
+            if s.file_level or s.line in (line, line - 1):
+                return s
+        return None
+
+    def suppresses(self, finding: Finding) -> bool:
+        """True (and marks the waiver used) when a suppression covers the
+        finding: same line, the standalone comment line directly above, or
+        a file-level waiver."""
+        s = self._match(finding.pass_id, finding.line)
+        if s is not None:
+            s.used = True
+            return True
+        return False
+
+
+def _parse_suppressions(path: str, text: str) -> List[Suppression]:
+    """Comment-token scan (``tokenize``), so a ``# zoolint:`` sequence
+    inside a string literal — e.g. a test fixture seeding a bad file — is
+    never mistaken for a live waiver."""
+    out: List[Suppression] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(text).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPP_RE.search(tok.string)
+            if not m:
+                continue
+            ids = tuple(p.strip() for p in m.group(2).split(",") if p.strip())
+            just = m.group(3).strip().lstrip("—–:- (").rstrip(")").strip()
+            out.append(Suppression(tok.start[0], ids, just,
+                                   m.group(1) == "disable-file"))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return out
+
+
+class Project:
+    """Cached AST + source index over the repo's analyzable files."""
+
+    def __init__(self, root: str = REPO_ROOT) -> None:
+        self.root = os.path.abspath(root)
+        self._cache: Dict[str, Tuple[Tuple[float, int], SourceFile]] = {}
+
+    # -- file walks -----------------------------------------------------------
+
+    def _walk(self, base: str) -> List[str]:
+        if os.path.isfile(base):
+            return [base]
+        files: List[str] = []
+        for dirpath, dirs, names in os.walk(base):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            files.extend(os.path.join(dirpath, n) for n in names
+                         if n.endswith(".py"))
+        return sorted(files)
+
+    def package_files(self) -> List[str]:
+        return self._walk(os.path.join(self.root, "analytics_zoo_tpu"))
+
+    def test_files(self) -> List[str]:
+        return self._walk(os.path.join(self.root, "tests"))
+
+    def bench_file(self) -> str:
+        return os.path.join(self.root, "bench.py")
+
+    def all_files(self) -> List[str]:
+        files = self.package_files() + self.test_files()
+        bench = self.bench_file()
+        if os.path.exists(bench):
+            files.append(bench)
+        return files
+
+    # -- cached parse ---------------------------------------------------------
+
+    def source(self, path: str) -> SourceFile:
+        """Parse-once accessor; works for any path (tests hand it tmp
+        files), keyed by (mtime, size) so edits invalidate."""
+        path = os.path.abspath(path)
+        st = os.stat(path)
+        key = (st.st_mtime, st.st_size)
+        hit = self._cache.get(path)
+        if hit is not None and hit[0] == key:
+            return hit[1]
+        with open(path) as fh:
+            text = fh.read()
+        src = SourceFile(path, text)
+        self._cache[path] = (key, src)
+        return src
+
+    def ast_for(self, path: str) -> ast.Module:
+        return self.source(path).tree
+
+
+_project: Optional[Project] = None
+
+
+def get_project() -> Project:
+    """The process-global shared index — every entry point funnels here, so
+    the repo is read and parsed once per process."""
+    global _project
+    if _project is None:
+        _project = Project()
+    return _project
+
+
+# -- pass registry ------------------------------------------------------------
+
+class LintPass:
+    """One analysis plugin. Subclasses set ``id``/``title``/``rationale``
+    and implement ``run(project) -> list[Finding]`` (raw findings —
+    suppression filtering happens in the runner)."""
+
+    id: str = ""
+    title: str = ""
+    rationale: str = ""
+
+    def run(self, project: Project) -> List[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, LintPass] = {}
+
+
+def register_pass(cls):
+    """Class decorator: instantiate and register a pass by its ``id``.
+    Re-registration with the same id replaces (supports module reload)."""
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"{cls.__name__} has no pass id")
+    _REGISTRY[inst.id] = inst
+    return cls
+
+
+def all_passes() -> Dict[str, LintPass]:
+    from . import passes  # noqa: F401 — importing registers the plugins
+    return dict(_REGISTRY)
+
+
+@dataclass
+class RunResult:
+    findings: List[Finding]          # active (unsuppressed) findings
+    suppressed: List[Finding]        # findings waived by a live suppression
+    pass_ids: List[str]
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def run_passes(project: Optional[Project] = None,
+               ids: Optional[Sequence[str]] = None) -> RunResult:
+    """Run the selected passes (default: all), apply suppressions, then
+    append ``unused-suppression`` findings for stale or justification-less
+    waivers of the selected passes."""
+    project = project or get_project()
+    registry = all_passes()
+    if ids is None:
+        selected = [p for p in registry.values()]
+    else:
+        unknown = [i for i in ids if i not in registry]
+        if unknown:
+            raise KeyError(f"unknown pass id(s): {', '.join(unknown)}; "
+                           f"known: {', '.join(sorted(registry))}")
+        selected = [registry[i] for i in ids]
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    seen: Set[Tuple[str, int, str, str]] = set()
+    for p in selected:
+        for f in p.run(project):
+            key = (f.file, f.line, f.pass_id, f.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            try:
+                src = project.source(f.file)
+            except OSError:
+                src = None
+            if src is not None and src.suppresses(f):
+                suppressed.append(f)
+            else:
+                active.append(f)
+    selected_ids = {p.id for p in selected}
+    active.extend(_suppression_hygiene(project, selected_ids))
+    active.sort(key=lambda f: (f.rel(), f.line, f.pass_id))
+    return RunResult(active, suppressed, [p.id for p in selected])
+
+
+def _suppression_hygiene(project: Project, selected_ids: Set[str]
+                         ) -> List[Finding]:
+    """The waiver ledger must stay honest: every suppression names known
+    passes, carries a justification, and still matches a real finding."""
+    known = set(all_passes()) | {UNUSED_SUPPRESSION_ID}
+    out: List[Finding] = []
+    for path in project.all_files():
+        src = project.source(path)
+        for s in src.suppressions:
+            bogus = [i for i in s.pass_ids if i not in known]
+            if bogus:
+                out.append(Finding(
+                    path, s.line, UNUSED_SUPPRESSION_ID,
+                    f"suppression names unknown pass(es) "
+                    f"{', '.join(bogus)}",
+                    "use ids from `python -m analytics_zoo_tpu.lint "
+                    "--list`"))
+                continue
+            if not s.justification:
+                out.append(Finding(
+                    path, s.line, UNUSED_SUPPRESSION_ID,
+                    f"suppression for {', '.join(s.pass_ids)} has no "
+                    f"justification",
+                    "append ' — <why this waiver is sound>'"))
+                continue
+            if not s.used and set(s.pass_ids) <= selected_ids:
+                out.append(Finding(
+                    path, s.line, UNUSED_SUPPRESSION_ID,
+                    f"unused suppression for {', '.join(s.pass_ids)} — no "
+                    f"finding matches this waiver anymore",
+                    "delete the stale comment"))
+    return out

@@ -1,0 +1,10 @@
+"""zoolint built-in passes. Importing this package registers every pass
+with :func:`analytics_zoo_tpu.lint.core.register_pass`; third-party or
+repo-local passes can do the same — subclass ``LintPass``, decorate with
+``@register_pass``, and import the module before calling ``run_passes``.
+"""
+from . import (config_keys, fault_sites, hot_path, jit_boundary,  # noqa: F401
+               metric_names, monotonic_clock)
+
+__all__ = ["config_keys", "fault_sites", "hot_path", "jit_boundary",
+           "metric_names", "monotonic_clock"]

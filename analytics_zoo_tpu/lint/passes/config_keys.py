@@ -1,0 +1,237 @@
+"""zoolint pass ``config-keys``: the config registry stays a closed ledger.
+
+The layered config (``analytics_zoo_tpu/common/config.py``) is the
+platform's operator API: every ``"x.y"`` key is settable from env vars,
+``conf={...}`` overrides, and the defaults layer. That API only stays
+trustworthy if the registry is bijective with reality:
+
+1. key arguments to ``register``/``get``/``set``/``unset`` on a config
+   receiver are string LITERALS (a computed key defeats this lint, grep,
+   and the docs); dynamic plumbing that forwards ``(k, v)`` pairs —
+   e.g. applying a ``conf`` dict — is exempt (non-literal keys are
+   simply not analyzable, and registration still validates them at
+   runtime);
+2. keys follow the dotted ``section.name`` convention (lower_snake
+   segments, at least one dot) so env-var mapping (``ZOO_TPU_SECTION_
+   NAME``) stays mechanical;
+3. each key is registered exactly ONCE — one owning module (today:
+   ``common/config.py``); a second registration would silently change
+   defaults/docs depending on import order;
+4. every ``get``/``set``/``unset`` of a literal key refers to a
+   REGISTERED key (a typo'd read returns the miss default forever);
+5. every registered key is READ somewhere in the package — a registered-
+   but-never-consumed key is dead operator surface that silently does
+   nothing when set;
+6. every registered key has a row in ``docs/configuration.md`` and the
+   table has no stale rows for unregistered keys.
+
+Config receivers are resolved, not guessed by name: ``_global_config``
+inside ``common/config.py``, direct ``global_config().op(...)`` chains,
+and any local name assigned from ``global_config()`` in the same file.
+``dict.get("...")`` calls elsewhere never match.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Set, Tuple
+
+from ..core import (Finding, LintPass, Project, REPO_ROOT, get_project,
+                    register_pass)
+
+_CONFIG_PY = os.path.join(REPO_ROOT, "analytics_zoo_tpu", "common",
+                          "config.py")
+_DOCS = os.path.join(REPO_ROOT, "docs", "configuration.md")
+
+_OPS = ("register", "get", "set", "unset")
+_KEY_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
+_DOC_ROW_RE = re.compile(r"^\|\s*`([^`]+)`")
+
+
+def _config_receivers(tree: ast.Module, path: str) -> Set[str]:
+    """Names that hold the global config in this file: assigned from a
+    ``global_config()`` call (any alias import) or, in config.py itself,
+    the module-level ``_global_config`` instance."""
+    names: Set[str] = set()
+    if os.path.abspath(path) == os.path.abspath(_CONFIG_PY):
+        names.add("_global_config")
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        v = node.value
+        if (isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+                and v.func.id == "global_config"):
+            names.add(node.targets[0].id)
+    return names
+
+
+def _config_op(node: ast.Call, receivers: Set[str]) -> str:
+    """The op name if this call is ``<config>.<op>(...)``, else ''."""
+    f = node.func
+    if not (isinstance(f, ast.Attribute) and f.attr in _OPS):
+        return ""
+    base = f.value
+    if isinstance(base, ast.Name) and base.id in receivers:
+        return f.attr
+    if (isinstance(base, ast.Call) and isinstance(base.func, ast.Name)
+            and base.func.id == "global_config"):
+        return f.attr
+    return ""
+
+
+def registrations(project=None
+                  ) -> Tuple[Dict[str, List[str]], List[Tuple[str, int]]]:
+    """``{key: [file:line, ...]}`` registrations plus non-literal
+    ``register`` sites."""
+    project = project or get_project()
+    regs: Dict[str, List[str]] = {}
+    bad: List[Tuple[str, int]] = []
+    for path in project.package_files():
+        tree = project.ast_for(path)
+        receivers = _config_receivers(tree, path)
+        rel = os.path.relpath(path, project.root)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _config_op(node, receivers) != "register":
+                continue
+            if (not node.args or not isinstance(node.args[0], ast.Constant)
+                    or not isinstance(node.args[0].value, str)):
+                bad.append((path, node.lineno))
+                continue
+            regs.setdefault(node.args[0].value, []).append(
+                f"{rel}:{node.lineno}")
+    return regs, bad
+
+
+def reads(project=None) -> Dict[str, List[str]]:
+    """``{key: [file:line, ...]}`` for literal get/set/unset sites across
+    the package and bench.py."""
+    project = project or get_project()
+    uses: Dict[str, List[str]] = {}
+    files = project.package_files()
+    if os.path.exists(project.bench_file()):
+        files = files + [project.bench_file()]
+    for path in files:
+        tree = project.ast_for(path)
+        receivers = _config_receivers(tree, path)
+        rel = os.path.relpath(path, project.root)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            op = _config_op(node, receivers)
+            if op in ("", "register"):
+                continue
+            if (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                uses.setdefault(node.args[0].value, []).append(
+                    f"{rel}:{node.lineno}")
+    return uses
+
+
+def documented_keys(project=None) -> Set[str]:
+    """Keys with a `` | `key` | `` table row in docs/configuration.md."""
+    project = project or get_project()
+    try:
+        with open(os.path.join(project.root, "docs", "configuration.md")) as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return set()
+    out: Set[str] = set()
+    for line in lines:
+        m = _DOC_ROW_RE.match(line.strip())
+        if m:
+            out.add(m.group(1))
+    return out
+
+
+def findings(project=None) -> List[Finding]:
+    project = project or get_project()
+    regs, bad = registrations(project)
+    uses = reads(project)
+    docs = documented_keys(project)
+    out: List[Finding] = []
+    for path, line in bad:
+        out.append(Finding(
+            path, line, ConfigKeysPass.id,
+            "config key registration must pass the key as one string "
+            "literal", "register with a literal 'section.name' key"))
+
+    def _loc(where: str) -> Tuple[str, int]:
+        rel, _, line = where.rpartition(":")
+        return os.path.join(project.root, rel), int(line)
+
+    for key, places in sorted(regs.items()):
+        path, line = _loc(places[0])
+        if len(places) > 1:
+            out.append(Finding(
+                path, line, ConfigKeysPass.id,
+                f"config key {key!r} registered at {len(places)} sites "
+                f"({', '.join(places)}); one key, one owning registration",
+                "keep a single registration per key"))
+        if not _KEY_RE.match(key):
+            out.append(Finding(
+                path, line, ConfigKeysPass.id,
+                f"config key {key!r} breaks the dotted 'section.name' "
+                f"convention (lower_snake segments, at least one dot) — "
+                f"env-var mapping needs it",
+                "rename to section.name"))
+        if key not in uses:
+            out.append(Finding(
+                path, line, ConfigKeysPass.id,
+                f"config key {key!r} is registered but never read — dead "
+                f"operator surface; setting it silently does nothing",
+                "consume the key or drop the registration"))
+        if key not in docs:
+            out.append(Finding(
+                path, line, ConfigKeysPass.id,
+                f"config key {key!r} has no row in docs/configuration.md",
+                "document every key an operator can set"))
+    for key, places in sorted(uses.items()):
+        if key in regs:
+            continue
+        path, line = _loc(places[0])
+        out.append(Finding(
+            path, line, ConfigKeysPass.id,
+            f"config key {key!r} read at {places[0]} but never registered "
+            f"— a typo'd key returns the miss default forever",
+            "register the key in common/config.py"))
+    doc_path = os.path.join(project.root, "docs", "configuration.md")
+    for key in sorted(docs - set(regs)):
+        out.append(Finding(
+            doc_path, 1, ConfigKeysPass.id,
+            f"docs/configuration.md documents {key!r} but no such key is "
+            f"registered — stale row",
+            "drop the row or restore the key"))
+    return out
+
+
+def check() -> List[str]:
+    """Human-readable violations; empty = clean."""
+    return [f.message for f in findings()]
+
+
+@register_pass
+class ConfigKeysPass(LintPass):
+    id = "config-keys"
+    title = "config-key registry literal/unique/consumed/documented ledger"
+    rationale = (
+        "the dotted-key registry is the operator API; unregistered reads, "
+        "dead keys and undocumented rows all fail silently at runtime")
+
+    def run(self, project: Project) -> List[Finding]:
+        return findings(project)
+
+
+def main() -> int:
+    problems = check()
+    if not problems:
+        print(f"config-key lint: clean ({len(registrations()[0])} keys, "
+              f"all literal, unique, consumed and documented)")
+        return 0
+    for p in problems:
+        print(p, file=sys.stderr)
+    return 1

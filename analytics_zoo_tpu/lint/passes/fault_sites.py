@@ -1,0 +1,182 @@
+"""zoolint pass ``fault-sites``: injection registry <-> call-site bijection.
+
+Ported from ``scripts/check_fault_sites.py`` (now a thin shim over this
+module). Chaos coverage rots silently: an injection site that no test arms
+is dead code wearing a safety vest, and a registry row whose call site was
+refactored away advertises protection that no longer exists. Rules:
+
+1. every ``faults.inject(...)`` call passes a string LITERAL (a computed
+   site name defeats both this lint and grep);
+2. every injected site name is registered in
+   ``analytics_zoo_tpu/common/faults.py``'s ``REGISTRY``;
+3. site names are UNIQUE across call sites — one site, one place (a name
+   shared by two call sites makes budgets/schedules ambiguous);
+4. every REGISTRY row has a live call site (no stale advertising);
+5. every site name appears in at least one file under ``tests/`` — i.e.
+   some test arms or asserts on it;
+6. every registered site is documented in ``docs/faults.md`` (the site
+   table is the operator's chaos-plan vocabulary).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Set, Tuple
+
+from ..core import (Finding, LintPass, Project, REPO_ROOT, get_project,
+                    register_pass)
+
+_PKG = os.path.join(REPO_ROOT, "analytics_zoo_tpu")
+_FAULTS_PY = os.path.join(_PKG, "common", "faults.py")
+_TESTS_DIR = os.path.join(REPO_ROOT, "tests")
+_DOCS_FAULTS = os.path.join(REPO_ROOT, "docs", "faults.md")
+
+
+def registry_sites(path: str = _FAULTS_PY) -> Set[str]:
+    """Site names from the REGISTRY dict literal (AST parse — import-free,
+    shared with the cached project index)."""
+    tree = get_project().ast_for(path)
+    for node in tree.body:
+        target = None
+        if isinstance(node, ast.AnnAssign):
+            target, value = node.target, node.value
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target, value = node.targets[0], node.value
+        if (isinstance(target, ast.Name) and target.id == "REGISTRY"
+                and isinstance(value, ast.Dict)):
+            for k in value.keys:
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    raise AssertionError(
+                        f"{path}: REGISTRY keys must be string literals")
+            return {k.value for k in value.keys}
+    raise AssertionError(f"{path}: no REGISTRY dict literal found")
+
+
+def _is_inject_call(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "inject"
+            and isinstance(f.value, ast.Name) and f.value.id == "faults")
+
+
+def inject_sites() -> Tuple[Dict[str, List[str]], List[Tuple[str, int, str]]]:
+    """``{site: [file:line, ...]}`` over all scanned files, plus
+    violations for non-literal site arguments."""
+    project = get_project()
+    calls: Dict[str, List[str]] = {}
+    bad: List[Tuple[str, int, str]] = []
+    files = project.package_files()
+    if os.path.exists(project.bench_file()):
+        files = files + [project.bench_file()]
+    for path in sorted(files):
+        tree = project.ast_for(path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and _is_inject_call(node)):
+                continue
+            where = f"{os.path.relpath(path, REPO_ROOT)}:{node.lineno}"
+            if (len(node.args) != 1
+                    or not isinstance(node.args[0], ast.Constant)
+                    or not isinstance(node.args[0].value, str)):
+                bad.append((path, node.lineno,
+                            "faults.inject() site must be one string "
+                            "literal"))
+                continue
+            calls.setdefault(node.args[0].value, []).append(where)
+    return calls, bad
+
+
+def tests_mentioning(site: str) -> List[str]:
+    out = []
+    for path in get_project().test_files():
+        if site in get_project().source(path).text:
+            out.append(os.path.basename(path))
+    return out
+
+
+def undocumented_sites(registered: Set[str]) -> List[str]:
+    """Registered sites with no `` `site` `` mention in docs/faults.md."""
+    try:
+        with open(_DOCS_FAULTS) as fh:
+            text = fh.read()
+    except OSError:
+        return sorted(registered)
+    return sorted(s for s in registered if f"`{s}`" not in text)
+
+
+def findings() -> List[Finding]:
+    registered = registry_sites()
+    calls, bad = inject_sites()
+    out: List[Finding] = []
+    for p, line, what in bad:
+        out.append(Finding(p, line, FaultSitesPass.id,
+                           f"{os.path.relpath(p, REPO_ROOT)}:{line}: {what}",
+                           "pass the site name as one string literal"))
+
+    def _site_loc(places: List[str]) -> Tuple[str, int]:
+        rel, _, line = places[0].rpartition(":")
+        return os.path.join(REPO_ROOT, rel), int(line)
+
+    for site, places in sorted(calls.items()):
+        path, line = _site_loc(places)
+        if site not in registered:
+            out.append(Finding(
+                path, line, FaultSitesPass.id,
+                f"site {site!r} injected at {places[0]} but not registered "
+                f"in common/faults.py REGISTRY",
+                "add a REGISTRY row (kind, description)"))
+        if len(places) > 1:
+            out.append(Finding(
+                path, line, FaultSitesPass.id,
+                f"site {site!r} injected from {len(places)} call sites "
+                f"({', '.join(places)}); site names must be unique",
+                "split into per-call-site names"))
+        if not tests_mentioning(site):
+            out.append(Finding(
+                path, line, FaultSitesPass.id,
+                f"site {site!r} is not exercised by any test under tests/ "
+                f"(arm it in a chaos test or drop the site)",
+                "arm the site in a chaos test"))
+    for site in sorted(registered - set(calls)):
+        out.append(Finding(
+            _FAULTS_PY, 1, FaultSitesPass.id,
+            f"REGISTRY advertises site {site!r} but no faults.inject("
+            f"{site!r}) call exists in the codebase",
+            "drop the stale row or restore the call site"))
+    for site in undocumented_sites(registered):
+        out.append(Finding(
+            _FAULTS_PY, 1, FaultSitesPass.id,
+            f"site {site!r} is registered but undocumented — add a row to "
+            f"the site table in docs/faults.md",
+            "document every chaos site an operator can arm"))
+    return out
+
+
+def check() -> List[str]:
+    """Human-readable violations; empty = clean."""
+    return [f.message for f in findings()]
+
+
+@register_pass
+class FaultSitesPass(LintPass):
+    id = "fault-sites"
+    title = "fault-injection registry/call-site/test/doc bijection"
+    rationale = (
+        "an injection site no test arms is dead code wearing a safety "
+        "vest; a registry row without a call site advertises protection "
+        "that no longer exists")
+
+    def run(self, project: Project) -> List[Finding]:
+        return findings()
+
+
+def main() -> int:
+    problems = check()
+    if not problems:
+        print(f"fault-site lint: clean "
+              f"({len(registry_sites())} sites, all registered, unique, "
+              f"test-exercised and documented)")
+        return 0
+    for p in problems:
+        print(p, file=sys.stderr)
+    return 1

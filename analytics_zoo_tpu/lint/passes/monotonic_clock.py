@@ -1,0 +1,106 @@
+"""zoolint pass ``monotonic-clock``: no ``time.time()`` in scheduling math.
+
+``time.time()`` is wall-clock: NTP slews and steps move it backwards or
+jump it forward, so any interval arithmetic built on it — retry windows,
+lease expiry, watchdog deadlines, latency measurement — misfires exactly
+when the fleet's clocks are being corrected, which on a multi-host TPU pod
+is routine. The rules:
+
+* **intervals and deadlines measured within one process** use
+  ``time.monotonic()`` (or ``perf_counter`` for micro-timing);
+* **stamps that cross process boundaries** (queue leases, request
+  ``enqueue_t``, ``health.json``, client-supplied deadlines) genuinely
+  need wall-clock — route them through
+  :func:`analytics_zoo_tpu.common.utils.wall_clock`, the single audited
+  call site, so intent is explicit and grep-able;
+* TensorBoard event ``wall_time`` is a file-format contract (waived
+  inline where it is written).
+
+The pass flags every ``time.time`` / ``time.time_ns`` call in the package
+(resolved through import aliases; tests and ``bench.py`` are out of
+scope — benches already use ``perf_counter``).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List
+
+from ..core import (Finding, LintPass, Project, REPO_ROOT, get_project,
+                    register_pass)
+
+_WALL = {"time.time", "time.time_ns"}
+
+
+def _import_map(tree: ast.Module) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name != "*":
+                    out[a.asname or a.name] = f"{node.module or ''}.{a.name}"
+    return out
+
+
+def _dotted(expr, imports: Dict[str, str]) -> str:
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if not isinstance(expr, ast.Name):
+        return ""
+    return ".".join([imports.get(expr.id, expr.id)]
+                    + list(reversed(parts)))
+
+
+def findings(project=None) -> List[Finding]:
+    project = project or get_project()
+    out: List[Finding] = []
+    for path in project.package_files():
+        tree = project.ast_for(path)
+        imports = _import_map(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func, imports)
+            if d in _WALL or d in ("time.time.time", "time.time.time_ns"):
+                out.append(Finding(
+                    path, node.lineno, MonotonicClockPass.id,
+                    f"{d}() is wall-clock — NTP steps break interval/"
+                    f"deadline arithmetic built on it",
+                    "use time.monotonic() for in-process intervals, or "
+                    "common.utils.wall_clock() for cross-process stamps"))
+    return out
+
+
+def check() -> List[str]:
+    """Human-readable violations; empty = clean."""
+    return [f.message for f in findings()]
+
+
+@register_pass
+class MonotonicClockPass(LintPass):
+    id = "monotonic-clock"
+    title = "wall-clock reads quarantined out of scheduling arithmetic"
+    rationale = (
+        "retry windows, leases and watchdogs built on time.time() "
+        "misfire exactly when NTP corrects a host — monotonic clocks for "
+        "intervals, one audited wall_clock() for cross-process stamps")
+
+    def run(self, project: Project) -> List[Finding]:
+        return findings(project)
+
+
+def main() -> int:
+    problems = check()
+    if not problems:
+        print("monotonic-clock lint: clean")
+        return 0
+    for p in problems:
+        print(p, file=sys.stderr)
+    return 1

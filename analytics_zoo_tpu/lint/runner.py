@@ -1,0 +1,59 @@
+"""zoolint CLI: ``python -m analytics_zoo_tpu.lint`` / ``zoolint``.
+
+Exit status is 0 when every selected pass is clean (including the
+built-in unused-suppression hygiene check), 1 when there are findings,
+2 on usage errors. ``--format github`` emits ``::error`` workflow
+annotations so CI surfaces findings on the touched lines.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .core import all_passes, get_project, run_passes
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="zoolint",
+        description="unified static analysis for analytics_zoo_tpu")
+    p.add_argument("--format", choices=("text", "github"), default="text",
+                   help="finding output style (default: text)")
+    p.add_argument("--pass", dest="passes", action="append", metavar="ID",
+                   help="run only this pass (repeatable; default: all)")
+    p.add_argument("--list", action="store_true",
+                   help="list registered passes and exit")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress the summary line")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    registry = all_passes()
+    if args.list:
+        width = max(len(i) for i in registry)
+        for pid in sorted(registry):
+            print(f"{pid:<{width}}  {registry[pid].title}")
+        return 0
+    try:
+        result = run_passes(get_project(), ids=args.passes)
+    except KeyError as e:
+        print(f"zoolint: {e.args[0]}", file=sys.stderr)
+        return 2
+    for f in result.findings:
+        print(f.github() if args.format == "github" else f.text())
+    if not args.quiet:
+        n = len(result.findings)
+        sup = len(result.suppressed)
+        ran = ", ".join(result.pass_ids)
+        status = "clean" if n == 0 else f"{n} finding(s)"
+        print(f"zoolint: {status} [{ran}]"
+              + (f" ({sup} suppressed)" if sup else ""),
+              file=sys.stderr)
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -936,7 +936,7 @@ def fused_short_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     seed = jnp.zeros((), jnp.int32)
     rate = 0.0
     if dropout_rate > 0.0 and dropout_rng is not None:
-        rate = float(dropout_rate)
+        rate = float(dropout_rate)  # zoolint: disable=jit-host-sync — static Python hyperparameter, not a tracer
         seed = jax.random.randint(dropout_rng, (), 0, 2 ** 31 - 1,
                                   dtype=jnp.int32)
     return _fused_short(q, k, v, key_bias, seed, scale, rate, causal)

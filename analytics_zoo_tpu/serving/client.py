@@ -15,6 +15,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ..common.utils import wall_clock
 from ..utils import trace as _trace
 from .queues import FileQueue, QueueBackend, encode_image, make_queue
 
@@ -30,7 +31,7 @@ class InputQueue(_API):
                deadline_ms: Optional[int]) -> Dict[str, Any]:
         # wall clock on purpose: enqueue_t crosses a process boundary, and
         # monotonic clocks do not compare across processes
-        payload["enqueue_t"] = time.time()
+        payload["enqueue_t"] = wall_clock()
         # every request carries a flow-chain id from birth: when a trace
         # session is active (here or on the server), the Perfetto timeline
         # draws enqueue→claim→decode→dispatch→result as one arrowed chain

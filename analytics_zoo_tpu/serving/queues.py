@@ -21,9 +21,9 @@ import base64
 import hashlib
 import json
 import os
-import time
 
 from ..common import file_io
+from ..common.utils import wall_clock
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -83,7 +83,7 @@ class FileQueue(QueueBackend):
             file_io.makedirs(d, exist_ok=True)
 
     def enqueue(self, uri: str, payload: Dict[str, Any]) -> None:
-        name = f"{time.time_ns():020d}-{uuid.uuid4().hex[:8]}.json"
+        name = f"{int(wall_clock() * 1e9):020d}-{uuid.uuid4().hex[:8]}.json"
         tmp = file_io.join(self.req_dir, "." + name)
         with file_io.fopen(tmp, "w") as f:
             f.write(json.dumps({"uri": uri, **payload}))
@@ -108,7 +108,7 @@ class FileQueue(QueueBackend):
         marker = file_io.join(self.claim_dir, name + ".claim")
         try:
             file_io.create_exclusive(
-                marker, repr(time.time()).encode())
+                marker, repr(wall_clock()).encode())
         except (FileExistsError, OSError):
             # marker held by another consumer — unless it's an expired
             # lease from a consumer that died between claim and cleanup.
@@ -140,13 +140,13 @@ class FileQueue(QueueBackend):
                     return None
 
             stamp = _read_stamp(marker)
-            if stamp is None or time.time() - stamp < self.claim_lease_s:
+            if stamp is None or wall_clock() - stamp < self.claim_lease_s:
                 return None
             reap_lock = marker + ".reap"
             # unique stamp doubles as an ownership token: the finally
             # below must not delete a lock some other consumer re-acquired
             # after OUR tenure was (legitimately) declared stale
-            lock_token = f"{time.time()!r}:{uuid.uuid4().hex}"
+            lock_token = f"{wall_clock()!r}:{uuid.uuid4().hex}"
             try:
                 file_io.create_exclusive(reap_lock, lock_token.encode())
             except (FileExistsError, OSError):
@@ -157,7 +157,7 @@ class FileQueue(QueueBackend):
                 # reader to stall >1 full lease between read and remove.
                 lock_stamp = _read_stamp(reap_lock)
                 if (lock_stamp is not None
-                        and time.time() - lock_stamp
+                        and wall_clock() - lock_stamp
                         >= 2 * self.claim_lease_s):
                     try:
                         file_io.remove(reap_lock)
@@ -170,7 +170,7 @@ class FileQueue(QueueBackend):
                 # and the lock acquisition — its fresh claim must survive
                 stamp = _read_stamp(marker)
                 if stamp is None or \
-                        time.time() - stamp < self.claim_lease_s:
+                        wall_clock() - stamp < self.claim_lease_s:
                     return None
                 try:
                     file_io.remove(marker)
@@ -181,7 +181,7 @@ class FileQueue(QueueBackend):
                 # create fails: exactly one winner either way
                 try:
                     file_io.create_exclusive(
-                        marker, repr(time.time()).encode())
+                        marker, repr(wall_clock()).encode())
                 except (FileExistsError, OSError):
                     return None
             finally:

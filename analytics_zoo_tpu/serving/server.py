@@ -32,7 +32,7 @@ import numpy as np
 from ..common import faults, file_io
 from ..common import metrics as _metrics
 from ..common import profiler as _profiler
-from ..common.utils import time_it
+from ..common.utils import time_it, wall_clock
 from ..inference.inference_model import InferenceModel
 from ..utils import trace as _trace
 from .config import ServingConfig
@@ -295,7 +295,7 @@ class ClusterServing:
         if not deadline_ms:
             return None
         t0 = rec.get("enqueue_t")
-        base = float(t0) if t0 is not None else time.time()
+        base = float(t0) if t0 is not None else wall_clock()
         return base + float(deadline_ms) / 1000.0
 
     def _post_terminal(self, uri: str, value: Dict[str, Any]) -> None:
@@ -312,7 +312,7 @@ class ClusterServing:
         self._m_in_flight.set(in_flight)
         if meta is not None:
             t0, flow_id = meta
-            self._m_latency.observe(max(time.time() - t0, 0.0))
+            self._m_latency.observe(max(wall_clock() - t0, 0.0))
             # flow terminus: the request's lifecycle chain ends here
             _trace.flow_point(flow_id, "serving.result", "f")
 
@@ -390,7 +390,7 @@ class ClusterServing:
             else:
                 time.sleep(0.001)
         if batch:
-            now = time.time()
+            now = wall_clock()
             with self._counter_lock:
                 self._in_flight += len(batch)
                 in_flight = self._in_flight
@@ -410,7 +410,7 @@ class ClusterServing:
         deadline error immediately — no decode, no device time."""
         if not batch:
             return batch
-        now = time.time()
+        now = wall_clock()
         live, expired = [], []
         for uri, rec in batch:
             exp = self._expiry(rec)
@@ -444,7 +444,7 @@ class ClusterServing:
                     _trace.flow_point(rec.get("trace_id"),
                                       "serving.decode", "t")
                 exp = self._expiry(rec)
-                if exp is not None and time.time() >= exp:
+                if exp is not None and wall_clock() >= exp:
                     expired.append(uri)
                     continue
                 uris.append(uri)
@@ -463,7 +463,7 @@ class ClusterServing:
                                 expiries: List[Optional[float]]):
         """Last deadline check, right before device dispatch — queueing
         inside the pipeline must not launder expired work onto the chip."""
-        now = time.time()
+        now = wall_clock()
         keep = [i for i, e in enumerate(expiries) if e is None or now < e]
         if len(keep) == len(uris):
             return uris, x
@@ -588,7 +588,7 @@ class ClusterServing:
             self._m_claim_age.set(claim_age)
         return {
             "state": state,
-            "time": time.time(),
+            "time": wall_clock(),
             "queue_pending": pending,
             "in_flight": in_flight,
             "records_served": self.records_served,
@@ -1254,7 +1254,7 @@ class GenerativeServing:
         if not deadline_ms:
             return None
         t0 = rec.get("enqueue_t")
-        base = float(t0) if t0 is not None else time.time()
+        base = float(t0) if t0 is not None else wall_clock()
         return base + float(deadline_ms) / 1000.0
 
     def _post_terminal(self, uri: str, value: Dict[str, Any]) -> None:
@@ -1271,7 +1271,7 @@ class GenerativeServing:
         self._m_in_flight.set(in_flight)
         if meta is not None:
             t0, flow_id = meta
-            self._m_latency.observe(max(time.time() - t0, 0.0))
+            self._m_latency.observe(max(wall_clock() - t0, 0.0))
             _trace.flow_point(flow_id, "serving.result", "f")
 
     def _retire(self, slot: int, value: Dict[str, Any],
@@ -1609,7 +1609,7 @@ class GenerativeServing:
         if not got:
             return
         self._last_claim_m = time.monotonic()
-        now = time.time()
+        now = wall_clock()
         with self._counter_lock:
             self._in_flight += len(got)
             in_flight = self._in_flight
@@ -1631,7 +1631,7 @@ class GenerativeServing:
         """Per-token deadline check: an expired stream is evicted
         MID-FLIGHT — its one terminal result is the deadline error (the
         partials it already streamed are not terminals)."""
-        now = time.time()
+        now = wall_clock()
         mask = np.zeros(self.slots, bool)
         for i in range(self.slots):
             if (self._active_host[i] and self._expires[i] is not None
@@ -1654,7 +1654,7 @@ class GenerativeServing:
         """Fold one step's tokens into every active stream: TTFT on the
         first token, partial results every ``stream_interval`` tokens,
         terminal value + evict on eos / budget exhaustion."""
-        now = time.time()
+        now = wall_clock()
         cfg = self.config
         finished = np.zeros(self.slots, bool)
         n_tok = 0
@@ -1697,7 +1697,7 @@ class GenerativeServing:
         clamp and eos truncation are host-side; a stream they cut short is
         retired in the same pass, so the device's over-advanced length
         never feeds another step."""
-        now = time.time()
+        now = wall_clock()
         cfg = self.config
         finished = np.zeros(self.slots, bool)
         n_tok = 0
@@ -1911,7 +1911,7 @@ class GenerativeServing:
             self._m_claim_age.set(claim_age)
         return {
             "state": state,
-            "time": time.time(),
+            "time": wall_clock(),
             "queue_pending": pending,
             "in_flight": in_flight,
             "slots": self.slots,

@@ -18,10 +18,10 @@ import queue
 import socket
 import struct
 import threading
-import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..common import file_io
+from ..common.utils import wall_clock
 
 # ---------------------------------------------------------------------------
 # CRC32C (Castagnoli), table-driven, pure python.
@@ -86,7 +86,7 @@ def _bytes_field(field: int, value: bytes) -> bytes:
 def encode_scalar_event(tag: str, value: float, step: int,
                         wall_time: Optional[float] = None) -> bytes:
     if wall_time is None:
-        wall_time = time.time()
+        wall_time = wall_clock()
     value_msg = _bytes_field(1, tag.encode("utf-8")) + _f32(2, float(value))
     summary_msg = _bytes_field(1, value_msg)
     return _f64(1, wall_time) + _i64(2, step) + _bytes_field(5, summary_msg)
@@ -94,7 +94,7 @@ def encode_scalar_event(tag: str, value: float, step: int,
 
 def encode_file_version_event(wall_time: Optional[float] = None) -> bytes:
     if wall_time is None:
-        wall_time = time.time()
+        wall_time = wall_clock()
     return _f64(1, wall_time) + _bytes_field(3, b"brain.Event:2")
 
 
@@ -206,7 +206,7 @@ class SummaryWriter:
         # pid suffix: two writers on one host in the same second (crash-loop
         # restarts) must not collide — remote fopen refuses to append to an
         # existing object
-        fname = (f"events.out.tfevents.{int(time.time())}."
+        fname = (f"events.out.tfevents.{int(wall_clock())}."
                  f"{socket.gethostname()}.{os.getpid()}")
         self.path = file_io.join(logdir, fname)
         self._queue: "queue.Queue[Optional[bytes]]" = queue.Queue()

@@ -1,169 +1,17 @@
 #!/usr/bin/env python
-"""Tier-1 lint: the fault-injection site registry and the ``faults.inject``
-call sites must stay in bijection, and every site must be exercised.
-
-Chaos coverage rots silently: an injection site that no test arms is dead
-code wearing a safety vest, and a registry row whose call site was
-refactored away advertises protection that no longer exists. This check
-fails the test run at collection time (``tests/test_fault_sites_lint.py``)
-when any of the following drifts:
-
-1. every ``faults.inject(...)`` call passes a string LITERAL (a computed
-   site name defeats both this lint and grep);
-2. every injected site name is registered in
-   ``analytics_zoo_tpu/common/faults.py``'s ``REGISTRY``;
-3. site names are UNIQUE across call sites — one site, one place (a name
-   shared by two call sites makes budgets/schedules ambiguous);
-4. every REGISTRY row has a live call site (no stale advertising);
-5. every site name appears in at least one file under ``tests/`` — i.e.
-   some test arms or asserts on it;
-6. every registered site is documented in ``docs/faults.md`` (the site
-   table is the operator's chaos-plan vocabulary — an undocumented site
-   is invisible to whoever writes ``faults.plan`` schedules).
+"""Thin shim: the fault-site checker now lives in
+``analytics_zoo_tpu.lint.passes.fault_sites`` (zoolint pass
+``fault-sites``). Kept so existing invocations and tests keep working;
+prefer ``python -m analytics_zoo_tpu.lint --pass fault-sites``.
 """
-from __future__ import annotations
-
-import ast
 import os
 import sys
-from typing import Dict, List, Set, Tuple
 
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_PKG = os.path.join(_REPO, "analytics_zoo_tpu")
-_FAULTS_PY = os.path.join(_PKG, "common", "faults.py")
-_TESTS_DIR = os.path.join(_REPO, "tests")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-#: files scanned for inject() calls: the whole package + the bench driver
-_SCAN_ROOTS = (_PKG, os.path.join(_REPO, "bench.py"))
-
-
-def registry_sites(path: str = _FAULTS_PY) -> Set[str]:
-    """Site names from the REGISTRY dict literal (AST parse — no package
-    import, so the lint runs without jax in a bare interpreter)."""
-    with open(path) as fh:
-        tree = ast.parse(fh.read(), filename=path)
-    for node in tree.body:
-        target = None
-        if isinstance(node, ast.AnnAssign):
-            target, value = node.target, node.value
-        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
-            target, value = node.targets[0], node.value
-        if (isinstance(target, ast.Name) and target.id == "REGISTRY"
-                and isinstance(value, ast.Dict)):
-            keys = set()
-            for k in value.keys:
-                if not (isinstance(k, ast.Constant)
-                        and isinstance(k.value, str)):
-                    raise AssertionError(
-                        f"{path}: REGISTRY keys must be string literals")
-            return {k.value for k in value.keys}
-    raise AssertionError(f"{path}: no REGISTRY dict literal found")
-
-
-def _is_inject_call(node: ast.Call) -> bool:
-    f = node.func
-    return (isinstance(f, ast.Attribute) and f.attr == "inject"
-            and isinstance(f.value, ast.Name) and f.value.id == "faults")
-
-
-def inject_sites() -> Tuple[Dict[str, List[str]], List[Tuple[str, int, str]]]:
-    """``{site: [file:line, ...]}`` over all scanned files, plus
-    violations for non-literal site arguments."""
-    calls: Dict[str, List[str]] = {}
-    bad: List[Tuple[str, int, str]] = []
-    files: List[str] = []
-    for root in _SCAN_ROOTS:
-        if os.path.isfile(root):
-            files.append(root)
-            continue
-        for dirpath, _dirs, names in os.walk(root):
-            if "__pycache__" in dirpath:
-                continue
-            files.extend(os.path.join(dirpath, n) for n in names
-                         if n.endswith(".py"))
-    for path in sorted(files):
-        with open(path) as fh:
-            tree = ast.parse(fh.read(), filename=path)
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call) and _is_inject_call(node)):
-                continue
-            where = f"{os.path.relpath(path, _REPO)}:{node.lineno}"
-            if (len(node.args) != 1
-                    or not isinstance(node.args[0], ast.Constant)
-                    or not isinstance(node.args[0].value, str)):
-                bad.append((path, node.lineno,
-                            "faults.inject() site must be one string "
-                            "literal"))
-                continue
-            calls.setdefault(node.args[0].value, []).append(where)
-    return calls, bad
-
-
-def tests_mentioning(site: str) -> List[str]:
-    out = []
-    for name in sorted(os.listdir(_TESTS_DIR)):
-        if not name.endswith(".py"):
-            continue
-        with open(os.path.join(_TESTS_DIR, name)) as fh:
-            if site in fh.read():
-                out.append(name)
-    return out
-
-
-_DOCS_FAULTS = os.path.join(_REPO, "docs", "faults.md")
-
-
-def undocumented_sites(registered: Set[str]) -> List[str]:
-    """Registered sites with no `` `site` `` mention in docs/faults.md."""
-    try:
-        with open(_DOCS_FAULTS) as fh:
-            text = fh.read()
-    except OSError:
-        return sorted(registered)
-    return sorted(s for s in registered if f"`{s}`" not in text)
-
-
-def check() -> List[str]:
-    """Human-readable violations; empty = clean."""
-    registered = registry_sites()
-    calls, bad = inject_sites()
-    problems = [f"{os.path.relpath(p, _REPO)}:{line}: {what}"
-                for p, line, what in bad]
-    for site, places in sorted(calls.items()):
-        if site not in registered:
-            problems.append(
-                f"site {site!r} injected at {places[0]} but not registered "
-                f"in common/faults.py REGISTRY")
-        if len(places) > 1:
-            problems.append(
-                f"site {site!r} injected from {len(places)} call sites "
-                f"({', '.join(places)}); site names must be unique")
-        if not tests_mentioning(site):
-            problems.append(
-                f"site {site!r} is not exercised by any test under tests/ "
-                f"(arm it in a chaos test or drop the site)")
-    for site in sorted(registered - set(calls)):
-        problems.append(
-            f"REGISTRY advertises site {site!r} but no faults.inject("
-            f"{site!r}) call exists in the codebase")
-    for site in undocumented_sites(registered):
-        problems.append(
-            f"site {site!r} is registered but undocumented — add a row to "
-            f"the site table in docs/faults.md")
-    return problems
-
-
-def main() -> int:
-    problems = check()
-    if not problems:
-        print(f"fault-site lint: clean "
-              f"({len(registry_sites())} sites, all registered, unique, "
-              f"test-exercised and documented)")
-        return 0
-    for p in problems:
-        print(p, file=sys.stderr)
-    return 1
-
+from analytics_zoo_tpu.lint.passes.fault_sites import (  # noqa: E402,F401
+    _is_inject_call, check, findings, inject_sites, main, registry_sites,
+    tests_mentioning, undocumented_sites)
 
 if __name__ == "__main__":
-    sys.exit(main())
+    raise SystemExit(main())

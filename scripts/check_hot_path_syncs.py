@@ -1,234 +1,19 @@
 #!/usr/bin/env python
-"""Tier-1 lint: the data-plane and eval/predict hot paths must stay free of
-per-batch host↔device syncs and per-batch/per-record Python regressions.
-
-Three families of policed regressions, each of which re-serializes work the
-async redesigns deliberately overlapped — nothing functional breaks when
-they creep back in, so only a BENCH round would notice. This check fails
-the test run at collection time instead (``tests/test_hot_path_lint.py``).
-
-1. **Estimator dispatch loops** (``analytics_zoo_tpu/estimator/
-   estimator.py``: ``evaluate``/``_evaluate_direct``/
-   ``_evaluate_direct_exact``/``predict`` loop bodies): no blocking
-   ``float(...)``, ``np.asarray(...)``, ``jax.device_get(...)``,
-   ``.block_until_ready()`` — batches stream through the DeviceFeed,
-   accumulation stays on device, the pass drains once after the loop.
-   The synchronous fallbacks in ``estimator/sync_eval.py`` are
-   deliberately NOT policed — they exist to be the parity reference.
-
-2. **FeatureSet batch staging** (``feature/featureset.py``):
-   ``FeatureSet._gather`` is the innermost per-batch hot function — no
-   device syncs, no per-record Python loops (it must stay a pure tree-map
-   of vectorized ``np.take`` gathers), and no ``np.asarray`` copies (the
-   zero-alloc redesign routes copies through ``np.take(..., out=...)``).
-   The lazy data plane's iterator cores are policed for device syncs too.
-
-3. **DeviceFeed eval adaptation** (``feature/device_feed.py``):
-   ``masked_eval_batches`` must not rebuild its ``np.arange`` mask per
-   batch (cached-mask fix), and the ``_produce`` producer loop must never
-   sync.
-
-4. **Sharded-embedding exchange bodies** (``parallel/embedding.py``:
-   ``_routing``/``_lookup_body``/``_lookup_bwd_body``/``_update_body``,
-   the shard_map-traced lookup/grad/update path): no host syncs, no
-   per-row Python loops (everything stays a vectorized
-   unique/all-to-all/segment-sum pipeline), and no ``one_hot`` calls —
-   a one-hot matmul densifies the [vocab, dim] gradient the segment-sum
-   backward exists to avoid. The ``one_hot`` ban applies to every
-   policed function above, not just the embedding bodies.
-
-5. **Generative decode step loop** (continuous-batching scheduler): the
-   slot-cache ops (``ops/decode.py``: ``init_slot_cache``/``slot_join``/
-   ``slot_evict``/``slot_insert``/``slot_attention``) and the
-   scheduler's device hot path (``serving/server.py GenerativeServing``:
-   ``_dispatch_step``/``_insert_request_device``/``_evict_slots``) must
-   stay pure vectorized jitted dispatches — no host syncs, no per-slot
-   Python loops, no per-token shape changes (a recompile per token is
-   the regression the fixed-shape slot cache exists to prevent). The
-   ``TransformerLM`` step fns (``capture/lm.py``: ``slot_step``/
-   ``prefill_kv``) are policed for syncs only — their per-BLOCK loop is
-   constant-trip tracing, not per-record work. The scheduler's single
-   host fetch per step lives in the deliberately-unpoliced
-   ``_fetch_tokens``.
-
-6. **Paged KV + speculative decode bodies**: the page gather/scatter ops
-   (``ops/decode.py``: ``init_paged_pool``/``page_table_set``/
-   ``page_table_clear``/``page_copy``/``_page_positions``/
-   ``_paged_write``/``paged_gather``/``paged_insert``/``paged_attention``/
-   ``paged_verify_attention`` and the speculative accept rules
-   ``spec_accept_greedy``/``_spec_accept_sampled``) must stay pure
-   vectorized advanced-indexing scatters/gathers — no host syncs, no
-   per-PAGE Python loops (a loop over table columns re-serializes the
-   gather the pool exists to batch), no ``one_hot`` densification of
-   page ids. The ``TransformerLM`` draft/verify step fns
-   (``capture/lm.py``: ``paged_slot_step``/``verify_step``/
-   ``prefill_kv_suffix``) and the scheduler's paged device methods
-   (``serving/server.py``: ``_insert_request_paged``/
-   ``_insert_request_spec``/``_insert_suffix_paged``/
-   ``_copy_page_device``) are policed like their contiguous twins —
-   syncs banned everywhere, with the constant-trip per-BLOCK loop
-   exemption for the lm step fns only.
+"""Thin shim: the hot-path sync checker now lives in
+``analytics_zoo_tpu.lint.passes.hot_path`` (zoolint pass
+``hot-path-sync``). Kept so existing invocations and tests keep working;
+prefer ``python -m analytics_zoo_tpu.lint --pass hot-path-sync``.
 """
-from __future__ import annotations
-
-import ast
 import os
 import sys
-from typing import Dict, List, Optional, Sequence, Tuple
 
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-ESTIMATOR_PY = os.path.join(_REPO, "analytics_zoo_tpu", "estimator",
-                            "estimator.py")
-FEATURESET_PY = os.path.join(_REPO, "analytics_zoo_tpu", "feature",
-                             "featureset.py")
-DEVICE_FEED_PY = os.path.join(_REPO, "analytics_zoo_tpu", "feature",
-                              "device_feed.py")
-EMBEDDING_PY = os.path.join(_REPO, "analytics_zoo_tpu", "parallel",
-                            "embedding.py")
-DECODE_PY = os.path.join(_REPO, "analytics_zoo_tpu", "ops", "decode.py")
-LM_PY = os.path.join(_REPO, "analytics_zoo_tpu", "capture", "lm.py")
-SERVER_PY = os.path.join(_REPO, "analytics_zoo_tpu", "serving", "server.py")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-EMBED_BODIES = ("_routing", "_lookup_body", "_lookup_bwd_body",
-                "_update_body")
-
-SLOT_OPS = ("init_slot_cache", "slot_join", "slot_evict", "slot_insert",
-            "slot_attention")
-
-PAGED_OPS = ("init_paged_pool", "page_table_set", "page_table_clear",
-             "page_copy", "_page_positions", "_paged_write", "paged_gather",
-             "paged_insert", "paged_attention", "paged_verify_attention",
-             "spec_accept_greedy", "_spec_accept_sampled")
-
-HOT_FUNCS = ("evaluate", "_evaluate_direct", "_evaluate_direct_exact",
-             "predict")
-
-#: policy rows: (path, class name or None for module level, function names,
-#: extra banned np.<attr> calls, ban per-record loops?, scope)
-#: scope "loops" = only loop bodies inside the function are policed;
-#: scope "body"  = the whole function body is policed (innermost hot funcs)
-_CHECKS: List[Tuple[str, Optional[str], Sequence[str], Sequence[str],
-                    bool, str]] = [
-    (ESTIMATOR_PY, "Estimator", HOT_FUNCS, (), False, "loops"),
-    (FEATURESET_PY, "FeatureSet", ("_gather",), ("asarray",), True, "body"),
-    (FEATURESET_PY, "LazyTransformFeatureSet",
-     ("train_iterator", "eval_iterator", "_transformed_batches",
-      "_cached_batches"), (), False, "loops"),
-    (DEVICE_FEED_PY, None, ("masked_eval_batches",), ("arange",), False,
-     "loops"),
-    (DEVICE_FEED_PY, None, ("_produce",), (), False, "loops"),
-    (EMBEDDING_PY, None, EMBED_BODIES, (), True, "body"),
-    (DECODE_PY, None, SLOT_OPS, (), True, "body"),
-    (DECODE_PY, None, PAGED_OPS, (), True, "body"),
-    (LM_PY, "TransformerLM",
-     ("slot_step", "prefill_kv", "paged_slot_step", "verify_step",
-      "prefill_kv_suffix"), (), False, "body"),
-    (SERVER_PY, "GenerativeServing",
-     ("_dispatch_step", "_insert_request_device", "_insert_request_paged",
-      "_insert_request_spec", "_insert_suffix_paged", "_copy_page_device",
-      "_evict_slots"), (), True, "body"),
-]
-
-
-def _banned_call(node: ast.Call, np_attrs: Sequence[str] = ("asarray",)
-                 ) -> str:
-    f = node.func
-    if isinstance(f, ast.Name) and f.id == "float":
-        return "float()"
-    if isinstance(f, ast.Name) and f.id == "one_hot":
-        return "one_hot()"
-    if isinstance(f, ast.Attribute):
-        if f.attr == "one_hot":
-            return "one_hot()"
-        base = f.value
-        if (f.attr in np_attrs and isinstance(base, ast.Name)
-                and base.id in ("np", "numpy")):
-            return f"{base.id}.{f.attr}()"
-        if (f.attr == "device_get" and isinstance(base, ast.Name)
-                and base.id == "jax"):
-            return "jax.device_get()"
-        if f.attr == "block_until_ready":
-            return ".block_until_ready()"
-    return ""
-
-
-def _iter_functions(tree: ast.Module, cls: Optional[str],
-                    names: Sequence[str]):
-    if cls is None:
-        for node in tree.body:
-            if isinstance(node, ast.FunctionDef) and node.name in names:
-                yield node
-        return
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef) and node.name == cls:
-            for fn in node.body:
-                if isinstance(fn, ast.FunctionDef) and fn.name in names:
-                    yield fn
-
-
-def _scan_stmts(stmts, np_attrs, out, fn_name):
-    for stmt in stmts:
-        for sub in ast.walk(stmt):
-            if isinstance(sub, ast.Call):
-                what = _banned_call(sub, np_attrs)
-                if what:
-                    out.append((fn_name, sub.lineno, what))
-
-
-def _check_file(path: str, cls: Optional[str], names: Sequence[str],
-                extra_np: Sequence[str], ban_loops: bool, scope: str
-                ) -> List[Tuple[str, int, str]]:
-    with open(path) as fh:
-        tree = ast.parse(fh.read(), filename=path)
-    np_attrs = ("asarray",) + tuple(extra_np)
-    violations: List[Tuple[str, int, str]] = []
-    for fn in _iter_functions(tree, cls, names):
-        if scope == "body":
-            _scan_stmts(fn.body, np_attrs, violations, fn.name)
-            if ban_loops:
-                for sub in ast.walk(fn):
-                    if isinstance(sub, (ast.For, ast.While, ast.AsyncFor,
-                                        ast.ListComp, ast.SetComp,
-                                        ast.DictComp, ast.GeneratorExp)):
-                        violations.append(
-                            (fn.name, sub.lineno, "per-record Python loop"))
-            continue
-        for loop in ast.walk(fn):
-            if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
-                continue
-            _scan_stmts(loop.body + loop.orelse, np_attrs, violations,
-                        fn.name)
-    return violations
-
-
-def check(path: Optional[str] = None
-          ) -> List[Tuple[str, str, int, str]]:
-    """Return ``(file, function, line, what)`` violations; empty = clean.
-    With an explicit ``path`` only the Estimator dispatch-loop policy runs
-    against that file (self-test hook)."""
-    if path is not None:
-        return [(path, fn, line, what) for fn, line, what in
-                _check_file(path, "Estimator", HOT_FUNCS, (), False,
-                            "loops")]
-    out: List[Tuple[str, str, int, str]] = []
-    for (p, cls, names, extra_np, ban_loops, scope) in _CHECKS:
-        out.extend((p, fn, line, what) for fn, line, what in
-                   _check_file(p, cls, names, extra_np, ban_loops, scope))
-    return out
-
-
-def main() -> int:
-    violations = check()
-    if not violations:
-        print("hot-path sync lint: clean")
-        return 0
-    for path, fn, line, what in violations:
-        print(f"{path}:{line}: {what} inside the hot path of {fn} — "
-              f"route syncs behind the dispatch frontier / drain after "
-              f"the loop, and keep per-batch staging vectorized",
-              file=sys.stderr)
-    return 1
-
+from analytics_zoo_tpu.lint.passes.hot_path import (  # noqa: E402,F401
+    DECODE_PY, DEVICE_FEED_PY, EMBED_BODIES, EMBEDDING_PY, ESTIMATOR_PY,
+    FEATURESET_PY, HOT_FUNCS, LM_PY, PAGED_OPS, SERVER_PY, SLOT_OPS,
+    _CHECKS, _banned_call, _check_file, _iter_functions, _scan_stmts,
+    check, main, policed_functions)
 
 if __name__ == "__main__":
-    sys.exit(main())
+    raise SystemExit(main())

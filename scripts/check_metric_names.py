@@ -1,156 +1,18 @@
 #!/usr/bin/env python
-"""Tier-1 lint: the metrics registry's names must stay canonical.
-
-The telemetry plane (``analytics_zoo_tpu/common/metrics.py``) only stays
-queryable if names don't rot: a metric registered twice makes dashboards
-ambiguous, an off-convention name breaks every ``subsystem.*`` query, and
-an undocumented metric is invisible to whoever writes the alerts. Mirrors
-``check_fault_sites.py``: fails the test run at collection time
-(``tests/test_metric_names_lint.py``) when any of the following drifts:
-
-1. every registration call (``metrics.counter(...)`` / ``.gauge(...)`` /
-   ``.histogram(...)`` on a metrics-module alias) passes a string LITERAL
-   name (a computed name defeats both this lint and grep);
-2. every metric name is registered exactly ONCE across the codebase — one
-   name, one owning module (re-registration elsewhere would silently
-   alias series);
-3. names follow the ``subsystem.noun_unit`` convention
-   (lower_snake, one dot), counters end in ``_total``, histograms in
-   ``_seconds`` (all our histograms observe durations), and gauges carry
-   a unit suffix (``_seconds``/``_bytes``/``_ratio``/``_depth``) unless
-   allow-listed as genuinely unitless (``serving.in_flight`` counts,
-   ``build.info`` is an info-style constant-1 gauge);
-4. every registered metric is documented in ``docs/observability.md``
-   (the metric table is the operator's scrape vocabulary).
+"""Thin shim: the metric-name checker now lives in
+``analytics_zoo_tpu.lint.passes.metric_names`` (zoolint pass
+``metric-names``). Kept so existing invocations and tests keep working;
+prefer ``python -m analytics_zoo_tpu.lint --pass metric-names``.
 """
-from __future__ import annotations
-
-import ast
 import os
-import re
 import sys
-from typing import Dict, List, Tuple
 
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_PKG = os.path.join(_REPO, "analytics_zoo_tpu")
-_DOCS = os.path.join(_REPO, "docs", "observability.md")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-#: files scanned for registration calls: the whole package + the bench
-#: driver; common/metrics.py itself is excluded (its internal plumbing
-#: calls the same method names on ``self``/fresh registries)
-_SCAN_ROOTS = (_PKG, os.path.join(_REPO, "bench.py"))
-_EXCLUDE = (os.path.join("common", "metrics.py"),)
-
-_KINDS = ("counter", "gauge", "histogram")
-_NAME_RE = re.compile(r"^[a-z][a-z0-9]*\.[a-z][a-z0-9_]*$")
-_UNIT_SUFFIX = {"counter": "_total", "histogram": "_seconds"}
-
-#: gauges must say what they measure; any of these suffixes qualifies
-_GAUGE_UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio", "_depth")
-#: gauges that are genuinely unitless: live request/slot counts and the
-#: info-style constant-1 build gauge (labels carry the payload)
-_GAUGE_UNITLESS_OK = {"serving.in_flight", "serving.slots_occupied",
-                      "serving.kv_pages_free", "build.info"}
-
-
-def _is_registration(node: ast.Call) -> bool:
-    f = node.func
-    return (isinstance(f, ast.Attribute) and f.attr in _KINDS
-            and isinstance(f.value, ast.Name)
-            and (f.value.id == "metrics" or f.value.id.endswith("_metrics")))
-
-
-def registrations() -> Tuple[Dict[str, List[Tuple[str, str]]],
-                             List[Tuple[str, int, str]]]:
-    """``{name: [(file:line, kind), ...]}`` over all scanned files, plus
-    violations for non-literal name arguments."""
-    regs: Dict[str, List[Tuple[str, str]]] = {}
-    bad: List[Tuple[str, int, str]] = []
-    files: List[str] = []
-    for root in _SCAN_ROOTS:
-        if os.path.isfile(root):
-            files.append(root)
-            continue
-        for dirpath, _dirs, names in os.walk(root):
-            if "__pycache__" in dirpath:
-                continue
-            files.extend(os.path.join(dirpath, n) for n in names
-                         if n.endswith(".py"))
-    for path in sorted(files):
-        rel = os.path.relpath(path, _REPO)
-        if any(rel.endswith(e) for e in _EXCLUDE):
-            continue
-        with open(path) as fh:
-            tree = ast.parse(fh.read(), filename=path)
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call) and _is_registration(node)):
-                continue
-            where = f"{rel}:{node.lineno}"
-            if (not node.args
-                    or not isinstance(node.args[0], ast.Constant)
-                    or not isinstance(node.args[0].value, str)):
-                bad.append((path, node.lineno,
-                            "metric name must be one string literal"))
-                continue
-            regs.setdefault(node.args[0].value, []).append(
-                (where, node.func.attr))
-    return regs, bad
-
-
-def undocumented(names) -> List[str]:
-    """Registered names with no `` `name` `` mention in the metric docs."""
-    try:
-        with open(_DOCS) as fh:
-            text = fh.read()
-    except OSError:
-        return sorted(names)
-    return sorted(n for n in names if f"`{n}`" not in text)
-
-
-def check() -> List[str]:
-    """Human-readable violations; empty = clean."""
-    regs, bad = registrations()
-    problems = [f"{os.path.relpath(p, _REPO)}:{line}: {what}"
-                for p, line, what in bad]
-    for name, places in sorted(regs.items()):
-        if len(places) > 1:
-            problems.append(
-                f"metric {name!r} registered at {len(places)} sites "
-                f"({', '.join(w for w, _ in places)}); each name must be "
-                f"registered exactly once")
-        kind = places[0][1]
-        if not _NAME_RE.match(name):
-            problems.append(
-                f"metric {name!r} ({places[0][0]}) breaks the "
-                f"'subsystem.noun_unit' convention (lower_snake, one dot)")
-        suffix = _UNIT_SUFFIX.get(kind)
-        if suffix and not name.endswith(suffix):
-            problems.append(
-                f"{kind} {name!r} ({places[0][0]}) must end in "
-                f"'{suffix}'")
-        if (kind == "gauge" and name not in _GAUGE_UNITLESS_OK
-                and not name.endswith(_GAUGE_UNIT_SUFFIXES)):
-            problems.append(
-                f"gauge {name!r} ({places[0][0]}) must end in one of "
-                f"{'/'.join(_GAUGE_UNIT_SUFFIXES)} or be allow-listed in "
-                f"_GAUGE_UNITLESS_OK")
-    for name in undocumented(regs):
-        problems.append(
-            f"metric {name!r} is registered but undocumented — add a row "
-            f"to the metric table in docs/observability.md")
-    return problems
-
-
-def main() -> int:
-    problems = check()
-    if not problems:
-        print(f"metric-name lint: clean ({len(registrations()[0])} metrics,"
-              f" all literal, unique, canonical and documented)")
-        return 0
-    for p in problems:
-        print(p, file=sys.stderr)
-    return 1
-
+from analytics_zoo_tpu.lint.passes.metric_names import (  # noqa: E402,F401
+    _EXCLUDE, _GAUGE_UNIT_SUFFIXES, _GAUGE_UNITLESS_OK, _KINDS, _NAME_RE,
+    _UNIT_SUFFIX, _is_registration, check, findings, main, registrations,
+    undocumented)
 
 if __name__ == "__main__":
-    sys.exit(main())
+    raise SystemExit(main())
