@@ -245,6 +245,28 @@ _global_config.register("embed.sparse_updates", True,
                         "and their optimizer state lives outside the dense "
                         "optax tree. False funnels embedding grads through "
                         "the dense optimizer like any other parameter.")
+_global_config.register("data.handoff", "slab",
+                        "XShard → FeatureSet lowering path: 'slab' has "
+                        "ETL workers write partition rows straight into "
+                        "one shared feature/label segment the FeatureSet "
+                        "wraps zero-copy; 'gather' is the eager "
+                        "concat-into-from_dataframe baseline (parity "
+                        "reference / A-B benchmarking).")
+_global_config.register("xshard.num_workers", 0,
+                        "ETL worker fleet size for the XShard engine "
+                        "(0 = the transform pool's default: min(4, "
+                        "cpu_count)).")
+_global_config.register("xshard.partitions", 0,
+                        "Default partition count for XShard.from_pandas "
+                        "(0 = one partition per ETL worker).")
+_global_config.register("xshard.slab_mb", 64.0,
+                        "Per-partition shared-memory slab budget (MB) for "
+                        "XShard blocks; a partition output exceeding it "
+                        "spills to a per-partition memmap file instead "
+                        "(xshard.spill_bytes_total counts the bytes).")
+_global_config.register("xshard.spill_dir", "",
+                        "Directory for XShard spill files ('' = a fresh "
+                        "temp dir per engine, removed at engine close).")
 _global_config.register("embed.cold_lr", 0.01,
                         "SGD learning rate for host-DRAM cold-tier embedding "
                         "rows (applied eagerly on the host inside the "

@@ -105,6 +105,12 @@ REGISTRY: Dict[str, Site] = {
     "worker.kill": Site(
         "transform worker child — SIGKILLs itself mid-batch (pool "
         "self-healing respawns and resubmits)", kind="flag"),
+    "xshard.task": Site(
+        "xshard ETL worker child, before running a task body — models a "
+        "transient per-task failure (task retry budget)"),
+    "xshard.kill": Site(
+        "xshard ETL worker child — SIGKILLs itself mid-task (pool "
+        "self-healing respawns and resubmits)", kind="flag"),
     "feed.produce": Site(
         "device-feed producer thread, once per host batch — models a "
         "data-plane crash mid-epoch (surfaces in the consumer)"),

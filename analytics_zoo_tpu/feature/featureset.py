@@ -171,6 +171,20 @@ class FeatureSet(HostDataset):
         return cls(features, labels, **kwargs)
 
     @classmethod
+    def from_slab_views(cls, features: ArrayTree,
+                        labels: Optional[ArrayTree] = None,
+                        keepalive=None, **kwargs) -> "FeatureSet":
+        """Wrap shared-memory views WITHOUT copying (the XShard zero-copy
+        handoff): ``features``/``labels`` are numpy views into segments
+        written by ETL workers, ``keepalive`` owns the unlinked mappings
+        so the pages outlive the producing engine. ``shard`` defaults
+        off — the producer already laid out exactly this host's rows."""
+        kwargs.setdefault("shard", False)
+        fs = cls(features, labels, **kwargs)
+        fs._shm_keepalive = keepalive
+        return fs
+
+    @classmethod
     def from_dataframe(cls, df, feature_cols: Sequence[str],
                        label_cols: Optional[Sequence[str]] = None,
                        stack: bool = False, **kwargs) -> "FeatureSet":

@@ -20,6 +20,11 @@ the way past it:
 - the consumer gets **zero-copy numpy views** into the slab — results never
   transit a pipe.
 
+The fleet plumbing (claim/done ledger, death sweep + respawn, transient-task
+retries, teardown) lives in :class:`WorkerPoolBase` so other forked worker
+fleets — the XShard ETL pool in ``xshard/engine.py`` — reuse the exact same
+self-healing protocol with their own task payloads.
+
 Slot ownership contract: a view yielded by :meth:`TransformWorkerPool.
 map_index_batches` is valid until ``slots - 1`` further batches have been
 drawn (the slot is then handed back to a worker). Consumers that forward
@@ -184,7 +189,7 @@ def _worker_main(wid, features, transform, slot_views, task_q,
         task = task_q.get()
         if task is None:
             return
-        task_id, slot, row0, idx = task
+        task_id, (slot, row0, idx) = task
         result_q.put(("claim", task_id, wid))
         try:
             # chaos sites: a hard self-SIGKILL mid-batch (pool self-healing
@@ -211,40 +216,34 @@ def _worker_main(wid, features, transform, slot_views, task_q,
 # -- parent side -------------------------------------------------------------
 
 
-class TransformWorkerPool:
-    """Fixed fleet of forked transform workers over shared-memory slabs.
+class WorkerPoolBase:
+    """Generic parent-side plumbing for a fixed fleet of forked workers.
 
-    ``rows`` is the slab height (max records per task — the batch size for
-    streaming use, the dataset size for one-shot :func:`transform_all`
-    use); ``slots`` is how many independent slabs cycle through the
-    workers (the pipeline depth).
+    Subclasses provide ``_spawn_worker`` (the Process target + inherited
+    state) and a task payload convention; the base owns everything that
+    makes the fleet survivable — the SimpleQueue claim/done ledger, the
+    death sweep with the ``data.worker_respawns`` budget, per-task error
+    retries (``data.task_retries``), ordered collection, and teardown.
+    The wire protocol is ``(tid, payload)`` on the task queue and
+    ``("claim", tid, wid)`` / ``("done", tid, result, err)`` back.
     """
 
-    _live: "Dict[int, TransformWorkerPool]" = {}
+    _live: "Dict[int, WorkerPoolBase]" = {}
+    _kind = "worker"  # noun used in error/log messages
+    _error_cls: type = RuntimeError
+    _respawn_metric = _M_RESPAWN
 
-    def __init__(self, features, transform, rows: int,
-                 slots: int = 4, num_workers: Optional[int] = None,
-                 sample_record=None):
+    def _init_pool(self, num_workers: int) -> None:
+        """Create queues, fork the fleet, and arm the ledgers. Subclass
+        ``__init__`` must have staged every attribute ``_spawn_worker``
+        reads (slab views, inherited state) before calling this."""
         if not fork_available():
             raise RuntimeError(
-                "TransformWorkerPool requires the fork start method "
-                "(POSIX); use the thread transform mode instead")
-        if sample_record is None:
-            sample_record = transform.apply(_index_tree(features, 0))
-        self.spec = TreeSpec(sample_record)
-        self.rows = int(rows)
-        self.slots = max(1, int(slots))
-        self.num_workers = (int(num_workers) if num_workers
-                            else default_workers())
-        slab_bytes = self.spec.slab_bytes(self.rows)
-        self._shms: List[shared_memory.SharedMemory] = []
-        self._slot_views: List[List[np.ndarray]] = []
-        for _ in range(self.slots):
-            shm = shared_memory.SharedMemory(create=True, size=slab_bytes)
-            self._shms.append(shm)
-            self._slot_views.append(self.spec.slab_views(shm, self.rows))
+                f"{type(self).__name__} requires the fork start method "
+                f"(POSIX); use the thread transform mode instead")
         from ..common.config import global_config
         cfg = global_config()
+        self.num_workers = int(num_workers)
         self._ctx = mp.get_context("fork")
         self._task_q = self._ctx.SimpleQueue()
         # SimpleQueue, NOT mp.Queue: workers put results with a synchronous
@@ -252,50 +251,47 @@ class TransformWorkerPool:
         # an unflushed feeder thread, so the parent's claim/done ledger
         # stays exact through hard kills
         self._result_q = self._ctx.SimpleQueue()
-        self._features = features
-        self._transform = transform
         self._procs: List[mp.Process] = []
         for wid in range(self.num_workers):
             self._procs.append(self._spawn_worker(wid))
         self._task_counter = itertools.count()
         self._outstanding: set = set()
-        self._results: Dict[int, Tuple[int, Optional[str]]] = {}
-        self._tasks: Dict[int, Tuple[int, int, np.ndarray]] = {}
+        self._results: Dict[int, Tuple[Any, Optional[str]]] = {}
+        self._tasks: Dict[int, Any] = {}
         self._claimed: Dict[int, int] = {}  # tid -> wid (death ledger)
         self._retried: Dict[int, int] = {}  # tid -> error-retry count
         self._task_retries = int(cfg.get("data.task_retries") or 0)
         self._respawns_left = int(cfg.get("data.worker_respawns") or 0)
         self._closed = False
         self._lock = threading.Lock()
-        TransformWorkerPool._live[id(self)] = self
+        WorkerPoolBase._live[id(self)] = self
 
     def _spawn_worker(self, wid: int) -> mp.Process:
+        raise NotImplementedError
+
+    def _fork_process(self, wid: int, target, args) -> mp.Process:
         with warnings.catch_warnings():
             # jax warns on fork of its multithreaded parent; the children
-            # never touch jax (numpy-only transform loops), so the warning
-            # is noise here
+            # never touch jax (numpy/pandas-only task loops), so the
+            # warning is noise here
             warnings.simplefilter("ignore")
             p = self._ctx.Process(
-                target=_worker_main,
-                args=(wid, self._features, self._transform,
-                      self._slot_views, self._task_q, self._result_q),
-                daemon=True, name=f"zoo-transform-worker-{wid}")
+                target=target, args=args, daemon=True,
+                name=f"zoo-{self._kind}-worker-{wid}")
             p.start()
         return p
 
     # -- task plumbing -------------------------------------------------------
 
-    def _submit(self, slot: int, row0: int, idx: np.ndarray) -> int:
+    def _submit_payload(self, payload) -> int:
         tid = next(self._task_counter)
         self._outstanding.add(tid)
-        idx = np.ascontiguousarray(idx, dtype=np.int64)
-        self._tasks[tid] = (slot, row0, idx)  # kept for resubmission
-        self._task_q.put((tid, slot, row0, idx))
+        self._tasks[tid] = payload  # kept for resubmission
+        self._task_q.put((tid, payload))
         return tid
 
     def _resubmit(self, tid: int) -> None:
-        slot, row0, idx = self._tasks[tid]
-        self._task_q.put((tid, slot, row0, idx))
+        self._task_q.put((tid, self._tasks[tid]))
 
     def _result_get(self, timeout: float):
         """``SimpleQueue.get`` with a timeout (single consumer thread —
@@ -306,26 +302,26 @@ class TransformWorkerPool:
 
     def _check_workers(self) -> None:
         """Death sweep: a child that exited nonzero (SIGKILL, OOM, abort)
-        is respawned — fork inherits the same features/transform/slab
-        views — and whatever task it had claimed is resubmitted, so the
-        consumer never hangs on a result that can no longer arrive. Once
-        the respawn budget (``data.worker_respawns``) is spent, the death
-        surfaces promptly as :class:`TransformWorkerError` instead."""
+        is respawned — fork inherits the same state and slab views — and
+        whatever task it had claimed is resubmitted, so the consumer never
+        hangs on a result that can no longer arrive. Once the respawn
+        budget (``data.worker_respawns``) is spent, the death surfaces
+        promptly as the pool's error class instead."""
         for wid, p in enumerate(self._procs):
             if p.is_alive() or p.exitcode in (0, None):
                 continue
             lost = [tid for tid, w in self._claimed.items() if w == wid]
             if self._respawns_left <= 0:
-                raise TransformWorkerError(
-                    f"transform worker died with exit code {p.exitcode} "
+                raise self._error_cls(
+                    f"{self._kind} worker died with exit code {p.exitcode} "
                     f"(killed? OOM?) and the respawn budget is exhausted; "
                     f"raise data.worker_respawns to self-heal") from None
             self._respawns_left -= 1
-            _M_RESPAWN.inc()
+            self._respawn_metric.inc()
             logger.warning(
-                "transform worker %d died with exit code %s; respawning "
+                "%s worker %d died with exit code %s; respawning "
                 "(%d respawns left) and resubmitting %d lost task(s)",
-                wid, p.exitcode, self._respawns_left, len(lost))
+                self._kind, wid, p.exitcode, self._respawns_left, len(lost))
             self._procs[wid] = self._spawn_worker(wid)
             for tid in lost:
                 self._claimed.pop(tid, None)
@@ -346,7 +342,7 @@ class TransformWorkerPool:
             _, tid, wid = msg
             self._claimed[tid] = wid
             return True
-        _, tid, n, err = msg
+        _, tid, result, err = msg
         self._claimed.pop(tid, None)
         if err is not None and self._retried.get(tid, 0) < self._task_retries:
             # transient-task resilience: burn one retry and re-run the
@@ -354,32 +350,33 @@ class TransformWorkerPool:
             # simply overwritten)
             self._retried[tid] = self._retried.get(tid, 0) + 1
             logger.warning(
-                "transform task %d failed (retry %d/%d):\n%s", tid,
+                "%s task %d failed (retry %d/%d):\n%s", self._kind, tid,
                 self._retried[tid], self._task_retries, err)
             self._resubmit(tid)
             return True
         self._outstanding.discard(tid)
-        self._results[tid] = (n, err)
+        self._results[tid] = (result, err)
         self._tasks.pop(tid, None)
         self._retried.pop(tid, None)
         return True
 
-    def _collect(self, tid: int, timeout: float = 300.0) -> int:
-        """Block until task ``tid`` finished; returns rows written. Polls
-        in short slices so a dead child is noticed (and healed or
+    def _collect(self, tid: int, timeout: float = 300.0):
+        """Block until task ``tid`` finished; returns its result payload.
+        Polls in short slices so a dead child is noticed (and healed or
         surfaced) within ~0.2s, not only when the whole queue goes
         quiet."""
         deadline = time.monotonic() + timeout
         while tid not in self._results:
             if not self._pump(timeout=0.2):
                 if time.monotonic() > deadline:
-                    raise TransformWorkerError(
-                        "timed out waiting for a transform worker") from None
-        n, err = self._results.pop(tid)
+                    raise self._error_cls(
+                        f"timed out waiting for a {self._kind} "
+                        f"worker") from None
+        result, err = self._results.pop(tid)
         if err is not None:
-            raise TransformWorkerError(
-                "transform raised inside a worker process:\n" + err)
-        return n
+            raise self._error_cls(
+                f"{self._kind} raised inside a worker process:\n" + err)
+        return result
 
     def _drain_outstanding(self) -> None:
         """Wait out tasks abandoned by a closed consumer generator, so
@@ -387,8 +384,104 @@ class TransformWorkerPool:
         for tid in sorted(self._outstanding):
             try:
                 self._collect(tid)
-            except TransformWorkerError:
+            except self._error_cls:
                 pass  # an abandoned task's error has no consumer left
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _release_resources(self) -> None:
+        """Subclass hook: free slabs/files owned by the pool."""
+
+    def close(self, unlink: bool = True) -> None:
+        """Stop workers and release resources. Safe to call repeatedly.
+        With ``unlink=False`` shared segments stay mapped (a caller
+        keeping zero-copy views alive unlinks later)."""
+        if getattr(self, "_closed", True):
+            return
+        self._closed = True
+        WorkerPoolBase._live.pop(id(self), None)
+        try:
+            for _ in self._procs:
+                self._task_q.put(None)
+        except Exception:
+            pass
+        for p in self._procs:
+            p.join(timeout=2)
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            if p.is_alive():
+                p.join(timeout=2)
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=2)
+        close_q = getattr(self._result_q, "close", None)
+        if close_q is not None:  # SimpleQueue.close (3.9+): release pipes
+            close_q()
+        if unlink:
+            self._release_resources()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class TransformWorkerPool(WorkerPoolBase):
+    """Fixed fleet of forked transform workers over shared-memory slabs.
+
+    ``rows`` is the slab height (max records per task — the batch size for
+    streaming use, the dataset size for one-shot :func:`transform_all`
+    use); ``slots`` is how many independent slabs cycle through the
+    workers (the pipeline depth).
+    """
+
+    _kind = "transform"
+    _error_cls = TransformWorkerError
+    _respawn_metric = _M_RESPAWN
+
+    def __init__(self, features, transform, rows: int,
+                 slots: int = 4, num_workers: Optional[int] = None,
+                 sample_record=None):
+        self._closed = True  # armed by _init_pool; keeps __del__ safe
+        if not fork_available():
+            raise RuntimeError(
+                "TransformWorkerPool requires the fork start method "
+                "(POSIX); use the thread transform mode instead")
+        if sample_record is None:
+            sample_record = transform.apply(_index_tree(features, 0))
+        self.spec = TreeSpec(sample_record)
+        self.rows = int(rows)
+        self.slots = max(1, int(slots))
+        slab_bytes = self.spec.slab_bytes(self.rows)
+        self._shms: List[shared_memory.SharedMemory] = []
+        self._slot_views: List[List[np.ndarray]] = []
+        for _ in range(self.slots):
+            shm = shared_memory.SharedMemory(create=True, size=slab_bytes)
+            self._shms.append(shm)
+            self._slot_views.append(self.spec.slab_views(shm, self.rows))
+        self._features = features
+        self._transform = transform
+        self._init_pool(int(num_workers) if num_workers
+                        else default_workers())
+
+    def _spawn_worker(self, wid: int) -> mp.Process:
+        return self._fork_process(
+            wid, _worker_main,
+            (wid, self._features, self._transform, self._slot_views,
+             self._task_q, self._result_q))
+
+    def _submit(self, slot: int, row0: int, idx: np.ndarray) -> int:
+        idx = np.ascontiguousarray(idx, dtype=np.int64)
+        return self._submit_payload((slot, row0, idx))
 
     # -- high-level consumers ------------------------------------------------
 
@@ -475,35 +568,8 @@ class TransformWorkerPool:
 
     # -- lifecycle -----------------------------------------------------------
 
-    def close(self, unlink: bool = True) -> None:
-        """Stop workers and release slabs. Safe to call repeatedly. With
-        ``unlink=False`` the shared segments stay mapped (a caller keeping
-        zero-copy views alive unlinks later via :func:`release_slabs`)."""
-        if self._closed:
-            return
-        self._closed = True
-        TransformWorkerPool._live.pop(id(self), None)
-        try:
-            for _ in self._procs:
-                self._task_q.put(None)
-        except Exception:
-            pass
-        for p in self._procs:
-            p.join(timeout=2)
-        for p in self._procs:
-            if p.is_alive():
-                p.terminate()
-        for p in self._procs:
-            if p.is_alive():
-                p.join(timeout=2)
-            if p.is_alive():
-                p.kill()
-                p.join(timeout=2)
-        close_q = getattr(self._result_q, "close", None)
-        if close_q is not None:  # SimpleQueue.close (3.9+): release pipes
-            close_q()
-        if unlink:
-            self.release_slabs()
+    def _release_resources(self) -> None:
+        self.release_slabs()
 
     def release_slabs(self) -> None:
         self._slot_views = []
@@ -525,23 +591,11 @@ class TransformWorkerPool:
         shms, self._shms = self._shms, []
         return shms
 
-    def __enter__(self) -> "TransformWorkerPool":
-        return self
-
-    def __exit__(self, *exc) -> None:
-        self.close()
-
-    def __del__(self):
-        try:
-            self.close()
-        except Exception:
-            pass
-
 
 @atexit.register
 def _close_live_pools() -> None:
     # interpreter exit must not strand worker processes or /dev/shm segments
-    for pool in list(TransformWorkerPool._live.values()):
+    for pool in list(WorkerPoolBase._live.values()):
         try:
             pool.close()
         except Exception:

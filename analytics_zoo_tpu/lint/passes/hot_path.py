@@ -38,6 +38,8 @@ DECODE_PY = os.path.join(REPO_ROOT, "analytics_zoo_tpu", "ops", "decode.py")
 LM_PY = os.path.join(REPO_ROOT, "analytics_zoo_tpu", "capture", "lm.py")
 SERVER_PY = os.path.join(REPO_ROOT, "analytics_zoo_tpu", "serving",
                          "server.py")
+ENGINE_PY = os.path.join(REPO_ROOT, "analytics_zoo_tpu", "xshard",
+                         "engine.py")
 
 EMBED_BODIES = ("_routing", "_lookup_body", "_lookup_bwd_body",
                 "_update_body")
@@ -52,6 +54,16 @@ PAGED_OPS = ("init_paged_pool", "page_table_set", "page_table_clear",
 
 HOT_FUNCS = ("evaluate", "_evaluate_direct", "_evaluate_direct_exact",
              "predict")
+
+#: XShard ETL engine bodies. The KERNELS are the per-row-scale vector
+#: cores (hash mixing, bucket reorder, join match, handoff scatter):
+#: loop-free outright. The TASKS are the exchange/partition/gather/
+#: combine bodies: loops there are column/source-count sized and legal,
+#: but host syncs and full-frame ``pd.concat`` are not.
+ETL_KERNELS = ("_mix64", "_bucket_order", "_join_match", "_stack_into",
+               "_exchange_task")
+ETL_TASKS = ("_gather_dest", "_filter_task", "_groupby_task", "_join_task",
+             "_handoff_task", "_take_cols_into")
 
 #: policy rows: (path, class name or None for module level, function names,
 #: extra banned np.<attr> calls, ban per-record loops?, scope)
@@ -77,6 +89,8 @@ _CHECKS: List[Tuple[str, Optional[str], Sequence[str], Sequence[str],
      ("_dispatch_step", "_insert_request_device", "_insert_request_paged",
       "_insert_request_spec", "_insert_suffix_paged", "_copy_page_device",
       "_evict_slots"), (), True, "body"),
+    (ENGINE_PY, None, ETL_KERNELS, (), True, "body"),
+    (ENGINE_PY, None, ETL_TASKS, (), False, "body"),
 ]
 
 
@@ -94,6 +108,11 @@ def _banned_call(node: ast.Call, np_attrs: Sequence[str] = ("asarray",)
         if (f.attr in np_attrs and isinstance(base, ast.Name)
                 and base.id in ("np", "numpy")):
             return f"{base.id}.{f.attr}()"
+        if (f.attr == "concat" and isinstance(base, ast.Name)
+                and base.id in ("pd", "pandas")):
+            # a full-frame concat in a policed body is the seed-era
+            # gather-everything antipattern the ETL engine exists to kill
+            return f"{base.id}.concat()"
         if (f.attr == "device_get" and isinstance(base, ast.Name)
                 and base.id == "jax"):
             return "jax.device_get()"

@@ -1,3 +1,5 @@
 from .shard import (  # noqa: F401
     DataShards, read_csv, read_json, read_parquet)
 from .pod_shard import PodDataShards  # noqa: F401
+from .engine import (  # noqa: F401
+    EtlEngine, XShard, XShardWorkerError, get_engine)

@@ -1,0 +1,988 @@
+"""XShard ETL engine: hash-partitioned relational ops over shared-memory
+blocks with a persistent forked worker pool.
+
+The reference's analytics half runs XShards on Ray/Spark executors; our
+seed-era :class:`~analytics_zoo_tpu.xshard.shard.DataShards` instead
+pickles whole pandas shards through a throwaway ``ProcessPoolExecutor``
+and funnels ``repartition``/``collect``/``to_featureset`` through a
+full-dataset ``pd.concat`` in the driver. This module is the real tier:
+
+- **blocks**: a partition is a column-major block (one aligned region per
+  column, same layout math as the transform slabs) living either in a
+  pooled ``multiprocessing.shared_memory`` slab or — when it outgrows the
+  ``xshard.slab_mb`` budget — in a per-partition ``.mmap`` spill file,
+  the same memmap tier FeatureSet's DISK mode uses;
+- **workers**: a persistent forked fleet (:class:`EtlPool`, built on the
+  transform pool's :class:`~analytics_zoo_tpu.feature.worker_pool.
+  WorkerPoolBase` claim/done ledger, death sweep + respawn, task
+  retries). Tasks ship as cloudpickle blobs; results are tiny
+  :class:`BlockRef` descriptors — data NEVER transits the pipe, it moves
+  by slab name;
+- **shuffle**: ``groupby(...).agg`` and ``join`` run as two-stage
+  hash-partitioned exchanges — stage A buckets each source partition by
+  key hash (stable reorder + per-destination offset table, written
+  straight into an exchange slab), stage B attaches every source's slab,
+  slices its destination ranges and combines locally — pandas' own
+  groupby kernel for aggregations (same values in the same order as the
+  single-process reference, so even Kahan-compensated float sums are
+  bit-identical) and a factorized-key ``searchsorted`` kernel for joins;
+- **zero-copy handoff**: :meth:`XShard.to_featureset` lays out ONE
+  exact-size feature/label segment, workers write their partition rows
+  at row offsets, and the FeatureSet wraps the views directly — no
+  intermediate DataFrame and no full-dataset concat ever exists in the
+  driver.
+
+Workers never touch jax (numpy/pandas only) and attach slabs UNTRACKED:
+a child re-attaching by name must not register the segment with its own
+``resource_tracker``, or the tracker would unlink the parent's live slab
+at child exit (bpo-39959). All segments are created in the parent.
+"""
+from __future__ import annotations
+
+import atexit
+import itertools
+import os
+import pickle
+import shutil
+import signal
+import tempfile
+import time
+import traceback
+from multiprocessing import shared_memory
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common import faults
+from ..common import metrics as _metrics
+from ..common.config import global_config
+from ..common.pickling import pickler as _pickler
+from ..common.utils import time_it
+from ..feature.worker_pool import (_ALIGN, SlabKeepAlive, WorkerPoolBase,
+                                   default_workers)
+
+_M_TASK = _metrics.histogram(
+    "xshard.task_seconds",
+    "XShard ETL task latency (observed in the forked worker).")
+_M_RESPAWN = _metrics.counter(
+    "xshard.respawn_total",
+    "XShard ETL workers respawned after dying mid-task (SIGKILL/OOM).")
+_M_EXCHANGE = _metrics.counter(
+    "xshard.exchange_bytes_total",
+    "Bytes written to shuffle-exchange blocks (stage-A bucket reorders).")
+_M_SPILL = _metrics.counter(
+    "xshard.spill_bytes_total",
+    "Block bytes that exceeded the xshard.slab_mb budget and spilled to "
+    "per-partition memmap files.")
+_M_HANDOFF = _metrics.counter(
+    "xshard.handoff_bytes_total",
+    "Bytes workers wrote directly into FeatureSet handoff segments "
+    "(the zero-copy to_featureset path).")
+
+
+class XShardWorkerError(RuntimeError):
+    """An ETL task raised inside a worker process; carries the worker-side
+    traceback so the failure reads as if it happened in the driver."""
+
+
+# -- block descriptors and layout -------------------------------------------
+
+
+class BlockRef:
+    """Tiny picklable descriptor of one materialized partition block.
+
+    ``kind`` is ``"shm"`` (name = slab segment), ``"mmap"`` (name = spill
+    file path) or ``"empty"``; ``schema`` is a tuple of ``(column,
+    dtype_str, shape_tail)``; ``meta`` carries small per-block extras
+    (the exchange offset table). The data itself never rides the pipe.
+    """
+
+    __slots__ = ("kind", "name", "schema", "rows", "nbytes", "meta")
+
+    def __init__(self, kind: str, name: str, schema, rows: int,
+                 nbytes: int, meta=None):
+        self.kind, self.name, self.schema = kind, name, schema
+        self.rows, self.nbytes, self.meta = int(rows), int(nbytes), meta
+
+    def __getstate__(self):
+        return (self.kind, self.name, self.schema, self.rows, self.nbytes,
+                self.meta)
+
+    def __setstate__(self, state):
+        (self.kind, self.name, self.schema, self.rows, self.nbytes,
+         self.meta) = state
+
+
+def _block_layout(schema, rows: int):
+    """Column-major block layout: per column one contiguous ``rows ×
+    cell`` region, starts aligned to ``_ALIGN``; final yield is the total
+    size sentinel."""
+    offset = 0
+    for col, dtstr, tail in schema:
+        offset = (offset + _ALIGN - 1) // _ALIGN * _ALIGN
+        dt = np.dtype(dtstr)
+        cell = dt.itemsize * int(np.prod(tail, dtype=np.int64))
+        yield offset, col, dt, tuple(tail)
+        offset += cell * rows
+    yield offset, None, None, None
+
+
+def _block_nbytes(schema, rows: int) -> int:
+    return max(1, list(_block_layout(schema, rows))[-1][0])
+
+
+def _block_views(buf, schema, rows: int) -> Dict[str, np.ndarray]:
+    return {col: np.ndarray((rows,) + tail, dtype=dt, buffer=buf,
+                            offset=off)
+            for off, col, dt, tail in _block_layout(schema, rows)
+            if col is not None}
+
+
+def _schema_of(cols: Dict[str, np.ndarray]):
+    schema = []
+    for c, a in cols.items():
+        if a.dtype.hasobject:
+            raise ValueError(
+                f"column {c!r} has object dtype; XShard blocks hold "
+                f"fixed-width (numeric/bool/datetime) columns only")
+        schema.append((c, a.dtype.str, tuple(a.shape[1:])))
+    return tuple(schema)
+
+
+# -- shared-memory attach (worker side, untracked) ---------------------------
+
+_ATTACHED: Dict[str, shared_memory.SharedMemory] = {}
+
+
+def _attach_untracked(name: str) -> shared_memory.SharedMemory:
+    """Attach an existing segment WITHOUT resource-tracker registration:
+    a tracked attach in a forked child unlinks the parent's live slab
+    when the child exits (bpo-39959)."""
+    try:
+        return shared_memory.SharedMemory(name=name, create=False,
+                                          track=False)  # 3.13+
+    except TypeError:
+        from multiprocessing import resource_tracker
+        orig = resource_tracker.register
+        resource_tracker.register = lambda *a, **k: None
+        try:
+            return shared_memory.SharedMemory(name=name, create=False)
+        finally:
+            resource_tracker.register = orig
+
+
+def _attach(name: str) -> shared_memory.SharedMemory:
+    shm = _ATTACHED.get(name)
+    if shm is None:
+        shm = _attach_untracked(name)
+        _ATTACHED[name] = shm
+    return shm
+
+
+def _detach_all() -> None:
+    for shm in _ATTACHED.values():
+        try:
+            shm.close()
+        except Exception:
+            pass
+    _ATTACHED.clear()
+
+
+# -- block load/store --------------------------------------------------------
+
+
+def _load_block(ref: BlockRef) -> Tuple[Dict[str, np.ndarray], Any]:
+    """Map a block back into column views; the returned keepalive object
+    must outlive the views (shm mapping or memmap buffer)."""
+    if ref.kind == "empty" or ref.rows == 0:
+        return ({col: np.empty((0,) + tail, dtype=dt)
+                 for _, col, dt, tail in _block_layout(ref.schema, 0)
+                 if col is not None}, None)
+    if ref.kind == "shm":
+        shm = _attach(ref.name)
+        return _block_views(shm.buf, ref.schema, ref.rows), shm
+    mm = np.memmap(ref.name, dtype=np.uint8, mode="r")
+    return _block_views(mm, ref.schema, ref.rows), mm
+
+
+def _alloc_block(schema, rows: int, slab: Optional[Tuple[str, int]],
+                 spill_dir: str, tag: str
+                 ) -> Tuple[Optional[Dict[str, np.ndarray]], BlockRef]:
+    """Views + ref for a block about to be written: the assigned pooled
+    slab when it fits the budget, a per-partition memmap spill file when
+    it does not (the disk tier — same convention as FeatureSet's
+    ``_spill_to_disk``)."""
+    nbytes = _block_nbytes(schema, rows)
+    if rows == 0:
+        return None, BlockRef("empty", "", schema, 0, 0)
+    if slab is not None and nbytes <= slab[1]:
+        return (_block_views(_attach(slab[0]).buf, schema, rows),
+                BlockRef("shm", slab[0], schema, rows, nbytes))
+    path = os.path.join(spill_dir, tag + ".mmap")
+    mm = np.memmap(path, dtype=np.uint8, mode="w+", shape=(nbytes,))
+    _M_SPILL.inc(nbytes)
+    return (_block_views(mm, schema, rows),
+            BlockRef("mmap", path, schema, rows, nbytes))
+
+
+def _store_block(cols: Dict[str, np.ndarray], slab, spill_dir: str,
+                 tag: str) -> BlockRef:
+    schema = _schema_of(cols)
+    rows = len(next(iter(cols.values()))) if cols else 0
+    views, ref = _alloc_block(schema, rows, slab, spill_dir, tag)
+    if views is not None:
+        for c, a in cols.items():  # per-COLUMN loop; each copy vectorized
+            views[c][...] = a
+    return ref
+
+
+def _take_cols_into(views: Dict[str, np.ndarray],
+                    cols: Dict[str, np.ndarray], order: np.ndarray) -> None:
+    for c, a in cols.items():  # per-COLUMN loop; gather itself vectorized
+        np.take(a, order, axis=0, out=views[c])
+
+
+def _cols_of(out) -> Dict[str, np.ndarray]:
+    """Normalize a task function's result (DataFrame or dict of arrays)
+    into contiguous column arrays."""
+    if isinstance(out, dict):
+        return {c: np.ascontiguousarray(v) for c, v in out.items()}
+    return {c: np.ascontiguousarray(out[c].to_numpy())
+            for c in out.columns}
+
+
+def _frame_of(cols: Dict[str, np.ndarray]):
+    import pandas as pd
+    return pd.DataFrame(cols, copy=False)
+
+
+# -- vectorized kernels (policed by the hot-path lint: loop-free, no
+#    full-frame concats, no per-row Python) ---------------------------------
+
+_MIX_MULT = np.uint64(0x9E3779B97F4A7C15)
+_MIX_SEED = np.uint64(0x243F6A8885A308D3)
+
+
+def _mix64(h: np.ndarray, a: np.ndarray) -> np.ndarray:
+    """One splitmix64-style round folding key column ``a`` into ``h``."""
+    a = np.ascontiguousarray(a)
+    if a.dtype.itemsize != 8:
+        a = a.astype(np.int64)
+    with np.errstate(over="ignore"):
+        v = a.view(np.uint64)
+        h = h ^ (v * _MIX_MULT)
+        h = (h ^ (h >> np.uint64(31))) * np.uint64(0xBF58476D1CE4E5B9)
+        return h ^ (h >> np.uint64(27))
+
+
+def _bucket_order(dest: np.ndarray, nparts: int
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Stable reorder by destination: ``order`` groups rows by dest
+    (original order preserved within a dest), ``offsets[j]:offsets[j+1]``
+    bounds dest ``j``'s rows in the reordered block."""
+    order = np.argsort(dest, kind="stable")
+    counts = np.bincount(dest, minlength=nparts)
+    offsets = np.zeros(nparts + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    return order, offsets
+
+
+def _join_match(lcode: np.ndarray, rcode: np.ndarray
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Inner-join row match on factorized keys: left order preserved,
+    each left row's matches in right original order (pandas ``merge``
+    row-order contract), duplicates expanded by arithmetic — no per-row
+    Python."""
+    order = np.argsort(rcode, kind="stable")
+    rs = rcode[order]
+    lo = np.searchsorted(rs, lcode, side="left")
+    hi = np.searchsorted(rs, lcode, side="right")
+    counts = hi - lo
+    li = np.repeat(np.arange(lcode.shape[0]), counts)
+    ends = np.cumsum(counts)
+    within = np.arange(int(ends[-1]) if ends.shape[0] else 0) \
+        - np.repeat(ends - counts, counts)
+    ri = order[np.repeat(lo, counts) + within]
+    return li, ri
+
+
+def _stack_into(out: np.ndarray, row0: int, k: int,
+                col: np.ndarray) -> None:
+    """Scatter one feature column into the handoff matrix at its row
+    offset (assignment casts to the matrix dtype, float32)."""
+    out[row0:row0 + col.shape[0], k] = col
+
+
+# -- key factorization (per-column loops live here, outside the policed
+#    kernels — column count is schema-sized, never row-sized) ----------------
+
+
+def _hash_keys(cols: Dict[str, np.ndarray], keys: Sequence[str],
+               nparts: int) -> np.ndarray:
+    n = len(cols[keys[0]])
+    h = np.full(n, _MIX_SEED, dtype=np.uint64)
+    for k in keys:
+        h = _mix64(h, cols[k])
+    return (h % np.uint64(nparts)).astype(np.int64)
+
+
+def _factorize_two(lcols, rcols, keys
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Factorize join keys over the UNION of both sides so codes agree."""
+    nl = len(lcols[keys[0]])
+    codes, sizes = [], []
+    for k in keys:
+        both = np.concatenate([lcols[k], rcols[k]])
+        _, inv = np.unique(both, return_inverse=True)
+        codes.append(inv.astype(np.int64))
+        sizes.append(int(inv.max()) + 1 if len(inv) else 1)
+    combined = codes[0]
+    for c, s in zip(codes[1:], sizes[1:]):
+        combined = combined * s + c
+    return combined[:nl], combined[nl:]
+
+
+# -- worker task bodies ------------------------------------------------------
+
+
+def _map_task(ref, blob, slab, spill_dir, tag):
+    cols, keep = _load_block(ref)
+    fn = pickle.loads(blob)
+    out_cols = _cols_of(fn(_frame_of(cols)))
+    del cols, keep
+    return _store_block(out_cols, slab, spill_dir, tag)
+
+
+def _filter_task(ref, blob, slab, spill_dir, tag):
+    cols, keep = _load_block(ref)
+    pred = pickle.loads(blob)
+    idx = np.flatnonzero(np.ascontiguousarray(pred(_frame_of(cols))))
+    views, out = _alloc_block(_schema_of(cols), len(idx), slab, spill_dir,
+                              tag)
+    if views is not None:
+        _take_cols_into(views, cols, idx)
+    del cols, keep
+    return out
+
+
+def _read_file_task(path, fmt, kwargs, slab, spill_dir, tag):
+    import pandas as pd
+    reader = {"csv": pd.read_csv, "json": pd.read_json,
+              "parquet": pd.read_parquet}[fmt]
+    return _store_block(_cols_of(reader(path, **kwargs)), slab, spill_dir,
+                        tag)
+
+
+def _exchange_task(ref, keys, nparts, slab, spill_dir, tag):
+    """Stage A of a shuffle: bucket one source partition by key hash —
+    stable reorder straight into the exchange block plus the
+    per-destination offset table (carried in the ref's meta)."""
+    cols, keep = _load_block(ref)
+    if ref.rows == 0:
+        out = BlockRef("empty", "", ref.schema, 0, 0)
+        out.meta = {"offsets": np.zeros(nparts + 1, dtype=np.int64)}
+        return out
+    dest = _hash_keys(cols, keys, nparts)
+    order, offsets = _bucket_order(dest, nparts)
+    views, out = _alloc_block(tuple(ref.schema), ref.rows, slab, spill_dir,
+                              tag)
+    _take_cols_into(views, cols, order)
+    out.meta = {"offsets": offsets}
+    _M_EXCHANGE.inc(out.nbytes)
+    del cols, keep
+    return out
+
+
+def _gather_dest(refs: Sequence[BlockRef], j: int
+                 ) -> Dict[str, np.ndarray]:
+    """Stage-B input: destination ``j``'s row ranges from every source's
+    exchange block, concatenated per column (the only concat in the
+    engine — per-destination slices, never the full dataset)."""
+    parts = []
+    keeps = []
+    for ref in refs:
+        if ref.rows == 0:
+            continue
+        cols, keep = _load_block(ref)
+        off = ref.meta["offsets"]
+        lo, hi = int(off[j]), int(off[j + 1])
+        if hi > lo:
+            parts.append({c: a[lo:hi] for c, a in cols.items()})
+            keeps.append(keep)
+    if not parts:
+        return {col: np.empty((0,) + tail, dtype=dt)
+                for _, col, dt, tail in _block_layout(refs[0].schema, 0)
+                if col is not None}
+    if len(parts) == 1:
+        merged = {c: np.ascontiguousarray(a) for c, a in parts[0].items()}
+    else:
+        merged = {c: np.concatenate([p[c] for p in parts])
+                  for c in parts[0]}
+    del keeps
+    return merged
+
+
+def _groupby_task(refs, j, keys, spec, slab, spill_dir, tag):
+    """Stage B of groupby-agg: local combine of destination ``j`` through
+    pandas' OWN groupby kernel. Bit-parity with the single-process
+    reference holds by construction: the hash shuffle puts every row of a
+    group in one destination, the stable bucket reorder + source-order
+    gather preserve each group's original row order, so pandas'
+    (Kahan-compensated) accumulation sees the same values in the same
+    order as it would on the whole frame."""
+    cols = _gather_dest(refs, j)
+    df = _frame_of(cols)
+    out = df.groupby(list(keys), as_index=False, sort=True).agg(dict(spec))
+    return _store_block(_cols_of(out), slab, spill_dir, tag)
+
+
+def _join_task(lrefs, rrefs, j, keys, slab, spill_dir, tag):
+    """Stage B of inner join: match destination ``j``'s left and right
+    slices on factorized keys."""
+    lcols = _gather_dest(lrefs, j)
+    rcols = _gather_dest(rrefs, j)
+    lcode, rcode = _factorize_two(lcols, rcols, keys)
+    li, ri = _join_match(lcode, rcode)
+    out_cols = {c: a[li] for c, a in lcols.items()}
+    for c, a in rcols.items():
+        if c not in keys:
+            out_cols[c] = a[ri]
+    return _store_block(out_cols, slab, spill_dir, tag)
+
+
+def _handoff_task(ref, feature_cols, label_cols, out_name, row0, hschema,
+                  total):
+    """Write one partition's rows straight into the shared FeatureSet
+    handoff segment at its row offset — the zero-copy lowering."""
+    cols, keep = _load_block(ref)
+    views = _block_views(_attach(out_name).buf, hschema, total)
+    feats = views["__features__"]
+    for k, c in enumerate(feature_cols):
+        _stack_into(feats, row0, k, cols[c])
+    nbytes = ref.rows * 4 * len(feature_cols)
+    for c in label_cols:
+        views[c][row0:row0 + ref.rows] = cols[c]
+        nbytes += ref.rows * cols[c].dtype.itemsize
+    _M_HANDOFF.inc(nbytes)
+    del cols, keep
+    return ref.rows
+
+
+# -- worker loop + pool ------------------------------------------------------
+
+
+def _etl_worker_main(wid, task_q, result_q) -> None:
+    """Forked ETL worker loop: tasks arrive as ``(tid, cloudpickle
+    blob)``, data moves by slab name. Same claim/done protocol as the
+    transform workers (see ``worker_pool._worker_main``)."""
+    from ..utils.trace import set_thread_label
+    set_thread_label(f"xshard-{wid}")
+    while True:
+        task = task_q.get()
+        if task is None:
+            return
+        tid, blob = task
+        result_q.put(("claim", tid, wid))
+        try:
+            if faults.inject("xshard.kill"):
+                os.kill(os.getpid(), signal.SIGKILL)
+            faults.inject("xshard.task")
+            t0 = time.perf_counter()
+            fn, args = pickle.loads(blob)
+            with time_it("xshard.task"):
+                out = fn(*args)
+            _M_TASK.observe(time.perf_counter() - t0)
+            result_q.put(("done", tid, out, None))
+        except BaseException:
+            result_q.put(("done", tid, None, traceback.format_exc()))
+
+
+class EtlPool(WorkerPoolBase):
+    """Persistent forked ETL worker fleet. Unlike the transform pool,
+    nothing task-specific is fork-inherited — tasks ship whole — so a
+    respawned worker is immediately as capable as the one it replaces."""
+
+    _kind = "xshard"
+    _error_cls = XShardWorkerError
+    _respawn_metric = _M_RESPAWN
+
+    def __init__(self, num_workers: int):
+        self._closed = True  # armed by _init_pool; keeps __del__ safe
+        self._init_pool(num_workers)
+
+    def _spawn_worker(self, wid: int):
+        return self._fork_process(wid, _etl_worker_main,
+                                  (wid, self._task_q, self._result_q))
+
+    def run(self, calls: Sequence[Tuple[Any, tuple]]) -> List[Any]:
+        """Submit ``(fn, args)`` tasks and collect results in order.
+        Pending claim/done messages are drained between submits so a wide
+        fan-out cannot wedge both pipes."""
+        if not self._lock.acquire(blocking=False):
+            raise RuntimeError(
+                "EtlPool is already running a task wave; use one engine "
+                "per concurrent driver thread")
+        try:
+            self._drain_outstanding()
+            tids = []
+            for fn, args in calls:
+                tids.append(self._submit_payload(_pickler.dumps((fn, args))))
+                while self._result_q._reader.poll(0):
+                    self._pump(0)
+            return [self._collect(tid) for tid in tids]
+        finally:
+            self._lock.release()
+
+
+# -- slab pool (parent-owned, reused across task waves) ----------------------
+
+
+class SlabPool:
+    """Fixed-size reusable shared-memory slabs, ALL created in the parent
+    (workers only ever attach by name, untracked). A slab is pinned while
+    a live XShard's block occupies it and recycled when that shard is
+    closed or collected."""
+
+    def __init__(self, slab_bytes: int):
+        self.slab_bytes = int(slab_bytes)
+        self._all: Dict[str, shared_memory.SharedMemory] = {}
+        self._free: List[str] = []
+
+    def acquire(self) -> Tuple[str, int]:
+        if self._free:
+            return self._free.pop(), self.slab_bytes
+        shm = shared_memory.SharedMemory(create=True, size=self.slab_bytes)
+        self._all[shm.name] = shm
+        return shm.name, self.slab_bytes
+
+    def release(self, name: str) -> None:
+        if name in self._all and name not in self._free:
+            self._free.append(name)
+
+    @property
+    def total_bytes(self) -> int:
+        return len(self._all) * self.slab_bytes
+
+    def close(self) -> None:
+        for shm in self._all.values():
+            try:
+                shm.close()
+            except BufferError:
+                pass  # a consumer still holds views; unlink below still
+                # frees the NAME — memory goes when the views do
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+        self._all = {}
+        self._free = []
+
+
+# -- engine ------------------------------------------------------------------
+
+
+class EtlEngine:
+    """One worker fleet + slab pool + spill directory; process-global via
+    :func:`get_engine` (rebuilt when the ``xshard.*`` config changes)."""
+
+    def __init__(self, num_workers: Optional[int] = None,
+                 slab_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+        cfg = global_config()
+        if num_workers is None:
+            num_workers = (int(cfg.get("xshard.num_workers") or 0)
+                           or default_workers())
+        if slab_bytes is None:
+            slab_bytes = int(float(cfg.get("xshard.slab_mb") or 64.0)
+                             * (1 << 20))
+        if spill_dir is None:
+            spill_dir = str(cfg.get("xshard.spill_dir") or "")
+        self.num_workers = int(num_workers)
+        self.slab_bytes = max(1, int(slab_bytes))
+        self._own_spill = not spill_dir
+        self.spill_dir = (spill_dir
+                          or tempfile.mkdtemp(prefix="zoo_xshard_spill_"))
+        os.makedirs(self.spill_dir, exist_ok=True)
+        self.slabs = SlabPool(self.slab_bytes)
+        self.pool = EtlPool(self.num_workers)
+        self._tag_counter = itertools.count()
+        self._closed = False
+        self._cfg_sig: Any = None
+
+    def run(self, calls) -> List[Any]:
+        return self.pool.run(calls)
+
+    def new_tag(self) -> str:
+        return f"xshard-{os.getpid()}-{next(self._tag_counter)}"
+
+    def release_block(self, ref: BlockRef) -> None:
+        if ref.kind == "shm":
+            self.slabs.release(ref.name)
+        elif ref.kind == "mmap":
+            try:
+                os.remove(ref.name)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.pool.close()
+        self.slabs.close()
+        _detach_all()
+        if self._own_spill:
+            shutil.rmtree(self.spill_dir, ignore_errors=True)
+
+    def __enter__(self) -> "EtlEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+_engine: Optional[EtlEngine] = None
+
+
+def _config_signature():
+    cfg = global_config()
+    return (int(cfg.get("xshard.num_workers") or 0),
+            float(cfg.get("xshard.slab_mb") or 64.0),
+            str(cfg.get("xshard.spill_dir") or ""))
+
+
+def get_engine() -> EtlEngine:
+    """The process-global ETL engine, rebuilt when its ``xshard.*``
+    config signature changes (worker count, slab budget, spill dir)."""
+    global _engine
+    sig = _config_signature()
+    if _engine is not None and _engine._cfg_sig != sig:
+        _engine.close()
+        _engine = None
+    if _engine is None:
+        _engine = EtlEngine()
+        _engine._cfg_sig = sig
+    return _engine
+
+
+@atexit.register
+def _close_engine() -> None:
+    global _engine
+    if _engine is not None:
+        try:
+            _engine.close()
+        except Exception:
+            pass
+        _engine = None
+
+
+# -- the user-facing shard -----------------------------------------------
+
+
+class XShard:
+    """A hash-partitionable distributed table: partitions are
+    shared-memory (or spilled-memmap) blocks, ops are waves of tasks on
+    the engine's persistent worker fleet."""
+
+    def __init__(self, engine: EtlEngine, refs: Sequence[BlockRef]):
+        self._engine = engine
+        self._refs: List[BlockRef] = list(refs)
+        self._closed = False
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_pandas(cls, df, npartitions: Optional[int] = None,
+                    engine: Optional[EtlEngine] = None) -> "XShard":
+        """Split a driver DataFrame into row-range partitions (the
+        ``np.array_split`` size convention) stored as blocks."""
+        eng = engine or get_engine()
+        if npartitions is None:
+            cfg = global_config()
+            npartitions = (int(cfg.get("xshard.partitions") or 0)
+                           or eng.num_workers)
+        npartitions = max(1, int(npartitions))
+        n = len(df)
+        sizes = np.full(npartitions, n // npartitions, dtype=np.int64)
+        sizes[:n % npartitions] += 1
+        bounds = np.concatenate([[0], np.cumsum(sizes)])
+        cols_all = {c: np.ascontiguousarray(df[c].to_numpy())
+                    for c in df.columns}
+        refs = []
+        for i in range(npartitions):
+            lo, hi = int(bounds[i]), int(bounds[i + 1])
+            part = {c: a[lo:hi] for c, a in cols_all.items()}
+            refs.append(cls._store_parent(eng, part))
+        return cls(eng, refs)
+
+    @classmethod
+    def from_shards(cls, dfs: Sequence[Any],
+                    engine: Optional[EtlEngine] = None) -> "XShard":
+        """One partition per DataFrame (the DataShards bridge)."""
+        eng = engine or get_engine()
+        return cls(eng, [cls._store_parent(eng, _cols_of(df))
+                         for df in dfs])
+
+    @classmethod
+    def read_files(cls, paths: Sequence[str], fmt: str = "csv",
+                   engine: Optional[EtlEngine] = None,
+                   **pandas_kwargs) -> "XShard":
+        """Distributed ingest: one partition per file, each loaded by a
+        WORKER straight into its block — file bytes never materialize in
+        the driver."""
+        eng = engine or get_engine()
+        slabs = [eng.slabs.acquire() for _ in paths]
+        calls = [(_read_file_task,
+                  (p, fmt, pandas_kwargs, slab, eng.spill_dir,
+                   eng.new_tag()))
+                 for p, slab in zip(paths, slabs)]
+        refs = eng.run(calls)
+        cls._release_unused(eng, slabs, refs)
+        return cls(eng, refs)
+
+    @classmethod
+    def read_csv(cls, path: str, engine: Optional[EtlEngine] = None,
+                 **pandas_kwargs) -> "XShard":
+        from .shard import _expand
+        return cls.read_files(_expand(path, [".csv"]), "csv", engine,
+                              **pandas_kwargs)
+
+    @staticmethod
+    def _store_parent(eng: EtlEngine, cols: Dict[str, np.ndarray]
+                      ) -> BlockRef:
+        slab = eng.slabs.acquire()
+        ref = _store_block(cols, slab, eng.spill_dir, eng.new_tag())
+        if ref.kind != "shm":
+            eng.slabs.release(slab[0])
+        return ref
+
+    @staticmethod
+    def _release_unused(eng, slabs, refs) -> None:
+        used = {r.name for r in refs if r is not None and r.kind == "shm"}
+        for name, _ in slabs:
+            if name not in used:
+                eng.slabs.release(name)
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def schema(self):
+        return self._refs[0].schema if self._refs else ()
+
+    @property
+    def columns(self) -> List[str]:
+        return [c for c, _, _ in self.schema]
+
+    def num_partitions(self) -> int:
+        return len(self._refs)
+
+    def count(self) -> int:
+        return sum(r.rows for r in self._refs)
+
+    # -- ops -----------------------------------------------------------------
+
+    def _wave(self, make_call) -> List[BlockRef]:
+        eng = self._engine
+        slabs = [eng.slabs.acquire() for _ in self._refs]
+        calls = [make_call(ref, slab) for ref, slab in
+                 zip(self._refs, slabs)]
+        refs = eng.run(calls)
+        self._release_unused(eng, slabs, refs)
+        return refs
+
+    def map(self, fn) -> "XShard":
+        """Apply ``fn(df) -> df`` per partition in the worker fleet."""
+        blob = _pickler.dumps(fn)
+        eng = self._engine
+        return XShard(eng, self._wave(
+            lambda ref, slab: (_map_task, (ref, blob, slab, eng.spill_dir,
+                                           eng.new_tag()))))
+
+    def filter(self, pred) -> "XShard":
+        """Keep rows where ``pred(df)`` is True (vectorized take in the
+        worker — no per-row Python, no intermediate frame)."""
+        blob = _pickler.dumps(pred)
+        eng = self._engine
+        return XShard(eng, self._wave(
+            lambda ref, slab: (_filter_task, (ref, blob, slab,
+                                              eng.spill_dir,
+                                              eng.new_tag()))))
+
+    def groupby(self, keys) -> "_GroupedXShard":
+        keys = [keys] if isinstance(keys, str) else list(keys)
+        return _GroupedXShard(self, keys)
+
+    def _exchange(self, keys: Sequence[str], nparts: int
+                  ) -> List[BlockRef]:
+        """Stage A: bucket every partition by key hash into exchange
+        blocks (handed off by slab name, never concatenated)."""
+        eng = self._engine
+        slabs = [eng.slabs.acquire() for _ in self._refs]
+        calls = [(_exchange_task, (ref, tuple(keys), nparts, slab,
+                                   eng.spill_dir, eng.new_tag()))
+                 for ref, slab in zip(self._refs, slabs)]
+        refs = eng.run(calls)
+        self._release_unused(eng, slabs, refs)
+        return refs
+
+    def join(self, other: "XShard", on, how: str = "inner") -> "XShard":
+        """Hash-partitioned inner join (pandas ``merge`` row-order and
+        column-order contract per destination partition; global row
+        order is partition-major, as with any shuffle engine)."""
+        if how != "inner":
+            raise ValueError("XShard.join supports how='inner' only")
+        if other._engine is not self._engine:
+            raise ValueError("joined XShards must share an engine")
+        keys = [on] if isinstance(on, str) else list(on)
+        overlap = (set(self.columns) & set(other.columns)) - set(keys)
+        if overlap:
+            raise ValueError(
+                f"non-key columns overlap: {sorted(overlap)}; rename "
+                f"before joining (no suffix support)")
+        eng = self._engine
+        nparts = max(self.num_partitions(), other.num_partitions())
+        lex = self._exchange(keys, nparts)
+        rex = other._exchange(keys, nparts)
+        slabs = [eng.slabs.acquire() for _ in range(nparts)]
+        calls = [(_join_task, (tuple(lex), tuple(rex), j, tuple(keys),
+                               slab, eng.spill_dir, eng.new_tag()))
+                 for j, slab in enumerate(slabs)]
+        refs = eng.run(calls)
+        self._release_unused(eng, slabs, refs)
+        for ref in lex + rex:
+            eng.release_block(ref)
+        return XShard(eng, refs)
+
+    # -- materialization -----------------------------------------------------
+
+    def collect(self) -> List[Any]:
+        """Partitions as driver DataFrames (copied out of the slabs, so
+        they survive slab recycling)."""
+        import pandas as pd
+        out = []
+        for ref in self._refs:
+            cols, keep = _load_block(ref)
+            out.append(pd.DataFrame({c: np.array(a)
+                                     for c, a in cols.items()}))
+            del cols, keep
+        return out
+
+    def to_pandas(self):
+        """Driver-side materialization (debug/interop — NOT the training
+        path; ``to_featureset`` lowers without this concat)."""
+        import pandas as pd
+        frames = self.collect()
+        if len(frames) == 1:
+            return frames[0]
+        return pd.concat(frames, ignore_index=True)
+
+    def to_featureset(self, feature_cols, label_cols=None,
+                      stack: bool = True, feature_shape=None, **kwargs):
+        """Lower into a FeatureSet with ZERO full-dataset host copies:
+        workers write partition rows straight into one exact-size shared
+        feature/label segment and the FeatureSet wraps the views
+        (``data.handoff='gather'`` switches to the eager
+        concat-into-``from_dataframe`` baseline for A/B).
+
+        ``feature_shape`` reshapes the ``[N, K]`` feature matrix to
+        ``(N, *feature_shape)`` — a free view reshape, used by the Zouwu
+        rolling-window path to feed ``(lookback, features)`` sequence
+        models."""
+        from ..feature.featureset import FeatureSet
+        feature_cols = ([feature_cols] if isinstance(feature_cols, str)
+                        else list(feature_cols))
+        label_cols = ([label_cols] if isinstance(label_cols, str)
+                      else list(label_cols or []))
+        mode = str(global_config().get("data.handoff") or "slab")
+        if mode == "gather" or not stack:
+            return FeatureSet.from_dataframe(
+                self.to_pandas(), feature_cols, label_cols or None,
+                stack=stack, **kwargs)
+        total = self.count()
+        if total == 0:
+            raise ValueError("cannot lower an empty XShard to a "
+                             "FeatureSet")
+        schema = {c: (dt, tail) for c, dt, tail in self.schema}
+        for c in feature_cols + label_cols:
+            if c not in schema:
+                raise KeyError(f"column {c!r} not in shard schema "
+                               f"{sorted(schema)}")
+            if schema[c][1]:
+                raise ValueError(f"column {c!r} is array-valued; the "
+                                 f"slab handoff stacks scalar columns")
+        hschema = ((("__features__", "<f4", (len(feature_cols),)),)
+                   + tuple((c,) + schema[c] for c in label_cols))
+        eng = self._engine
+        shm = shared_memory.SharedMemory(
+            create=True, size=_block_nbytes(hschema, total))
+        try:
+            calls, row0 = [], 0
+            for ref in self._refs:
+                if ref.rows:
+                    calls.append((_handoff_task,
+                                  (ref, tuple(feature_cols),
+                                   tuple(label_cols), shm.name, row0,
+                                   hschema, total)))
+                row0 += ref.rows
+            eng.run(calls)
+        except BaseException:
+            shm.close()
+            shm.unlink()
+            raise
+        views = _block_views(shm.buf, hschema, total)
+        feats = views["__features__"]
+        if feature_shape is not None:
+            feats = feats.reshape((total,) + tuple(feature_shape))
+        labels: Any = tuple(views[c] for c in label_cols)
+        if len(labels) == 1:
+            labels = labels[0]
+        return FeatureSet.from_slab_views(
+            feats, labels if label_cols else None,
+            keepalive=SlabKeepAlive([shm]), **kwargs)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Release this shard's blocks back to the slab pool (and delete
+        its spill files). Also runs on GC."""
+        if self._closed:
+            return
+        self._closed = True
+        eng = self._engine
+        if eng is not None and not eng._closed:
+            for ref in self._refs:
+                eng.release_block(ref)
+        self._refs = []
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class _GroupedXShard:
+    """``xs.groupby(keys).agg({col: how})`` — the two-stage shuffle."""
+
+    def __init__(self, xs: XShard, keys: List[str]):
+        self._xs = xs
+        self._keys = keys
+
+    def agg(self, spec: Dict[str, str]) -> XShard:
+        """Aggregate with pandas ``groupby(keys, as_index=False,
+        sort=True).agg(spec)`` semantics per destination partition
+        (sum/count/mean/min/max; accumulation order matches pandas so
+        float sums are bit-identical)."""
+        xs, keys = self._xs, self._keys
+        eng = xs._engine
+        nparts = xs.num_partitions()
+        ex = xs._exchange(keys, nparts)
+        slabs = [eng.slabs.acquire() for _ in range(nparts)]
+        calls = [(_groupby_task, (tuple(ex), j, tuple(keys), dict(spec),
+                                  slab, eng.spill_dir, eng.new_tag()))
+                 for j, slab in enumerate(slabs)]
+        refs = eng.run(calls)
+        XShard._release_unused(eng, slabs, refs)
+        for ref in ex:
+            eng.release_block(ref)
+        return XShard(eng, refs)

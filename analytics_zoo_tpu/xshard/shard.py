@@ -53,13 +53,43 @@ class DataShards:
         return len(self.shards)
 
     def repartition(self, n: int) -> "DataShards":
-        """Rebalance pandas shards into ``n`` partitions."""
+        """Rebalance pandas shards into ``n`` partitions by row-range
+        offsets: each output part concatenates only the shard SLICES that
+        overlap its row range (``np.array_split`` size convention), so
+        the whole frame is never materialized in the driver — the seed
+        did a full ``pd.concat`` + per-part ``iloc``, two dataset-sized
+        copies."""
         import pandas as pd
-        whole = pd.concat(self.shards, ignore_index=True)
-        parts = np.array_split(np.arange(len(whole)), n)
-        return DataShards([whole.iloc[p].reset_index(drop=True)
-                           for p in parts], self.parallelism,
-                          self.use_processes)
+        n = max(1, int(n))
+        sizes = np.array([len(s) for s in self.shards], dtype=np.int64)
+        starts = np.concatenate([[0], np.cumsum(sizes)])
+        total = int(starts[-1])
+        part_sizes = np.full(n, total // n, dtype=np.int64)
+        part_sizes[:total % n] += 1
+        bounds = np.concatenate([[0], np.cumsum(part_sizes)])
+        parts = []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            i = max(0, int(np.searchsorted(starts, lo, side="right")) - 1)
+            pieces = []
+            while i < len(self.shards) and starts[i] < hi:
+                s0 = int(starts[i])
+                a, b = max(int(lo) - s0, 0), min(int(hi) - s0, int(sizes[i]))
+                if b > a:
+                    pieces.append(self.shards[i].iloc[a:b])
+                i += 1
+            if not pieces:
+                pieces = [self.shards[0].iloc[0:0]]
+            part = (pd.concat(pieces, ignore_index=True) if len(pieces) > 1
+                    else pieces[0].reset_index(drop=True))
+            parts.append(part)
+        return DataShards(parts, self.parallelism, self.use_processes)
+
+    def to_xshard(self, engine=None):
+        """Bridge into the partitioned ETL engine (one XShard block per
+        shard): shuffle ops, disk spill and the zero-copy
+        ``to_featureset`` handoff — see ``docs/xshard.md``."""
+        from .engine import XShard
+        return XShard.from_shards(self.shards, engine=engine)
 
     def to_featureset(self, feature_cols: Sequence[str],
                       label_cols: Optional[Sequence[str]] = None,
@@ -92,7 +122,16 @@ def _read(path: str, exts: Sequence[str], reader: Callable,
     files = _expand(path, exts)
     if not files:
         files = [path]
-    dfs = [reader(f, **pandas_kwargs) for f in files]
+    if len(files) > 1:
+        # fan file loads over a thread pool — a 100-file parquet dir
+        # cold-starts in parallel instead of one file at a time (pandas
+        # IO/decompression releases the GIL for long stretches)
+        with ThreadPoolExecutor(
+                max_workers=min(8, len(files), os.cpu_count() or 1)) as pool:
+            dfs = list(pool.map(
+                lambda f: reader(f, **pandas_kwargs), files))
+    else:
+        dfs = [reader(files[0], **pandas_kwargs)]
     shards = DataShards(dfs)
     if num_shards and num_shards != len(dfs):
         shards = shards.repartition(num_shards)

@@ -4,3 +4,5 @@ from .model.forecast import (  # noqa: F401
     Forecaster, LSTMForecaster, MTNetForecaster, Seq2SeqForecaster)
 from .model.anomaly import ThresholdDetector, ThresholdEstimator  # noqa: F401
 from .autots.forecast import AutoTSTrainer, TSPipeline  # noqa: F401
+from .tsshard import (  # noqa: F401
+    lag_feature_cols, roll_windows, rolled_featureset)

@@ -1,0 +1,71 @@
+"""Zouwu × XShard: rolling/lag feature windows computed IN the ETL
+engine, lowered zero-copy into a sequence-model FeatureSet.
+
+The reference's Zouwu rolls time-series windows in the driver (pandas
+``shift`` over the whole frame) before handing numpy arrays to a
+forecaster. Here the roll runs as an :meth:`XShard.map` wave — one
+partition per series (the natural Zouwu sharding: windows never cross a
+series boundary) — and :func:`rolled_featureset` lowers the lag columns
+straight into FeatureSet staging memory with ``feature_shape=(lookback,
+n_features)``, so ``Estimator.train`` reads sequence batches out of the
+slabs the ETL workers wrote. No window tensor is ever materialized in
+the driver.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+
+def lag_feature_cols(value_cols: Sequence[str], lookback: int
+                     ) -> List[str]:
+    """Time-major lag column order — oldest step first, value columns
+    within a step — so the flat ``[N, lookback * F]`` feature matrix
+    reshapes to ``(N, lookback, F)`` as a free view."""
+    return [f"{c}_lag{lookback - 1 - t}"
+            for t in range(lookback) for c in value_cols]
+
+
+def roll_windows(xs, value_cols: Sequence[str], lookback: int,
+                 horizon: int = 1, target_col: Optional[str] = None):
+    """Roll lag windows per partition (= per series): each output row
+    holds ``lookback`` trailing steps of every value column plus the
+    ``horizon``-step-ahead target. Returns ``(rolled_shard,
+    feature_cols)``; rows without a full window or future target are
+    dropped within their partition, so windows never leak across series
+    boundaries."""
+    value_cols = list(value_cols)
+    target_col = target_col or value_cols[0]
+    lookback = int(lookback)
+    horizon = int(horizon)
+    if lookback < 1 or horizon < 1:
+        raise ValueError("lookback and horizon must be >= 1")
+
+    def _roll(df):
+        import pandas as pd
+        out = {}
+        for t in range(lookback):  # lag count, not rows — shifts vectorize
+            shift = lookback - 1 - t
+            for c in value_cols:
+                out[f"{c}_lag{shift}"] = df[c].shift(shift)
+        out["target"] = df[target_col].shift(-horizon)
+        rolled = pd.DataFrame(out)
+        lo, hi = lookback - 1, len(df) - horizon
+        return rolled.iloc[lo:hi].reset_index(drop=True)
+
+    return xs.map(_roll), lag_feature_cols(value_cols, lookback)
+
+
+def rolled_featureset(xs, value_cols: Sequence[str], lookback: int,
+                      horizon: int = 1,
+                      target_col: Optional[str] = None, **kwargs
+                      ) -> Tuple[object, object]:
+    """Roll windows in the engine and lower them zero-copy: returns
+    ``(featureset, rolled_shard)`` where the FeatureSet's features are
+    ``(N, lookback, F)`` float32 views into worker-written slabs — ready
+    for a recurrent model under ``Estimator.train``."""
+    rolled, feature_cols = roll_windows(xs, value_cols, lookback,
+                                        horizon, target_col)
+    fs = rolled.to_featureset(
+        feature_cols, "target",
+        feature_shape=(lookback, len(list(value_cols))), **kwargs)
+    return fs, rolled

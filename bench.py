@@ -1070,6 +1070,113 @@ def bench_input_pipeline(batch_size: int = 256, steps: int = 30):
                         "run; the uint8-vs-f32 RATIO is the stable signal"})
 
 
+def bench_etl_to_train(rows: int = 200_000, nparts: int = 8,
+                       batch_size: int = 2048, epochs: int = 2):
+    """Distributed ETL → training handoff: a synthetic table goes through
+    the XShard engine (partition → per-partition transform wave →
+    ``to_featureset``) and straight into ``Estimator.train``. Two paths:
+
+    - slab (the zero-copy tentpole): ETL workers write partition rows
+      into ONE shared feature/label segment the FeatureSet wraps —
+      training batches read the bytes the workers wrote;
+    - gather (``data.handoff='gather'``): the eager baseline — concat
+      every partition in the driver, then copy again into feature
+      arrays.
+
+    The headline is the slab path's ingest→transform→train bytes/s; the
+    record also carries the zero-copy vs eager-gather ratio with BIT
+    parity of the resulting feature/label arrays asserted, plus a
+    per-stage attribution recorded through the step-phase profiler
+    (``loop="etl"`` series on the metrics page)."""
+    import pandas as pd
+
+    from analytics_zoo_tpu.common import metrics as zoo_metrics
+    from analytics_zoo_tpu.common import profiler as zoo_profiler
+    from analytics_zoo_tpu.common.config import global_config
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.xshard.engine import EtlEngine, XShard
+
+    init_tpu_context()
+    rs = np.random.RandomState(0)
+    df = pd.DataFrame({
+        "a": rs.rand(rows), "b": rs.rand(rows), "c": rs.rand(rows),
+        "y": rs.rand(rows).astype(np.float32)})
+    cfg = global_config()
+
+    def run(mode):
+        cfg.set("data.handoff", mode)
+        eng = EtlEngine(num_workers=min(4, os.cpu_count() or 1))
+        try:
+            stages = {}
+            t0 = time.perf_counter()
+            xs = XShard.from_pandas(df, nparts, engine=eng)
+            stages["partition"] = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            xs = xs.map(lambda d: d.assign(z=d.a * d.b + d.c))
+            stages["transform"] = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            fs = xs.to_featureset(["a", "b", "c", "z"], "y")
+            stages["handoff"] = time.perf_counter() - t0
+            payload = (np.asarray(fs.features).nbytes
+                       + np.asarray(fs.labels).nbytes)
+            est = _ratio_estimator()
+            t0 = time.perf_counter()
+            est.train(fs, batch_size=batch_size, epochs=epochs)
+            stages["train"] = time.perf_counter() - t0
+            total = sum(stages.values())
+            # feature/label copies survive engine close for the parity
+            # assert (the slab views are engine-independent, but copies
+            # make the comparison unambiguous)
+            feats = np.asarray(fs.features).copy()
+            labels = np.asarray(fs.labels).copy()
+            return stages, total, payload, feats, labels
+        finally:
+            cfg.unset("data.handoff")
+            eng.close()
+
+    run("slab")  # warm: XLA compile of the train step, forks, allocators
+    slab_stages, slab_s, payload, slab_x, slab_y = run("slab")
+    _note_partial(metric="etl_to_train_bytes_per_sec",
+                  value=round(payload / slab_s, 1), unit="bytes/s",
+                  slab_pipeline_s=round(slab_s, 3))
+    gather_stages, gather_s, _, gather_x, gather_y = run("gather")
+    if not (np.array_equal(slab_x, gather_x)
+            and np.array_equal(slab_y, gather_y)):
+        raise RuntimeError("zero-copy handoff diverged from the eager "
+                           "gather baseline")
+
+    # stage attribution through the step-phase profiler: the etl loop's
+    # phase series must land on the metrics page like train/eval phases
+    zoo_profiler.set_enabled(True)
+    try:
+        for phase, seconds in slab_stages.items():
+            zoo_profiler.record_phase("etl", phase, seconds)
+    finally:
+        zoo_profiler.set_enabled(False)
+    expo = zoo_metrics.expose_text()
+    profiler_ok = ("zoo_profile_phase_seconds" in expo
+                   and 'loop="etl"' in expo and 'phase="handoff"' in expo)
+
+    return _BenchResult(
+        metric="etl_to_train_bytes_per_sec",
+        value=round(payload / slab_s, 1),
+        unit="bytes/s", mfu=None,
+        detail={"rows": rows, "partitions": nparts,
+                "feature_payload_mb": round(payload / 1e6, 2),
+                "slab_stages_s": {k: round(v, 3)
+                                  for k, v in slab_stages.items()},
+                "gather_stages_s": {k: round(v, 3)
+                                    for k, v in gather_stages.items()},
+                "slab_pipeline_s": round(slab_s, 3),
+                "gather_pipeline_s": round(gather_s, 3),
+                "zero_copy_vs_gather_ratio": round(gather_s / slab_s, 2),
+                "handoff_parity_ok": True,
+                "profiler_etl_phases_ok": bool(profiler_ok),
+                "note": "ratio compares identical pipelines differing "
+                        "only in the handoff: shared-segment writes vs "
+                        "driver concat + copy; parity is bitwise"})
+
+
 def _bert_serving_rate(requests: int = 256, batch_size: int = 32,
                        seq_len: int = 128):
     """North-star #5 names ResNet AND BERT batch inference: token-tensor
@@ -2047,6 +2154,7 @@ _WORKLOADS = {
     "obs_overhead": bench_obs_overhead,
     "quantized": bench_quantized,
     "pipeline": bench_input_pipeline,
+    "etl_to_train": bench_etl_to_train,
 }
 
 # spelling aliases accepted on the CLI (resolved in main, NOT in the dict —
@@ -2660,6 +2768,50 @@ def _ratio_paged(lm, rs, new_tokens: int, plen: int, pstreams: int = 512,
                 paged_eff / max(contig_eff, 1e-9), 2)}
 
 
+def _ratio_etl():
+    """Zero-copy slab handoff vs eager gather on a small table — the
+    etl_to_train workload's A/B shrunk to CPU scale, bit parity
+    asserted."""
+    import pandas as pd
+
+    from analytics_zoo_tpu.common.config import global_config
+    from analytics_zoo_tpu.xshard.engine import EtlEngine, XShard
+
+    rs = np.random.RandomState(0)
+    n = 40_000
+    df = pd.DataFrame({"a": rs.rand(n), "b": rs.rand(n),
+                       "y": rs.rand(n).astype(np.float32)})
+    cfg = global_config()
+
+    def timed(mode):
+        cfg.set("data.handoff", mode)
+        eng = EtlEngine(num_workers=2)
+        try:
+            xs = XShard.from_pandas(df, 4, engine=eng).map(
+                lambda d: d.assign(z=d.a + d.b))
+            t0 = time.perf_counter()
+            fs = xs.to_featureset(["a", "b", "z"], "y")
+            dt = time.perf_counter() - t0
+            return dt, np.asarray(fs.features).copy(), \
+                np.asarray(fs.labels).copy()
+        finally:
+            cfg.unset("data.handoff")
+            eng.close()
+
+    timed("slab")  # warm forks + allocators
+    t_slab, x_slab, y_slab = timed("slab")
+    t_gather, x_gather, y_gather = timed("gather")
+    parity = bool(np.array_equal(x_slab, x_gather)
+                  and np.array_equal(y_slab, y_gather))
+    if not parity:
+        raise RuntimeError("slab handoff diverged from gather baseline")
+    return {"slab_handoff_s": round(t_slab, 4),
+            "gather_handoff_s": round(t_gather, 4),
+            "handoff_parity_ok": parity,
+            "zero_copy_vs_gather_ratio":
+                round(t_gather / max(t_slab, 1e-9), 2)}
+
+
 _RATIO_IMPLS = {
     "transfer": _ratio_transfer,
     "transform": _ratio_transform,
@@ -2670,6 +2822,7 @@ _RATIO_IMPLS = {
     "recovery": _ratio_recovery,
     "embed": _ratio_embed,
     "generate": _ratio_generate,
+    "etl": _ratio_etl,
 }
 
 #: every workload → (proxy impl, the detail key that becomes the record's
@@ -2690,6 +2843,7 @@ _RATIO_PLAN = {
     "obs_overhead": ("obs", "enabled_vs_disabled_record_ratio"),
     "recovery": ("recovery", "restore_vs_step_ratio"),
     "generate": ("generate", "batched_vs_serial_tokens_ratio"),
+    "etl_to_train": ("etl", "zero_copy_vs_gather_ratio"),
 }
 
 #: impl results shared across the workloads that proxy to the same impl
@@ -2805,6 +2959,7 @@ _BASELINE_DETAIL_KEYS = {
     "widedeep_sharded": ("hbm_roofline_fraction",
                          "sharded_vs_dense_samples_ratio"),
     "resnet50": ("hbm_roofline_fraction",),
+    "etl_to_train": ("zero_copy_vs_gather_ratio",),
 }
 
 
@@ -2907,6 +3062,8 @@ _COMPACT_KEYS = {
     "obs_overhead": ("overhead_under_2pct", "flow_chain_ok", "trace_pids"),
     "pipeline": (),
     "recovery": ("restore_ms", "recovery_vs_step", "parity_ok"),
+    "etl_to_train": ("zero_copy_vs_gather_ratio", "handoff_parity_ok",
+                     "profiler_etl_phases_ok"),
 }
 
 

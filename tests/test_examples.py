@@ -9,6 +9,14 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# the two heaviest smokes (~90s combined) run in the slow tier; their
+# subject matter keeps tier-1 coverage through test_objectdetection.py /
+# test_int8_dataflow.py
+_SLOW = {
+    "examples/imageclassification/int8_dataflow_train.py",
+    "examples/objectdetection/ssd_example.py",
+}
+
 EXAMPLES = [
     "examples/recommendation/ncf_example.py",
     "examples/recommendation/wide_and_deep_example.py",
@@ -38,8 +46,11 @@ EXAMPLES = [
 _NEEDS = {"examples/imageclassification/pretrained_import.py": "torch"}
 
 
-@pytest.mark.parametrize("script", EXAMPLES, ids=[os.path.basename(p)
-                                                  for p in EXAMPLES])
+@pytest.mark.parametrize(
+    "script",
+    [pytest.param(p, marks=[pytest.mark.slow] if p in _SLOW else [])
+     for p in EXAMPLES],
+    ids=[os.path.basename(p) for p in EXAMPLES])
 def test_example_smoke(script):
     if script in _NEEDS:
         pytest.importorskip(_NEEDS[script])

@@ -43,6 +43,17 @@ def test_lint_covers_data_plane_files():
             "_lookup_bwd_body", "_update_body"} <= funcs
 
 
+def test_lint_covers_etl_engine_bodies():
+    """The XShard shuffle kernels and exchange/gather/combine task bodies
+    must stay under the hot-path policy."""
+    files = {os.path.basename(row[0]) for row in _lint._CHECKS}
+    assert "engine.py" in files
+    funcs = {fn for row in _lint._CHECKS for fn in row[2]}
+    assert {"_mix64", "_bucket_order", "_join_match", "_stack_into",
+            "_exchange_task", "_gather_dest", "_groupby_task",
+            "_join_task", "_handoff_task", "_take_cols_into"} <= funcs
+
+
 def test_lint_catches_a_seeded_sync(tmp_path):
     """The checker itself must detect a seeded violation (guards against
     the lint rotting into a silent always-pass)."""
@@ -99,6 +110,41 @@ def test_lint_catches_seeded_embedding_regressions(tmp_path):
                               True, "body")
     whats = {w for _, _, w in found}
     assert {"one_hot()", "per-record Python loop", "float()"} <= whats
+
+
+def test_lint_catches_seeded_etl_regressions(tmp_path):
+    """A per-row Python loop in a shuffle kernel, or a full-frame
+    ``pd.concat`` / host sync in an exchange/gather body, must trip the
+    ETL rules (the seed-era gather-everything antipattern)."""
+    bad = tmp_path / "engine.py"
+    bad.write_text(
+        "def _bucket_order(dest, nparts):\n"
+        "    order = [i for i in range(len(dest)) if dest[i] == 0]\n"
+        "    return np.asarray(order)\n")
+    found = _lint._check_file(str(bad), None, _lint.ETL_KERNELS, (),
+                              True, "body")
+    whats = {w for _, _, w in found}
+    assert {"per-record Python loop", "np.asarray()"} <= whats
+
+    bad2 = tmp_path / "engine2.py"
+    bad2.write_text(
+        "def _gather_dest(refs, j):\n"
+        "    frames = load_all(refs)\n"
+        "    whole = pd.concat(frames, ignore_index=True)\n"
+        "    n = float(whole.size)\n"
+        "    return whole, n\n")
+    found = _lint._check_file(str(bad2), None, _lint.ETL_TASKS, (),
+                              False, "body")
+    assert {w for _, _, w in found} == {"pd.concat()", "float()"}
+
+
+def test_etl_bodies_are_policed_clean():
+    """The real ETL kernels/tasks must currently satisfy their own policy
+    — direct check, independent of _CHECKS."""
+    assert _lint._check_file(_lint.ENGINE_PY, None, _lint.ETL_KERNELS,
+                             (), True, "body") == []
+    assert _lint._check_file(_lint.ENGINE_PY, None, _lint.ETL_TASKS,
+                             (), False, "body") == []
 
 
 def test_embedding_bodies_are_policed_clean():

@@ -1,0 +1,490 @@
+"""Distributed XShard ETL engine: shared-memory shuffle, disk spill, and
+the zero-copy handoff into training.
+
+The contract under test everywhere: every distributed op (map / filter /
+groupby-agg / join) is BIT-IDENTICAL to the single-process pandas
+reference — not merely allclose — because the combine stage runs pandas'
+own kernels per destination partition; ``to_featureset`` lowers without a
+single full-dataset host copy (training batches read from the very slab
+bytes the ETL workers wrote); blocks over the slab budget spill to memmap
+files with identical results; and the worker fleet self-heals through
+SIGKILLs and transient task faults with exact results.
+"""
+import multiprocessing
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.common import faults
+from analytics_zoo_tpu.common.config import global_config
+from analytics_zoo_tpu.xshard import (DataShards, EtlEngine, XShard,
+                                      XShardWorkerError, read_csv)
+from analytics_zoo_tpu.xshard import engine as _eng
+from analytics_zoo_tpu.zouwu import (lag_feature_cols, roll_windows,
+                                     rolled_featureset)
+
+
+def make_df(n=200, seed=0, nkeys=17):
+    rs = np.random.RandomState(seed)
+    return pd.DataFrame({
+        "k": rs.randint(0, nkeys, n).astype(np.int64),
+        "g": rs.randint(0, 5, n).astype(np.int32),
+        "x": rs.rand(n).astype(np.float64),
+        "y": rs.rand(n).astype(np.float32),
+    })
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.reset()
+    yield
+    faults.reset()
+    cfg = global_config()
+    for key in ("data.handoff", "data.task_retries", "data.worker_respawns",
+                "xshard.num_workers", "xshard.partitions", "xshard.slab_mb",
+                "xshard.spill_dir"):
+        cfg.unset(key)
+
+
+@pytest.fixture()
+def eng():
+    e = EtlEngine(num_workers=2)
+    yield e
+    e.close()
+
+
+def exact_frames(got, want):
+    """Bit-exact frame comparison: same columns, dtypes, and VALUES —
+    float columns compared with ``==``, not a tolerance."""
+    assert list(got.columns) == list(want.columns)
+    assert len(got) == len(want)
+    for c in want.columns:
+        a, b = got[c].to_numpy(), want[c].to_numpy()
+        assert a.dtype == b.dtype, c
+        assert (a == b).all(), c
+
+
+class TestShuffleParity:
+    """map / filter / groupby / join vs single-process pandas, bitwise."""
+
+    def test_map_parity(self, ctx, eng):
+        df = make_df()
+        fn = lambda d: d.assign(z=d.x * 2.0 + d.y)  # noqa: E731
+        xs = XShard.from_pandas(df, 4, engine=eng)
+        got = xs.map(fn).to_pandas()
+        exact_frames(got, fn(df))
+
+    def test_filter_parity(self, ctx, eng):
+        df = make_df()
+        xs = XShard.from_pandas(df, 4, engine=eng)
+        got = xs.filter(lambda d: d.x > 0.5).to_pandas()
+        exact_frames(got, df[df.x > 0.5].reset_index(drop=True))
+
+    def test_groupby_sum_mean_bitwise(self, ctx, eng):
+        # float group sums in pandas >= 1.3 are Kahan-compensated; the
+        # engine must reproduce them BITWISE, which only holds because
+        # the combine stage runs pandas' own groupby per destination
+        df = make_df(n=500)
+        xs = XShard.from_pandas(df, 4, engine=eng)
+        got = (xs.groupby("k").agg({"x": "sum", "y": "mean"}).to_pandas()
+               .sort_values("k").reset_index(drop=True))
+        want = df.groupby("k", as_index=False).agg({"x": "sum", "y": "mean"})
+        exact_frames(got, want)
+
+    def test_groupby_multikey_min_max_count(self, ctx, eng):
+        df = make_df(n=400)
+        xs = XShard.from_pandas(df, 3, engine=eng)
+        got = (xs.groupby(["k", "g"])
+               .agg({"x": "min", "y": "max"}).to_pandas()
+               .sort_values(["k", "g"]).reset_index(drop=True))
+        want = df.groupby(["k", "g"], as_index=False).agg(
+            {"x": "min", "y": "max"})
+        exact_frames(got, want)
+
+    def test_join_parity(self, ctx, eng):
+        rs = np.random.RandomState(3)
+        left = pd.DataFrame({"k": rs.randint(0, 12, 150).astype(np.int64),
+                             "i": np.arange(150, dtype=np.int64),
+                             "x": rs.rand(150)})
+        right = pd.DataFrame({"k": rs.randint(0, 12, 60).astype(np.int64),
+                              "j": np.arange(60, dtype=np.int64),
+                              "w": rs.rand(60).astype(np.float32)})
+        xl = XShard.from_pandas(left, 4, engine=eng)
+        xr = XShard.from_pandas(right, 3, engine=eng)
+        got = (xl.join(xr, on="k").to_pandas()
+               .sort_values(["i", "j"]).reset_index(drop=True))
+        want = (left.merge(right, on="k", how="inner")
+                .sort_values(["i", "j"]).reset_index(drop=True))
+        exact_frames(got, want)
+
+    def test_join_guards(self, ctx, eng):
+        df = make_df(n=20)
+        xa = XShard.from_pandas(df, 2, engine=eng)
+        xb = XShard.from_pandas(df, 2, engine=eng)
+        with pytest.raises(ValueError, match="inner"):
+            xa.join(xb, on="k", how="left")
+        with pytest.raises(ValueError, match="overlap"):
+            xa.join(xb, on="k")  # g/x/y collide
+
+    def test_chained_pipeline_parity(self, ctx, eng):
+        df = make_df(n=300, seed=9)
+        xs = XShard.from_pandas(df, 4, engine=eng)
+        got = (xs.map(lambda d: d.assign(x2=d.x * d.x))
+               .filter(lambda d: d.g != 2)
+               .groupby("k").agg({"x2": "sum"}).to_pandas()
+               .sort_values("k").reset_index(drop=True))
+        ref = df.assign(x2=df.x * df.x)
+        ref = ref[ref.g != 2]
+        want = ref.groupby("k", as_index=False).agg({"x2": "sum"})
+        exact_frames(got, want)
+
+    def test_introspection_and_partition_convention(self, ctx, eng):
+        df = make_df(n=10)
+        xs = XShard.from_pandas(df, 3, engine=eng)
+        assert xs.num_partitions() == 3
+        assert xs.count() == 10
+        assert xs.columns == ["k", "g", "x", "y"]
+        # np.array_split size convention: 4, 3, 3
+        assert [r.rows for r in xs._refs] == [4, 3, 3]
+        parts = xs.collect()
+        exact_frames(pd.concat(parts, ignore_index=True), df)
+
+    def test_distributed_read_files(self, ctx, eng, tmp_path):
+        dfs = [make_df(n=30, seed=s) for s in range(3)]
+        for i, d in enumerate(dfs):
+            d.to_csv(tmp_path / f"part{i}.csv", index=False)
+        paths = sorted(str(p) for p in tmp_path.glob("*.csv"))
+        xs = XShard.read_files(paths, "csv", engine=eng)
+        assert xs.num_partitions() == 3
+        got = xs.to_pandas()
+        # the reference is what pandas itself reads back (csv round-trips
+        # widen int32/float32), loaded the single-process way
+        want = pd.concat([pd.read_csv(p) for p in paths],
+                         ignore_index=True)
+        pd.testing.assert_frame_equal(got, want, check_exact=True)
+
+
+class TestSpill:
+    """Partitions over the slab budget go through the memmap spill path
+    with identical results."""
+
+    def test_spill_bit_parity_and_cleanup(self, ctx):
+        e = EtlEngine(num_workers=2, slab_bytes=1024)  # everything spills
+        spill_dir = e.spill_dir
+        before = _eng._M_SPILL.value()
+        try:
+            df = make_df(n=2000)
+            xs = XShard.from_pandas(df, 4, engine=e)
+            assert all(r.kind == "mmap" for r in xs._refs)
+            got = (xs.groupby("k").agg({"x": "sum"}).to_pandas()
+                   .sort_values("k").reset_index(drop=True))
+            exact_frames(got, df.groupby("k", as_index=False)
+                         .agg({"x": "sum"}))
+            assert _eng._M_SPILL.value() > before
+            assert any(f.endswith(".mmap") for f in os.listdir(spill_dir))
+        finally:
+            e.close()
+        assert not os.path.exists(spill_dir)  # own temp dir removed
+
+    def test_spilled_handoff_matches_slab_handoff(self, ctx):
+        df = make_df(n=600)
+        small = EtlEngine(num_workers=2, slab_bytes=512)
+        big = EtlEngine(num_workers=2)
+        try:
+            fa = XShard.from_pandas(df, 3, engine=small).to_featureset(
+                ["x", "y"], "g")
+            fb = XShard.from_pandas(df, 3, engine=big).to_featureset(
+                ["x", "y"], "g")
+            np.testing.assert_array_equal(np.asarray(fa.features),
+                                          np.asarray(fb.features))
+            np.testing.assert_array_equal(np.asarray(fa.labels),
+                                          np.asarray(fb.labels))
+        finally:
+            small.close()
+            big.close()
+
+
+class TestZeroCopyHandoff:
+    """to_featureset writes partition rows straight into ONE shared
+    segment the FeatureSet wraps — no driver concat, no second copy."""
+
+    def test_matches_from_dataframe_exactly(self, ctx, eng):
+        from analytics_zoo_tpu.feature.featureset import FeatureSet
+        df = make_df(n=257)  # odd size: uneven partition tails
+        fs = XShard.from_pandas(df, 4, engine=eng).to_featureset(
+            ["x", "y"], "g")
+        ref = FeatureSet.from_dataframe(df, ["x", "y"], ["g"], stack=True)
+        got_x, want_x = np.asarray(fs.features), np.asarray(ref.features)
+        assert got_x.dtype == want_x.dtype == np.float32
+        np.testing.assert_array_equal(got_x, want_x)
+        got_y, want_y = np.asarray(fs.labels), np.asarray(ref.labels)
+        assert got_y.dtype == want_y.dtype  # label dtype preserved
+        np.testing.assert_array_equal(got_y, want_y)
+
+    def test_no_driver_concat_or_dataframe_rebuild(self, ctx, eng,
+                                                   monkeypatch):
+        """The slab path must never route through pd.concat or
+        from_dataframe in the DRIVER (workers are already forked, so
+        their legitimate pandas use is untouched)."""
+        from analytics_zoo_tpu.feature import featureset as fsmod
+        df = make_df(n=100)
+        xs = XShard.from_pandas(df, 3, engine=eng)
+
+        def boom(*a, **k):
+            raise AssertionError("full-dataset gather in the driver")
+
+        monkeypatch.setattr(pd, "concat", boom)
+        monkeypatch.setattr(fsmod.FeatureSet, "from_dataframe",
+                            classmethod(boom))
+        fs = xs.to_featureset(["x", "y"], "g")
+        assert np.asarray(fs.features).shape == (100, 2)
+
+    def test_batches_read_worker_written_slab_bytes(self, ctx, eng):
+        """Memory-sharing proof: the FeatureSet's arrays ARE views into
+        the handoff segment, and a batch drawn after mutating the segment
+        observes the mutation — training reads the ETL workers' bytes."""
+        df = make_df(n=64)
+        fs = XShard.from_pandas(df, 2, engine=eng).to_featureset(
+            ["x", "y"], "g")
+        shm = fs._shm_keepalive._shms[0]
+        feats = fs.features
+        assert np.shares_memory(
+            feats, np.frombuffer(shm.buf, dtype=np.uint8))
+        first = np.asarray(next(iter(fs.eval_iterator(16)))[0]).copy()
+        np.testing.assert_array_equal(first[0],
+                                      df[["x", "y"]].to_numpy(np.float32)[0])
+        feats[0, 0] += 7.0  # scribble on the slab view...
+        again = np.asarray(next(iter(fs.eval_iterator(16)))[0])
+        assert again[0, 0] == first[0, 0] + np.float32(7.0)  # ...batch sees it
+
+    def test_gather_mode_is_bit_identical_baseline(self, ctx, eng):
+        df = make_df(n=120)
+        xs = XShard.from_pandas(df, 3, engine=eng)
+        slab = xs.to_featureset(["x", "y"], "g")
+        global_config().set("data.handoff", "gather")
+        eager = xs.to_featureset(["x", "y"], "g")
+        np.testing.assert_array_equal(np.asarray(slab.features),
+                                      np.asarray(eager.features))
+        np.testing.assert_array_equal(np.asarray(slab.labels),
+                                      np.asarray(eager.labels))
+
+    def test_feature_shape_is_a_free_view_reshape(self, ctx, eng):
+        df = make_df(n=40)
+        fs = XShard.from_pandas(df, 2, engine=eng).to_featureset(
+            ["x", "y"], "g", feature_shape=(2, 1))
+        assert np.asarray(fs.features).shape == (40, 2, 1)
+
+    def test_bad_inputs_raise(self, ctx, eng):
+        df = make_df(n=30)
+        xs = XShard.from_pandas(df, 2, engine=eng)
+        with pytest.raises(KeyError, match="nope"):
+            xs.to_featureset(["nope"])
+        empty = xs.filter(lambda d: d.x > 2.0)
+        with pytest.raises(ValueError, match="empty"):
+            empty.to_featureset(["x"])
+
+    def test_trains_through_estimator(self, ctx, eng):
+        from analytics_zoo_tpu.estimator import Estimator
+        from analytics_zoo_tpu.keras import (Sequential, objectives,
+                                             optimizers)
+        from analytics_zoo_tpu.keras.layers import Dense
+        df = make_df(n=128, seed=5)
+        fs = (XShard.from_pandas(df, 4, engine=eng)
+              .map(lambda d: d.assign(z=d.x - d.y))
+              .to_featureset(["x", "y", "z"], "g"))
+        est = Estimator(
+            model=Sequential([Dense(8, activation="relu"), Dense(1)]),
+            loss_fn=objectives.get("mse"), optimizer=optimizers.SGD(0.01))
+        out = est.train(fs, batch_size=32, epochs=2)
+        assert out["iterations"] == 8
+        assert np.isfinite(out["loss_history"]).all()
+
+
+class TestSelfHealing:
+    """The ETL fleet survives SIGKILLed workers (respawn + resubmit) and
+    transient task faults (``data.task_retries``) with EXACT results."""
+
+    def test_sigkilled_worker_respawns_results_exact(self, ctx):
+        df = make_df(n=300)
+        want = df.groupby("k", as_index=False).agg({"x": "sum"})
+        faults.arm("xshard.kill", at=2, budget=1)  # before the pool forks
+        e = EtlEngine(num_workers=2)
+        try:
+            got = (XShard.from_pandas(df, 4, engine=e)
+                   .groupby("k").agg({"x": "sum"}).to_pandas()
+                   .sort_values("k").reset_index(drop=True))
+        finally:
+            e.close()
+        assert faults.fire_count("xshard.kill") == 1
+        exact_frames(got, want)
+
+    def test_task_retries_absorb_transient_faults(self, ctx):
+        global_config().set("data.task_retries", 2)
+        faults.arm("xshard.task", at=1, budget=1)
+        df = make_df(n=100)
+        e = EtlEngine(num_workers=2)
+        try:
+            got = (XShard.from_pandas(df, 3, engine=e)
+                   .map(lambda d: d.assign(z=d.x + 1.0)).to_pandas())
+        finally:
+            e.close()
+        assert faults.fire_count("xshard.task") == 1
+        exact_frames(got, df.assign(z=df.x + 1.0))
+
+    def test_retry_budget_exhausts_to_error(self, ctx):
+        faults.arm("xshard.task", p=1.0, budget=100)
+        e = EtlEngine(num_workers=2)
+        try:
+            with pytest.raises(XShardWorkerError, match="injected fault"):
+                XShard.from_pandas(make_df(n=40), 2, engine=e).map(
+                    lambda d: d).collect()
+        finally:
+            e.close()
+
+    def test_respawn_budget_exhausts_promptly(self, ctx):
+        import time
+        global_config().set("data.worker_respawns", 0)
+        faults.arm("xshard.kill", at=1, budget=1)
+        e = EtlEngine(num_workers=2)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(XShardWorkerError, match="worker died"):
+                XShard.from_pandas(make_df(n=40), 2, engine=e).map(
+                    lambda d: d).collect()
+            assert time.monotonic() - t0 < 10
+        finally:
+            e.close()
+
+    def test_close_leaves_no_children(self, ctx):
+        e = EtlEngine(num_workers=2)
+        XShard.from_pandas(make_df(n=40), 2, engine=e).map(
+            lambda d: d.assign(z=d.x)).collect()
+        e.close()
+        ours = [p for p in multiprocessing.active_children()
+                if p.name.startswith("zoo-xshard-worker")]
+        assert ours == []
+
+
+class TestDataShardsSatellites:
+    """repartition by row-range offsets; parallel multi-file reads; the
+    to_xshard bridge."""
+
+    def test_repartition_row_ranges(self, ctx):
+        dfs = [make_df(n=n, seed=i) for i, n in enumerate((5, 3, 7))]
+        ds = DataShards(dfs)
+        want = pd.concat(dfs, ignore_index=True)
+        for n in (1, 2, 4, 6):
+            rp = ds.repartition(n)
+            assert rp.num_partitions() == n
+            sizes = [len(s) for s in rp.shards]
+            assert sizes == [15 // n + (1 if i < 15 % n else 0)
+                             for i in range(n)]
+            pd.testing.assert_frame_equal(rp.concat_to_pandas(), want,
+                                          check_exact=True)
+
+    def test_repartition_more_parts_than_rows(self, ctx):
+        ds = DataShards([make_df(n=2), make_df(n=1, seed=1)])
+        rp = ds.repartition(5)
+        assert [len(s) for s in rp.shards] == [1, 1, 1, 0, 0]
+        assert list(rp.shards[4].columns) == ["k", "g", "x", "y"]
+        pd.testing.assert_frame_equal(rp.concat_to_pandas(),
+                                      ds.concat_to_pandas(),
+                                      check_exact=True)
+
+    def test_read_csv_many_files_in_parallel(self, ctx, tmp_path):
+        dfs = [make_df(n=20, seed=s) for s in range(4)]
+        for i, d in enumerate(dfs):
+            d.to_csv(tmp_path / f"f{i}.csv", index=False)
+        ds = read_csv(str(tmp_path))
+        assert ds.num_partitions() == 4  # one shard per file, sorted order
+        want = pd.concat(
+            [pd.read_csv(tmp_path / f"f{i}.csv") for i in range(4)],
+            ignore_index=True)  # csv round-trips widen int32/float32
+        pd.testing.assert_frame_equal(ds.concat_to_pandas(), want,
+                                      check_exact=True)
+
+    def test_to_xshard_bridge(self, ctx, eng):
+        dfs = [make_df(n=10, seed=s) for s in range(3)]
+        xs = DataShards(dfs).to_xshard(engine=eng)
+        assert xs.num_partitions() == 3
+        exact_frames(xs.to_pandas(), pd.concat(dfs, ignore_index=True))
+
+
+class TestZouwuCapstone:
+    """Rolling/lag windows computed IN the engine feed a sequence model
+    straight from the slabs."""
+
+    def _series(self, n, s0):
+        t = np.arange(n, dtype=np.float64)
+        return pd.DataFrame({
+            "v": np.sin(0.1 * t + s0).astype(np.float64),
+            "u": np.cos(0.07 * t + s0).astype(np.float64)})
+
+    def test_roll_windows_per_series_parity(self, ctx, eng):
+        s1, s2 = self._series(30, 0.0), self._series(24, 1.0)
+        xs = XShard.from_shards([s1, s2], engine=eng)
+        rolled, cols = roll_windows(xs, ["v", "u"], lookback=3, horizon=2,
+                                    target_col="v")
+        assert cols == lag_feature_cols(["v", "u"], 3)
+        assert cols[:3] == ["v_lag2", "u_lag2", "v_lag1"]  # time-major
+        parts = rolled.collect()
+        # windows never cross the series boundary
+        assert [len(p) for p in parts] == [30 - 2 - 2, 24 - 2 - 2]
+        ref = s1
+        want_first = ref.v.to_numpy()[0:3]  # oldest..newest of window 0
+        got = parts[0]
+        np.testing.assert_array_equal(
+            got[["v_lag2", "v_lag1", "v_lag0"]].to_numpy()[0], want_first)
+        np.testing.assert_array_equal(got["target"].to_numpy(),
+                                      ref.v.to_numpy()[4:])
+
+    def test_rolled_featureset_trains_recurrent_model(self, ctx, eng):
+        from analytics_zoo_tpu.estimator import Estimator
+        from analytics_zoo_tpu.keras import (Sequential, objectives,
+                                             optimizers)
+        from analytics_zoo_tpu.keras.layers import GRU, Dense
+        xs = XShard.from_shards(
+            [self._series(40, 0.0), self._series(40, 2.0)], engine=eng)
+        fs, rolled = rolled_featureset(xs, ["v", "u"], lookback=4,
+                                       horizon=1)
+        n = rolled.count()
+        assert np.asarray(fs.features).shape == (n, 4, 2)
+        # sequence features are float32 views over worker-written slabs
+        assert np.shares_memory(
+            fs.features,
+            np.frombuffer(fs._shm_keepalive._shms[0].buf, dtype=np.uint8))
+        est = Estimator(model=Sequential([GRU(6), Dense(1)]),
+                        loss_fn=objectives.get("mse"),
+                        optimizer=optimizers.SGD(0.05))
+        out = est.train(fs, batch_size=24, epochs=2)
+        assert np.isfinite(out["loss_history"]).all()
+
+
+@pytest.mark.slow
+class TestEtlSweep:
+    """Heavy end-to-end sweep: larger tables, every op, spill on and off,
+    all bit-identical to pandas."""
+
+    @pytest.mark.parametrize("slab_bytes", [None, 4096])
+    def test_full_pipeline_sweep(self, ctx, slab_bytes):
+        e = (EtlEngine(num_workers=3) if slab_bytes is None
+             else EtlEngine(num_workers=3, slab_bytes=slab_bytes))
+        try:
+            for n, nkeys, nparts in ((3000, 7, 5), (10000, 257, 8)):
+                df = make_df(n=n, seed=n, nkeys=nkeys)
+                xs = XShard.from_pandas(df, nparts, engine=e)
+                got = (xs.map(lambda d: d.assign(z=d.x * d.y))
+                       .filter(lambda d: d.k % 3 != 1)
+                       .groupby(["k", "g"])
+                       .agg({"z": "sum", "x": "mean", "y": "max"})
+                       .to_pandas().sort_values(["k", "g"])
+                       .reset_index(drop=True))
+                ref = df.assign(z=df.x * df.y)
+                ref = ref[ref.k % 3 != 1]
+                want = ref.groupby(["k", "g"], as_index=False).agg(
+                    {"z": "sum", "x": "mean", "y": "max"})
+                exact_frames(got, want)
+        finally:
+            e.close()

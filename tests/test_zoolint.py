@@ -272,6 +272,11 @@ HOST_STAGING_ROWS = {
     "_cached_batches", "_gather", "_produce", "_transformed_batches",
     "eval_iterator", "init_paged_pool", "init_slot_cache",
     "masked_eval_batches", "train_iterator",
+    # XShard ETL engine bodies: host-side numpy/pandas shuffle kernels in
+    # forked workers — never traced, so jit discovery can't see them
+    "_bucket_order", "_exchange_task", "_filter_task", "_gather_dest",
+    "_groupby_task", "_handoff_task", "_join_match", "_join_task",
+    "_mix64", "_stack_into", "_take_cols_into",
 }
 
 
