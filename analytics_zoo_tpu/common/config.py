@@ -272,3 +272,16 @@ _global_config.register("embed.cold_lr", 0.01,
                         "rows (applied eagerly on the host inside the "
                         "backward callback; independent of the device "
                         "optimizer).")
+_global_config.register("fleet.stale_after_s", 5.0,
+                        "Health-file age beyond which the fleet router "
+                        "treats an instance as dead: its spool is "
+                        "reclaimed and its in-flight streams fail over "
+                        "from their last streamed prefix.")
+_global_config.register("fleet.health_refresh_s", 0.25,
+                        "Router cadence for re-reading per-instance "
+                        "health files (placement gauges refresh at most "
+                        "this often).")
+_global_config.register("fleet.scale_headroom", 1.25,
+                        "Multiplier on observed demand when computing the "
+                        "fleet.desired_instances scale signal (>1 keeps "
+                        "spare capacity for failover).")

@@ -138,6 +138,10 @@ REGISTRY: Dict[str, Site] = {
         "the request must be SHED with a terminal page-shed error while "
         "every resident stream keeps decoding (no crash, no stall)",
         kind="flag"),
+    "fleet.route": Site(
+        "fleet router placement, once per routed request — a failed "
+        "placement pass must park the request in the router backlog and "
+        "retry it next pass (never lost, never double-enqueued)"),
 }
 
 

@@ -38,6 +38,8 @@ DECODE_PY = os.path.join(REPO_ROOT, "analytics_zoo_tpu", "ops", "decode.py")
 LM_PY = os.path.join(REPO_ROOT, "analytics_zoo_tpu", "capture", "lm.py")
 SERVER_PY = os.path.join(REPO_ROOT, "analytics_zoo_tpu", "serving",
                          "server.py")
+FLEET_PY = os.path.join(REPO_ROOT, "analytics_zoo_tpu", "serving",
+                        "fleet.py")
 ENGINE_PY = os.path.join(REPO_ROOT, "analytics_zoo_tpu", "xshard",
                          "engine.py")
 
@@ -89,6 +91,10 @@ _CHECKS: List[Tuple[str, Optional[str], Sequence[str], Sequence[str],
      ("_dispatch_step", "_insert_request_device", "_insert_request_paged",
       "_insert_request_spec", "_insert_suffix_paged", "_copy_page_device",
       "_evict_slots"), (), True, "body"),
+    # the fleet router's placement scoring runs once per routed request:
+    # it must stay a single vectorized pass over the instance-gauge
+    # arrays — no host syncs, no per-request Python loop over instances
+    (FLEET_PY, None, ("_score_instances",), (), True, "body"),
     (ENGINE_PY, None, ETL_KERNELS, (), True, "body"),
     (ENGINE_PY, None, ETL_TASKS, (), False, "body"),
 ]

@@ -48,7 +48,8 @@ _GAUGE_UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio", "_depth")
 #: gauges that are genuinely unitless: live request/slot counts and the
 #: info-style constant-1 build gauge (labels carry the payload)
 _GAUGE_UNITLESS_OK = {"serving.in_flight", "serving.slots_occupied",
-                      "serving.kv_pages_free", "build.info"}
+                      "serving.kv_pages_free", "build.info",
+                      "fleet.instances_alive", "fleet.desired_instances"}
 
 
 def _is_registration(node: ast.Call) -> bool:

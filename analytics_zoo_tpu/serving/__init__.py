@@ -3,6 +3,8 @@
 batched TPU inference → result write-back with backpressure."""
 from .client import InputQueue, OutputQueue  # noqa: F401
 from .config import ServingConfig  # noqa: F401
+from .fleet import (FLEET_SHED_ERROR, FleetInstance,  # noqa: F401
+                    FleetRouter, instance_queue, read_health)
 from .queues import FileQueue, QueueBackend, RedisQueue, make_queue  # noqa: F401
 from .server import (ClusterServing, GenerativeServing,  # noqa: F401
                      ModelReloadError)

@@ -65,19 +65,34 @@ class InputQueue(_API):
     def enqueue_prompt(self, uri: str, tokens,
                        deadline_ms: Optional[int] = None,
                        max_new_tokens: Optional[int] = None,
-                       seed: Optional[int] = None) -> None:
+                       seed: Optional[int] = None,
+                       prefix=None) -> None:
         """Generative request: ``tokens`` is the int prompt sequence.
         ``max_new_tokens`` caps this stream (else the server's config
         budget applies); ``seed`` makes sampled decoding reproducible
         per-request. With a ``deadline_ms``, the budget is enforced PER
         TOKEN — an expired stream is evicted mid-flight with a deadline
-        error as its one terminal result."""
+        error as its one terminal result.
+
+        ``prefix`` resumes a stream that already decoded some tokens
+        elsewhere: the server re-prefills ``prompt + prefix`` and
+        continues token-identically (the fleet router uses this for
+        continuation-on-failover — docs/fleet.md; with ``prefix`` a
+        sampled stream must also pass its original ``seed``).
+
+        Routed fleets change NOTHING here: point the client at the fleet
+        FRONT spool and the router places the request on an instance
+        whose results land back in the same front ``results/`` this
+        client polls (``serving.fleet.instance_queue``)."""
         payload: Dict[str, Any] = {
             "prompt": [int(t) for t in np.asarray(tokens).reshape(-1)]}
         if max_new_tokens is not None:
             payload["max_new_tokens"] = int(max_new_tokens)
         if seed is not None:
             payload["seed"] = int(seed)
+        if prefix is not None:
+            payload["prefix"] = [int(t) for t in
+                                 np.asarray(prefix).reshape(-1)]
         self.queue.enqueue(uri, self._stamp(payload, deadline_ms))
 
 

@@ -1,0 +1,454 @@
+"""Fleet tier: a telemetry-driven router/admission layer over N serving
+instances (docs/fleet.md).
+
+The reference platform scales serving by adding containers behind ONE
+shared Redis queue — every server pulls blindly, so a hot instance and an
+idle one look identical to the work, and a dead server's claimed requests
+sit in its PEL until a lease expires. This module makes the *fleet* the
+unit of design instead:
+
+- **Per-instance queues.** Each server gets its own request spool
+  (:func:`instance_queue` — a FileQueue under ``<root>/inst/<name>`` whose
+  results land in the FRONT spool, so clients poll one place no matter
+  which instance answers). Clients keep enqueueing to the front; the
+  router is the only consumer of the front spool.
+- **Telemetry-driven placement.** The router reads each instance's
+  ``health.json`` (queue depth, in-flight, EWMA service time, per-instance
+  p99, ``slots_occupied``, ``kv_pages_free``, claim age) and places every
+  request on the instance with the lowest *estimated completion time* —
+  least-loaded for one-shot predicts, slot/page-aware for generative
+  joins. The scoring body (:func:`_score_instances`) is pure vectorized
+  numpy over the instance axis and is policed by the zoolint hot-path
+  pass: no host syncs, no per-request Python loops over instance gauges.
+- **Shed before enqueue.** When no instance can meet a request's deadline
+  the router answers ``FLEET_SHED_ERROR`` immediately — the client learns
+  in one poll instead of burning queue time to a deadline error.
+- **Continuation-on-failover.** A stale health file (``health_age_s`` past
+  ``fleet.stale_after_s``) marks an instance dead: its unstarted spool is
+  reclaimed, and every stream the router had assigned to it is re-enqueued
+  carrying the accumulated token ``prefix`` (+ sampling ``seed``) from its
+  last partial result. The adopting server re-prefills ``prompt + prefix``
+  through the same bucketed prefill path serial ``generate()`` uses and
+  continues the stream **token-identically** (``server.py _join``).
+- **Scale signals.** ``fleet.instances_alive`` / ``fleet.desired_instances``
+  gauges give an autoscaler the observed and target fleet size; headroom
+  is ``fleet.scale_headroom``.
+
+The router never holds the only copy of a request: anything claimed from
+the front spool lives in the router backlog or an instance spool or the
+``_assigned`` failover map until its ONE terminal result lands — the
+``fleet.route`` fault site proves a failed placement pass parks work in
+the backlog rather than losing it.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common import faults, file_io
+from ..common import metrics as _metrics
+from ..common.config import global_config
+from ..common.utils import wall_clock
+from .queues import FileQueue, QueueBackend
+from .server import DEADLINE_ERROR
+
+logger = logging.getLogger("analytics_zoo_tpu.serving")
+
+#: terminal error text for router-level admission shed (clients match it)
+FLEET_SHED_ERROR = "shed: no instance can meet the deadline"
+
+#: states a router may place NEW work on (idle = constructed, stepped
+#: manually or not yet started — still claims from its spool)
+_ROUTABLE_STATES = ("running", "idle")
+#: terminal states: the instance will never claim again — reclaim its
+#: spool and fail its streams over immediately, don't wait for staleness
+_DEAD_STATES = ("crashed", "stopped", "drained")
+
+_M_ROUTED = _metrics.counter(
+    "fleet.routed_total", "Requests placed on an instance by the router.",
+    labels=("instance",))
+_M_SHED = _metrics.counter(
+    "fleet.shed_total",
+    "Requests shed by the router before enqueue (no instance could meet "
+    "the deadline).")
+_M_EXPIRED = _metrics.counter(
+    "fleet.expired_total",
+    "Requests already past their deadline at routing time.")
+_M_FAILOVERS = _metrics.counter(
+    "fleet.failovers_total",
+    "Streams re-enqueued with their token prefix after their instance "
+    "died or drained.")
+_M_ALIVE = _metrics.gauge(
+    "fleet.instances_alive",
+    "Instances with a fresh health file in a routable state.")
+_M_DESIRED = _metrics.gauge(
+    "fleet.desired_instances",
+    "Scale signal: instances needed for observed demand x headroom.")
+_M_BACKLOG = _metrics.gauge(
+    "fleet.backlog_depth",
+    "Requests parked in the router awaiting a routable instance.")
+_M_ROUTE_PASS = _metrics.histogram(
+    "fleet.route_pass_seconds", "Wall seconds per route_once() pass.")
+
+
+def read_health(path: str, now: Optional[float] = None) -> Optional[Dict]:
+    """Read an instance's ``health.json`` and stamp its **age**: the
+    snapshot's gauges froze at ``snap['time']``, so consumers must not
+    trust them without knowing how stale they are. Returns the snapshot
+    with ``health_age_s`` added, or ``None`` when the file is missing or
+    unreadable (an instance that never came up)."""
+    try:
+        with file_io.fopen(path) as f:
+            snap = json.loads(f.read())
+    except (OSError, ValueError, FileNotFoundError):
+        return None
+    if not isinstance(snap, dict) or "time" not in snap:
+        return None
+    t = now if now is not None else wall_clock()
+    snap["health_age_s"] = max(0.0, t - float(snap["time"]))
+    return snap
+
+
+def instance_queue(root: str, name: str) -> FileQueue:
+    """A per-instance request spool under the fleet front spool: requests
+    at ``<root>/inst/<name>``, results shared with the front's
+    ``results/`` so placement stays invisible to clients."""
+    return FileQueue(file_io.join(root, "inst", name), results_root=root)
+
+
+@dataclass
+class FleetInstance:
+    """One routable serving instance: its private queue, the health file
+    its server writes, and its slot count (decode slots for generative
+    servers, concurrent batch capacity for one-shot predict servers)."""
+    name: str
+    queue: QueueBackend
+    health_path: str
+    slots: int = 1
+    #: latest health snapshot (with health_age_s), None before first read
+    health: Optional[Dict[str, Any]] = field(default=None, repr=False)
+
+
+def _score_instances(alive, depth, in_flight, slots_free, pages_free,
+                     service_s, token_s, need_tokens, need_pages):
+    """Estimated completion seconds per instance for ONE request —
+    vectorized over the instance axis (policed by the zoolint hot-path
+    pass: no host syncs, no Python loops). ``np.inf`` marks an instance
+    the request must not be placed on.
+
+    One-shot predicts (``need_tokens == 0``) queue behind the backlog at
+    the instance's EWMA service time. Generative joins wait for a free
+    slot (when none is free, a resident stream must run out first — the
+    backlog-scaled slot wait), then stream the remaining budget at the
+    instance's per-token EWMA; an instance whose free KV pages cannot hold
+    the stream yet pays a retirement-wait penalty per missing page."""
+    backlog = depth + in_flight
+    one_shot = (backlog + 1.0) * service_s
+    slot_wait = np.where(slots_free > 0.5, 0.0,
+                         (backlog + 1.0) * need_tokens * token_s)
+    gen = slot_wait + need_tokens * token_s
+    est = np.where(need_tokens > 0.5, gen, one_shot)
+    page_short = np.maximum(need_pages - np.maximum(pages_free, 0.0), 0.0)
+    est = est + np.where((pages_free > -0.5) & (need_pages > 0.5),
+                         page_short * token_s * 4.0, 0.0)
+    return np.where(alive, est, np.inf)
+
+
+class FleetRouter:
+    """Route requests from a FRONT queue onto per-instance queues by
+    estimated completion time; reclaim and fail over the work of dead
+    instances; emit scale signals. Drive with :meth:`route_once` (tests)
+    or :meth:`start`/:meth:`stop` (a background thread)."""
+
+    def __init__(self, front: QueueBackend,
+                 instances: List[FleetInstance], *,
+                 stale_after_s: Optional[float] = None,
+                 health_refresh_s: Optional[float] = None,
+                 scale_headroom: Optional[float] = None,
+                 default_deadline_ms: Optional[float] = None,
+                 default_max_new_tokens: int = 32,
+                 default_service_s: float = 0.05,
+                 default_token_s: float = 0.02,
+                 page_len: int = 0,
+                 settle_batch: int = 128):
+        cfg = global_config()
+        self.front = front
+        self.instances = list(instances)
+        self.stale_after_s = (float(stale_after_s) if stale_after_s
+                              is not None
+                              else float(cfg.get("fleet.stale_after_s")))
+        self.health_refresh_s = (
+            float(health_refresh_s) if health_refresh_s is not None
+            else float(cfg.get("fleet.health_refresh_s")))
+        self.scale_headroom = (
+            float(scale_headroom) if scale_headroom is not None
+            else float(cfg.get("fleet.scale_headroom")))
+        self.default_deadline_ms = default_deadline_ms
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.default_service_s = float(default_service_s)
+        self.default_token_s = float(default_token_s)
+        self.page_len = int(page_len)
+        self.settle_batch = int(settle_batch)
+        #: uri -> {"instance": name, "rec": original request} for every
+        #: request placed and not yet seen terminal — the failover map
+        self._assigned: Dict[str, Dict[str, Any]] = {}
+        #: requests the router holds but could not place yet (fault, all
+        #: instances dead, ...) — retried every pass, never dropped
+        self._backlog: List[Tuple[str, Dict[str, Any]]] = []
+        self._g: Optional[Dict[str, np.ndarray]] = None
+        self._last_refresh = -1e18
+        self._settle_cursor = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _refresh(self, now: float) -> None:
+        """Re-read every instance's health file and rebuild the placement
+        gauge arrays. ``dead`` instances additionally get their spool
+        reclaimed and their assigned streams failed over."""
+        n = len(self.instances)
+        alive = np.zeros(n, bool)
+        dead = np.zeros(n, bool)
+        depth = np.zeros(n)
+        in_flight = np.zeros(n)
+        slots_free = np.zeros(n)
+        pages_free = np.full(n, -1.0)
+        service_s = np.full(n, self.default_service_s)
+        token_s = np.full(n, self.default_token_s)
+        for i, inst in enumerate(self.instances):
+            snap = read_health(inst.health_path, now=now)
+            inst.health = snap
+            if snap is None or snap["health_age_s"] > self.stale_after_s \
+                    or snap.get("state") in _DEAD_STATES:
+                dead[i] = True
+                continue
+            if snap.get("state") not in _ROUTABLE_STATES:
+                continue  # draining: not dead, not routable
+            alive[i] = True
+            depth[i] = snap.get("queue_pending") or 0
+            in_flight[i] = snap.get("in_flight") or 0
+            occupied = snap.get("slots_occupied")
+            if occupied is not None:
+                slots_free[i] = max(0, (snap.get("slots") or inst.slots)
+                                    - occupied)
+            else:
+                slots_free[i] = max(0, inst.slots - in_flight[i])
+            kv = snap.get("kv_pages_free")
+            if kv is not None:
+                pages_free[i] = kv
+            ewma = snap.get("service_time_s_ewma")
+            p99 = (snap.get("latency_ms") or {}).get("p99")
+            if ewma:
+                service_s[i] = ewma
+            elif p99:
+                service_s[i] = p99 / 1e3
+            tps = snap.get("tokens_per_sec_ewma")
+            if tps:
+                token_s[i] = 1.0 / tps
+        self._g = {"alive": alive, "dead": dead, "depth": depth,
+                   "in_flight": in_flight, "slots_free": slots_free,
+                   "pages_free": pages_free, "service_s": service_s,
+                   "token_s": token_s}
+        _M_ALIVE.set(int(alive.sum()))
+        for i in np.flatnonzero(dead):
+            self._reclaim_dead(self.instances[i])
+
+    # -- failover ----------------------------------------------------------
+
+    def _reclaim_dead(self, inst: FleetInstance) -> None:
+        """Sweep a dead instance: pull its UNSTARTED spool entries back
+        into the router backlog, and fail over every stream assigned to
+        it — from its accumulated prefix when a partial result exists,
+        from scratch otherwise. A terminal that already landed settles
+        the request instead (the instance died after answering)."""
+        try:
+            stolen = inst.queue.claim_batch(1 << 16)
+        except Exception:
+            logger.exception("reclaiming %s's spool failed", inst.name)
+            stolen = []
+        for uri, rec in stolen:
+            self._assigned.pop(uri, None)
+            self._backlog.append((uri, rec))
+        orphans = [u for u, a in self._assigned.items()
+                   if a["instance"] == inst.name]
+        for uri in orphans:
+            entry = self._assigned.pop(uri)
+            try:
+                res = self.front.get_result(uri)
+            except Exception:
+                res = None
+            if res is not None and ("error" in res or "value" in res):
+                continue  # answered before dying: settled
+            rec = dict(entry["rec"])
+            if res is not None and res.get("stream"):
+                # mid-stream death: carry the decoded prefix (and the
+                # sampling seed the partial exported) so the adopter
+                # continues token-identically instead of restarting
+                rec["prefix"] = [int(x) for x in res["stream"]]
+                if res.get("seed") is not None:
+                    rec["seed"] = int(res["seed"])
+                _M_FAILOVERS.inc()
+                logger.warning(
+                    "failing over %s from %s with a %d-token prefix",
+                    uri, inst.name, len(rec["prefix"]))
+            self._backlog.append((uri, rec))
+
+    def _settle(self) -> None:
+        """Drop assigned entries whose terminal result has landed — a
+        bounded round-robin slice per pass so a large in-flight set never
+        stalls routing."""
+        uris = list(self._assigned)
+        if not uris:
+            return
+        start = self._settle_cursor % len(uris)
+        for uri in (uris[start:start + self.settle_batch]
+                    or uris[:self.settle_batch]):
+            try:
+                res = self.front.get_result(uri)
+            except Exception:
+                continue
+            if res is not None and ("error" in res or "value" in res):
+                self._assigned.pop(uri, None)
+        self._settle_cursor = start + self.settle_batch
+
+    # -- placement ---------------------------------------------------------
+
+    def _place(self, uri: str, rec: Dict[str, Any], now: float) -> bool:
+        """Route one request. True = handled (placed, shed, or expired);
+        False = park it in the backlog for the next pass."""
+        try:
+            # chaos site: a flaky placement (queue hiccup, torn health
+            # read) must PARK the request, never lose or double-place it
+            faults.inject("fleet.route")
+        except faults.FaultInjected:
+            return False
+        deadline_ms = rec.get("deadline_ms") or self.default_deadline_ms
+        enq = float(rec.get("enqueue_t") or now)
+        remain = (enq + float(deadline_ms) / 1e3 - now
+                  if deadline_ms else None)
+        if remain is not None and remain <= 0:
+            self.front.put_result(uri, {"error": DEADLINE_ERROR})
+            _M_EXPIRED.inc()
+            return True
+        g = self._g
+        if g is None or not bool(g["alive"].any()):
+            return False
+        prompt = rec.get("prompt")
+        if prompt:
+            budget = int(rec.get("max_new_tokens")
+                         or self.default_max_new_tokens)
+            need_tokens = max(1, budget - len(rec.get("prefix") or []))
+            need_pages = (math.ceil((len(prompt) + budget) / self.page_len)
+                          if self.page_len > 0 else 0)
+        else:
+            need_tokens = 0
+            need_pages = 0
+        est = _score_instances(
+            g["alive"], g["depth"], g["in_flight"], g["slots_free"],
+            g["pages_free"], g["service_s"], g["token_s"],
+            np.float64(need_tokens), np.float64(need_pages))
+        best = int(np.argmin(est))
+        if not np.isfinite(est[best]):
+            return False
+        if remain is not None and float(est[best]) > remain:
+            # admission control: answer NOW instead of queueing work no
+            # instance can finish in time
+            self.front.put_result(uri, {"error": FLEET_SHED_ERROR})
+            _M_SHED.inc()
+            return True
+        inst = self.instances[best]
+        try:
+            inst.queue.enqueue(uri, rec)
+        except Exception:
+            logger.exception("enqueue to %s failed", inst.name)
+            return False
+        self._assigned[uri] = {"instance": inst.name, "rec": rec}
+        # optimistic gauge bump: later placements in this same pass see
+        # the queued work without waiting for the next health refresh
+        g["depth"][best] += 1.0
+        if need_tokens:
+            g["slots_free"][best] = max(0.0, g["slots_free"][best] - 1.0)
+        _M_ROUTED.labels(instance=inst.name).inc()
+        return True
+
+    def route_once(self, max_items: int = 64) -> int:
+        """One router pass: refresh telemetry (cadenced), fail over dead
+        instances, settle finished work, then place the backlog plus a
+        fresh batch from the front queue. Returns requests placed."""
+        t0 = time.perf_counter()
+        now = wall_clock()
+        if now - self._last_refresh >= self.health_refresh_s:
+            self._last_refresh = now
+            self._refresh(now)
+        self._settle()
+        work, self._backlog = self._backlog, []
+        try:
+            work.extend(self.front.claim_batch(max_items))
+        except Exception:
+            logger.exception("front claim failed (transient)")
+        placed = 0
+        for uri, rec in work:
+            if self._place(uri, rec, now):
+                placed += 1
+            else:
+                self._backlog.append((uri, rec))
+        self._scale_signals()
+        _M_ROUTE_PASS.observe(time.perf_counter() - t0)
+        return placed
+
+    def _scale_signals(self) -> None:
+        """Demand-derived autoscale gauges: an operator (or test) watches
+        ``fleet.desired_instances`` against ``fleet.instances_alive`` to
+        decide scale-out/in; headroom keeps failover capacity spare."""
+        _M_BACKLOG.set(len(self._backlog))
+        g = self._g
+        demand = len(self._backlog) + len(self._assigned)
+        if g is not None:
+            demand += int(g["depth"].sum() + g["in_flight"].sum())
+        per = max(1.0, float(np.mean([i.slots for i in self.instances]))
+                  if self.instances else 1.0)
+        _M_DESIRED.set(int(math.ceil(self.scale_headroom * demand / per))
+                       if demand else 0)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def run(self, poll_interval_s: float = 0.01) -> None:
+        logger.info("fleet router started (%d instances)",
+                    len(self.instances))
+        while not self._stop.is_set():
+            if self.route_once() == 0:
+                time.sleep(poll_interval_s)
+
+    def start(self) -> "FleetRouter":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop routing. Backlogged requests are returned to the FRONT
+        queue so a successor router (or a direct consumer) finds them —
+        the router never takes work to its grave."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        for uri, rec in self._backlog:
+            try:
+                self.front.enqueue(uri, rec)
+            except Exception:
+                logger.exception("returning %s to the front failed", uri)
+        self._backlog = []
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        return {"assigned": len(self._assigned),
+                "backlog": len(self._backlog)}

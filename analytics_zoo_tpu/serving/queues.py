@@ -72,11 +72,19 @@ class FileQueue(QueueBackend):
     # same recovery stance as redis XAUTOCLAIM
     CLAIM_LEASE_S = 300.0
 
-    def __init__(self, root: str, claim_lease_s: Optional[float] = None):
+    def __init__(self, root: str, claim_lease_s: Optional[float] = None,
+                 results_root: Optional[str] = None):
+        """``results_root`` detaches the result store from the request
+        spool: the fleet tier gives every server its OWN request spool
+        (``<root>/inst/<name>``) while all of them post results into the
+        FRONT spool's ``results/`` — clients poll one place no matter
+        which instance answered, and the router's re-routing stays
+        invisible to them."""
         self.root = root
         self.req_dir = file_io.join(root, "requests")
         self.claim_dir = file_io.join(root, "claimed")
-        self.res_dir = file_io.join(root, "results")
+        self.res_dir = file_io.join(results_root if results_root else root,
+                                    "results")
         self.claim_lease_s = (claim_lease_s if claim_lease_s is not None
                               else self.CLAIM_LEASE_S)
         for d in (self.req_dir, self.claim_dir, self.res_dir):
@@ -417,6 +425,26 @@ class RedisQueue(QueueBackend):
         except Exception:
             pass
         return self.db.xlen(self.STREAM)
+
+    def consumer_pending(self) -> Dict[str, int]:
+        """Per-consumer pending (claimed-not-yet-acked) counts, via XINFO
+        CONSUMERS. Group lag (:meth:`pending_count`) is the UNDELIVERED
+        backlog; this is the in-flight side — what each server instance
+        has claimed and not yet answered. The fleet router reads it as the
+        true per-instance queue depth a placement decision adds to.
+        Returns ``{}`` when the server/fake doesn't support the call."""
+        out: Dict[str, int] = {}
+        try:
+            for c in self.db.xinfo_consumers(self.STREAM, self.GROUP):
+                name = c.get("name")
+                if isinstance(name, bytes):
+                    name = name.decode()
+                if name is None:
+                    continue
+                out[str(name)] = int(c.get("pending") or 0)
+        except Exception:
+            return {}
+        return out
 
     def trim(self, max_pending: int) -> int:
         before = self.pending_count()

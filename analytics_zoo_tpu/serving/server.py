@@ -586,6 +586,8 @@ class ClusterServing:
         self._m_in_flight.set(in_flight)
         if claim_age is not None:
             self._m_claim_age.set(claim_age)
+        with self._counter_lock:
+            ewma = self._ewma_record_s
         return {
             "state": state,
             "time": wall_clock(),
@@ -593,6 +595,7 @@ class ClusterServing:
             "in_flight": in_flight,
             "records_served": self.records_served,
             "device_seconds": round(self.device_seconds, 4),
+            "service_time_s_ewma": (round(ewma, 6) if ewma > 0 else None),
             "last_claim_age_s": claim_age,
             "latency_ms": {"p50": _pct(0.50), "p99": _pct(0.99),
                            "window": self._m_latency.count()},
@@ -1205,6 +1208,12 @@ class GenerativeServing:
         self._keys: List[Optional[np.ndarray]] = [None] * s
         self._next_tokens = np.zeros(s, np.int32)
         self._active_host = np.zeros(s, bool)
+        # continuation-on-failover bookkeeping: the original prompt, seed
+        # and deadline ride along so a drain handoff can re-enqueue the
+        # stream with its accumulated prefix (docs/fleet.md)
+        self._prompt: List[Optional[List[int]]] = [None] * s
+        self._seed: List[Optional[int]] = [None] * s
+        self._deadline_ms: List[Optional[float]] = [None] * s
         # -- SLO bookkeeping (same registry families as ClusterServing) ---
         self.metrics_label = f"srv{next(_instance_ids)}"
         self._m = {key: fam.labels(server=self.metrics_label)
@@ -1233,6 +1242,7 @@ class GenerativeServing:
         self._claim_fail_streak = 0
         self._stop = threading.Event()
         self._draining = threading.Event()
+        self._handoff_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._loop_running = False
         self._terminal_state: Optional[str] = None
@@ -1285,13 +1295,33 @@ class GenerativeServing:
             self._m_records.inc()
         if self._paged:
             self._release_pages(slot)
+        self._clear_slot(slot)
+
+    def _clear_slot(self, slot: int) -> None:
         self._uri[slot] = None
         self._tokens[slot] = None
         self._keys[slot] = None
         self._expires[slot] = None
         self._first_t[slot] = None
         self._streamed[slot] = 0
+        self._prompt[slot] = None
+        self._seed[slot] = None
+        self._deadline_ms[slot] = None
         self._active_host[slot] = False
+
+    def _abandon(self, slot: int) -> None:
+        """Release a slot WITHOUT posting a terminal — the stream's one
+        terminal will be posted by whichever instance adopts its re-routed
+        continuation. Only :meth:`handoff` may do this: every other exit
+        path funnels through :meth:`_retire`."""
+        with self._counter_lock:
+            self._in_flight = max(0, self._in_flight - 1)
+            in_flight = self._in_flight
+            self._meta.pop(self._uri[slot], None)
+        self._m_in_flight.set(in_flight)
+        if self._paged:
+            self._release_pages(slot)
+        self._clear_slot(slot)
 
     def _release_pages(self, slot: int) -> None:
         """Decrement every page the slot holds; refcount-0 pages return to
@@ -1529,7 +1559,15 @@ class GenerativeServing:
               now: float) -> bool:
         """Validate a claimed request and prefill it into ``slot``. Returns
         False (slot stays free) when the request terminates immediately
-        (bad prompt, over-budget, already expired)."""
+        (bad prompt, over-budget, already expired).
+
+        A request carrying a ``prefix`` (tokens already decoded elsewhere
+        — a re-routed stream after its server died or drained) is ADOPTED:
+        ``prompt + prefix`` is re-prefilled through the same bucketed path
+        and decoding resumes at position ``len(prefix)``; with an explicit
+        ``seed`` the key schedule is rebuilt over the FULL original budget
+        so step ``i`` uses the same key an uninterrupted stream would —
+        the continuation is token-identical (docs/fleet.md)."""
         from ..capture.lm import prefill_bucket
 
         cfg = self.config
@@ -1539,6 +1577,8 @@ class GenerativeServing:
             self._count("errors")
             return False
         budget = int(rec.get("max_new_tokens") or cfg.max_new_tokens)
+        prompt = [int(x) for x in prompt]
+        prefix = [int(x) for x in (rec.get("prefix") or [])]
         t = len(prompt)
         if budget < 1 or t + budget > self.lm.max_len:
             self._post_terminal(uri, {
@@ -1551,40 +1591,60 @@ class GenerativeServing:
             self._post_terminal(uri, {"error": DEADLINE_ERROR})
             self._count("expired")
             return False
+        if prefix and len(prefix) >= budget:
+            # the dead server decoded the whole budget but never posted
+            # the terminal — settle it here, nothing left to decode
+            self._post_terminal(uri, {"value": prefix[:budget],
+                                      "done": True})
+            self._m_records.inc()
+            return False
+        full = prompt + prefix
+        t_full = len(full)
         t0 = time.perf_counter()
         if self._paged:
-            if not self._join_paged(slot, uri, prompt, t, budget):
+            if not self._join_paged(slot, uri, full, t_full,
+                                    budget - len(prefix)):
                 _profiler.record_phase("serving", "host_input",
                                        time.perf_counter() - t0, start=t0)
                 return False
-        elif t > 1:
-            # right-pad prompt[:-1] to its length bucket: the SAME compiled
-            # prefill program serial generate() uses (bit-parity anchor)
-            tb = prefill_bucket(t - 1, self.lm.max_len)
+        elif t_full > 1:
+            # right-pad full[:-1] to its length bucket: the SAME compiled
+            # prefill program serial generate() uses (bit-parity anchor);
+            # an adopted prefix re-prefills here — the KV it rebuilds is
+            # bit-identical to what the dead server's decode steps wrote
+            tb = prefill_bucket(t_full - 1, self.lm.max_len)
             padded = np.zeros((1, tb), np.int32)
-            padded[0, :t - 1] = prompt[:-1]
+            padded[0, :t_full - 1] = full[:-1]
             self._insert_request_device(padded, np.int32(slot),
-                                        np.int32(t - 1))
+                                        np.int32(t_full - 1))
         else:
             self._state = self._join_fn(self._state, np.int32(slot),
                                         np.int32(0))
         _profiler.record_phase("serving", "host_input",
                                time.perf_counter() - t0, start=t0)
         self._uri[slot] = uri
-        self._tokens[slot] = []
+        self._tokens[slot] = list(prefix)
         self._budget[slot] = budget
         self._expires[slot] = exp
         self._enqueue_t[slot] = float(rec.get("enqueue_t") or now)
-        self._first_t[slot] = None
-        self._streamed[slot] = 0
-        self._next_tokens[slot] = int(prompt[-1])
+        # TTFT was already observed on the original server for an adopted
+        # stream — don't observe it twice
+        self._first_t[slot] = now if prefix else None
+        self._streamed[slot] = len(prefix)
+        self._next_tokens[slot] = int(full[-1])
+        self._prompt[slot] = prompt
+        self._deadline_ms[slot] = rec.get("deadline_ms")
+        self._seed[slot] = None
         if self._sampling:
             seed = rec.get("seed")
             if seed is None:  # fresh entropy: repeated requests differ
                 seed = int(np.random.SeedSequence().entropy % (2 ** 31))
             # the FULL per-request key schedule, precomputed once: step i
             # uses key [i] — identical to serial sample_generate's
-            # split(PRNGKey(seed), budget) schedule
+            # split(PRNGKey(seed), budget) schedule. The step index is
+            # len(self._tokens[slot]), so an adopted prefix resumes the
+            # schedule exactly where the dead server left off.
+            self._seed[slot] = int(seed)
             self._keys[slot] = self._split(int(seed), budget)
         self._active_host[slot] = True
         return True
@@ -1677,9 +1737,7 @@ class GenerativeServing:
                   and (len(self._tokens[i]) - self._streamed[i]
                        >= cfg.stream_interval)):
                 try:
-                    self.queue.put_result(
-                        self._uri[i], {"stream": list(self._tokens[i]),
-                                       "done": False})
+                    self.queue.put_result(self._uri[i], self._partial(i))
                     self._streamed[i] = len(self._tokens[i])
                 except Exception:
                     logger.exception("partial result for %s failed",
@@ -1688,6 +1746,17 @@ class GenerativeServing:
             self._m_tokens.inc(n_tok)
         if finished.any():
             self._evict_slots(finished)
+
+    def _partial(self, slot: int) -> Dict[str, Any]:
+        """A stream-progress record: accumulated tokens + the sampling seed
+        (when sampling). The seed is the failover handle — a router that
+        adopts the stream re-enqueues ``{prefix: stream, seed: seed}`` and
+        the adopting server's key schedule resumes bit-identically."""
+        out: Dict[str, Any] = {"stream": list(self._tokens[slot]),
+                               "done": False}
+        if self._seed[slot] is not None:
+            out["seed"] = self._seed[slot]
+        return out
 
     def _post_tokens_spec(self, emitted: np.ndarray,
                           n_acc: np.ndarray) -> None:
@@ -1726,9 +1795,7 @@ class GenerativeServing:
                   and (len(self._tokens[i]) - self._streamed[i]
                        >= cfg.stream_interval)):
                 try:
-                    self.queue.put_result(
-                        self._uri[i], {"stream": list(self._tokens[i]),
-                                       "done": False})
+                    self.queue.put_result(self._uri[i], self._partial(i))
                     self._streamed[i] = len(self._tokens[i])
                 except Exception:
                     logger.exception("partial result for %s failed",
@@ -1798,7 +1865,8 @@ class GenerativeServing:
         self._loop_running = True
         self._last_shed_m = -1e18
         try:
-            while not self._stop.is_set():
+            while (not self._stop.is_set()
+                   and not self._handoff_evt.is_set()):
                 stepped = self.serve_step()
                 if self._draining.is_set() and stepped == 0:
                     return  # drained: every in-flight stream finished
@@ -1813,6 +1881,7 @@ class GenerativeServing:
     def start(self) -> "GenerativeServing":
         self._stop.clear()
         self._draining.clear()
+        self._handoff_evt.clear()
         self._terminal_state = None
         self._background_error: Optional[BaseException] = None
 
@@ -1851,6 +1920,67 @@ class GenerativeServing:
             self._terminal_state = "drained"
         self._write_health()
         self.check_health()
+
+    def handoff(self, to_queue, timeout_s: float = 30.0) -> int:
+        """Drain WITHOUT finishing locally: pause the loop and re-enqueue
+        every in-flight stream onto ``to_queue`` carrying its accumulated
+        token ``prefix`` (+ sampling ``seed``), so another instance adopts
+        it mid-stream and continues token-identically — the fast half of
+        the failover protocol (docs/fleet.md). No terminal is posted here;
+        the adopting server posts the stream's ONE terminal. A stream
+        whose re-enqueue fails is errored instead (never silently lost).
+        Returns the number of streams handed off."""
+        self._draining.set()
+        self._handoff_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout_s)
+            if t.is_alive():
+                raise RuntimeError(
+                    f"handoff: serve loop did not pause within {timeout_s}s")
+            self._thread = None
+        elif self._loop_running:
+            # foreground run(): wait for the loop to notice the event
+            deadline = time.monotonic() + timeout_s
+            while self._loop_running:
+                if time.monotonic() >= deadline:
+                    raise RuntimeError(
+                        f"handoff: serve loop did not pause within "
+                        f"{timeout_s}s")
+                time.sleep(0.002)
+        moved = 0
+        mask = np.zeros(self.slots, bool)
+        for i in range(self.slots):
+            if not self._active_host[i]:
+                continue
+            uri = self._uri[i]
+            rec: Dict[str, Any] = {
+                "prompt": list(self._prompt[i]),
+                "prefix": list(self._tokens[i]),
+                "max_new_tokens": self._budget[i],
+                "enqueue_t": self._enqueue_t[i],
+            }
+            if self._deadline_ms[i] is not None:
+                rec["deadline_ms"] = self._deadline_ms[i]
+            if self._seed[i] is not None:
+                rec["seed"] = self._seed[i]
+            mask[i] = True
+            try:
+                to_queue.enqueue(uri, rec)
+            except Exception:
+                logger.exception("handoff enqueue for %s failed", uri)
+                self._retire(i, {"error": SHUTDOWN_ERROR},
+                             counter="errors")
+                continue
+            self._abandon(i)
+            moved += 1
+        if mask.any():
+            self._evict_slots(mask)
+        if self._terminal_state is None:
+            self._terminal_state = "drained"
+        self._write_health()
+        self.check_health()
+        return moved
 
     def stop(self) -> None:
         """Hard stop: active streams are answered with explicit shutdown

@@ -1444,6 +1444,317 @@ def bench_serving_slo(requests: int = 360, batch_size: int = 16):
                         "max_pending=4 batches"})
 
 
+def _fleet_server_proc(root: str, name: str, stall_s: float,
+                       batch_size: int, done_q):
+    """Subprocess: one fleet instance — ClusterServing on its private
+    spool under ``<root>/inst/<name>`` whose results land in the FRONT
+    result store, health file on a fast cadence so the router sees live
+    gauges (and a SIGKILL as a frozen, aging file). Serves until the DONE
+    flag appears; a ``RELOAD_<name>`` flag triggers one hot
+    ``reload_model`` mid-traffic (the rolling-deploy leg)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from analytics_zoo_tpu.inference import InferenceModel
+    from analytics_zoo_tpu.serving import ClusterServing, ServingConfig
+    from analytics_zoo_tpu.serving.fleet import instance_queue
+
+    def fwd(p, x):
+        return x.reshape(x.shape[0], -1).mean(1, keepdims=True)
+
+    def stall_model():
+        im = InferenceModel().load_jax(fwd, {})
+
+        class StallModel:
+            """Host stall dominates the batch so fleet scaling is
+            measurable on any machine (the multiserver-test trick)."""
+
+            def predict(self, x):
+                time.sleep(stall_s)
+                return im.predict(x)
+
+            def predict_async(self, x):
+                f = im.predict_async(x)
+
+                def fetch():
+                    time.sleep(stall_s)
+                    return f()
+                return fetch
+        return StallModel()
+
+    cfg = ServingConfig(data_src=f"dir://{root}/inst/{name}",
+                        batch_size=batch_size, batch_wait_ms=2,
+                        input_dtype="float32",
+                        health_path=os.path.join(root,
+                                                 f"{name}.health.json"),
+                        health_interval_s=0.1)
+    srv = ClusterServing(cfg, model=stall_model(),
+                         queue=instance_queue(root, name))
+    with open(os.path.join(root, f"READY_{name}"), "w") as f:
+        f.write("1")
+    served, reloads = 0, 0
+    deadline = time.time() + 600
+    while time.time() < deadline:
+        if reloads == 0 and os.path.exists(
+                os.path.join(root, f"RELOAD_{name}")):
+            srv.reload_model(model=stall_model())
+            reloads += 1
+        n = srv.serve_once()
+        served += n
+        if not n:
+            if os.path.exists(os.path.join(root, "DONE")):
+                break
+            time.sleep(0.005)
+    done_q.put((name, served, reloads))
+
+
+def bench_serving_fleet(requests: int = 1200, batch_size: int = 4,
+                        stall_s: float = 0.08):
+    """Fleet tier end to end (docs/fleet.md): three REAL server processes
+    behind one telemetry-driven FleetRouter, with a mid-run SIGKILL of
+    one instance (its claimed work re-placed from the failover map, its
+    spool reclaimed, a warm standby registered in its place) and a
+    rolling ``reload_model`` on a second instance. Headline = sustained
+    routed throughput over a single-instance baseline at the same
+    offered load — gated on the invariant that EVERY request got exactly
+    one terminal result, kill and reload included. A second leg routes
+    generative streams across two in-process schedulers and kills one
+    mid-decode: the orphaned streams must finish on the survivor via
+    prefix continuation (tokens/s + failover count in detail)."""
+    import multiprocessing as mp
+    import signal
+    import tempfile
+
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.serving import (FleetInstance, FleetRouter,
+                                           fleet as zfleet)
+    from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+    from analytics_zoo_tpu.serving.fleet import instance_queue
+    from analytics_zoo_tpu.serving.queues import FileQueue
+
+    init_tpu_context()
+    ctx = mp.get_context("spawn")
+    rs = np.random.RandomState(0)
+    vec = rs.rand(64).astype(np.float32)
+
+    def spawn(root: str, names) -> dict:
+        done_q = ctx.Queue()
+        procs = {nm: ctx.Process(target=_fleet_server_proc,
+                                 args=(root, nm, stall_s, batch_size,
+                                       done_q))
+                 for nm in names}
+        for p in procs.values():
+            p.start()
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if all(os.path.exists(os.path.join(root, f"READY_{nm}"))
+                   for nm in names):
+                break
+            time.sleep(0.05)
+        return {"procs": procs, "done_q": done_q}
+
+    def finish(root: str, fleet: dict) -> dict:
+        with open(os.path.join(root, "DONE"), "w") as f:
+            f.write("1")
+        reports = {}
+        live = [p for p in fleet["procs"].values() if p.is_alive()]
+        for _ in live:
+            nm, served, reloads = fleet["done_q"].get(timeout=60)
+            reports[nm] = {"served": served, "reloads": reloads}
+        for p in fleet["procs"].values():
+            p.join(timeout=30)
+        return reports
+
+    def drive(root: str, names, n: int, kill: str = "",
+              reload_on: str = "", standby: str = "") -> dict:
+        """Enqueue n deadline-stamped requests to the front and run the
+        router inline until every terminal lands. The kill fires at 35%
+        answered (standby registered with the router in the same pass),
+        the rolling reload at 55%."""
+        fleet = spawn(root, list(names) + ([standby] if standby else []))
+        front = FileQueue(root)
+        insts = {nm: FleetInstance(
+            nm, instance_queue(root, nm),
+            os.path.join(root, f"{nm}.health.json"))
+            for nm in list(names) + ([standby] if standby else [])}
+        router = FleetRouter(front,
+                             [insts[nm] for nm in names],
+                             stale_after_s=0.5, health_refresh_s=0.1,
+                             # operator-tuned cold-start estimate: an
+                             # instance with no service history yet (the
+                             # warm standby) scores at the fleet's known
+                             # per-record time instead of a pessimistic
+                             # default that starves it of its fair share
+                             default_service_s=stall_s / batch_size)
+        inq = InputQueue(f"dir://{root}")
+        outq = OutputQueue(f"dir://{root}")
+        res_dir = os.path.join(root, "results")
+
+        def n_results() -> int:
+            # file COUNT only — parsing every result json each poll would
+            # put an O(results^2) read loop inside the timed region
+            try:
+                return sum(1 for f in os.listdir(res_dir)
+                           if not f.startswith("."))
+            except FileNotFoundError:
+                return 0
+
+        t0 = time.perf_counter()
+        for i in range(n):
+            inq.enqueue_tensor(f"r{i}", vec, deadline_ms=120_000)
+        killed = reloaded = False
+        deadline = time.time() + 420
+        done = 0
+        while time.time() < deadline and done < n:
+            router.route_once()
+            done = n_results()
+            if kill and not killed and done >= 0.35 * n:
+                os.kill(fleet["procs"][kill].pid, signal.SIGKILL)
+                # the fleet answer to a dead instance: register the warm
+                # standby; the router reclaims the victim's spool and
+                # re-places its claimed-but-unanswered work
+                router.instances.append(insts[standby])
+                router._last_refresh = -1e18
+                killed = True
+            if reload_on and not reloaded and done >= 0.55 * n:
+                with open(os.path.join(root, f"RELOAD_{reload_on}"),
+                          "w") as f:
+                    f.write("1")
+                reloaded = True
+            time.sleep(0.005)
+        wall = time.perf_counter() - t0
+        answered = {u: r for u, r in outq.dequeue().items()
+                    if u.startswith("r")}
+        reports = finish(root, fleet)
+        router.stop()
+        if len(answered) != n:
+            raise RuntimeError(
+                f"fleet invariant violated: {n - len(answered)} of {n} "
+                f"requests never received a terminal result")
+        errors = sum(1 for r in answered.values() if "error" in r)
+        return {"rps": n / wall, "errors": errors, "reports": reports}
+
+    # -- single-instance baseline at the same offered load ---------------
+    single = drive(tempfile.mkdtemp(prefix="zoo_fleet_one_"), ["s0"],
+                   max(batch_size * 10, requests // 3))
+    _note_partial(single_records_per_sec=round(single["rps"], 1))
+    # -- 3 instances + mid-run SIGKILL + rolling reload + warm standby ----
+    routed = drive(tempfile.mkdtemp(prefix="zoo_fleet_three_"),
+                   ["a", "b", "c"], requests,
+                   kill="a", reload_on="b", standby="d")
+    speedup = routed["rps"] / max(single["rps"], 1e-9)
+    reloads = sum(r["reloads"] for r in routed["reports"].values())
+    _note_partial(metric="serving_fleet_speedup",
+                  value=round(speedup, 2), unit="x",
+                  routed3_records_per_sec=round(routed["rps"], 1))
+
+    # -- generative leg: routed streams + mid-decode kill = continuation -
+    from analytics_zoo_tpu.capture.lm import TransformerLM
+    from analytics_zoo_tpu.serving import GenerativeServing, ServingConfig
+    lm = TransformerLM(vocab_size=128, hidden=32, n_block=2, n_head=2,
+                       max_len=64, seed=0)
+    lm.fit(rs.randint(0, 128, (32, 12)), batch_size=8, epochs=1)
+    groot = tempfile.mkdtemp(prefix="zoo_fleet_gen_")
+    gfront = FileQueue(groot)
+    gsrvs, ginsts = [], []
+    for nm in ("ga", "gb"):
+        q = instance_queue(groot, nm)
+        hp = os.path.join(groot, f"{nm}.health.json")
+        gsrvs.append(GenerativeServing(
+            ServingConfig(data_src=f"dir://{groot}/inst/{nm}", slots=4,
+                          max_new_tokens=16, stream_interval=2,
+                          health_path=hp, health_interval_s=0.02),
+            lm, queue=q))
+        # slots=4 so the 24 streams decode in overlapping waves — the
+        # kill lands while the victim holds mid-flight streams whose
+        # partials become failover prefixes
+        ginsts.append(FleetInstance(nm, q, hp, slots=4))
+    # prewarm OFF the routed path: the first decode step per prefill
+    # bucket cold-compiles for seconds, which would freeze health long
+    # enough for the router to declare a busy-compiling instance dead.
+    # Warm the buckets continuation re-prefill can hit (prompt alone and
+    # prompt+prefix) the way ClusterServing prewarms before traffic.
+    for srv, inst in zip(gsrvs, ginsts):
+        for j, plen in enumerate((5, 12, 20)):
+            inst.queue.enqueue(f"warm_{inst.name}_{j}",
+                               {"prompt": rs.randint(0, 128,
+                                                     (plen,)).tolist(),
+                                "max_new_tokens": 2})
+        for _ in range(64):
+            if not srv.serve_step() and not inst.queue.pending_count():
+                break
+    for srv in gsrvs:
+        # one idle step each AFTER both prewarms: the first server's
+        # health would otherwise be a prewarm-duration old when the
+        # router takes its first snapshot — and look dead on arrival
+        srv.serve_step()
+    grouter = FleetRouter(gfront, ginsts, stale_after_s=0.5,
+                          health_refresh_s=0.05)
+    ginq = InputQueue(f"dir://{groot}")
+    goutq = OutputQueue(f"dir://{groot}")
+    n_streams, new_tokens = 24, 16
+    failovers0 = zfleet._M_FAILOVERS.value()
+    t0 = time.perf_counter()
+    for i in range(n_streams):
+        ginq.enqueue_prompt(f"g{i}", rs.randint(0, 128, (5,)).tolist(),
+                            max_new_tokens=new_tokens)
+    dead = False
+    terminals = {}
+    deadline = time.time() + 240
+    while time.time() < deadline and len(terminals) < n_streams:
+        grouter.route_once()
+        for s in (gsrvs[1:] if dead else gsrvs):
+            s.serve_step()
+        results = {u: r for u, r in goutq.dequeue().items()
+                   if u.startswith("g")}
+        terminals = {u: r for u, r in results.items()
+                     if "value" in r or "error" in r}
+        mid_flight = any(4 <= len(r.get("stream") or []) <= 10
+                         for r in results.values()
+                         if not r.get("done", True))
+        if not dead and len(terminals) >= n_streams // 4 and mid_flight:
+            dead = True  # SIGKILL equivalent, deliberately MID-wave (a
+            #   partial with 4..10 of 16 tokens is in flight): ga stops
+            #   stepping with streams resident in its slots; its frozen
+            #   health ages out and the router re-places the orphans
+            #   WITH their accumulated token prefixes
+    gwall = time.perf_counter() - t0
+    grouter.stop()
+    if len(terminals) != n_streams:
+        raise RuntimeError(
+            f"fleet invariant violated (generative leg): "
+            f"{n_streams - len(terminals)} of {n_streams} streams never "
+            f"received a terminal result")
+    gen_failovers = int(zfleet._M_FAILOVERS.value() - failovers0)
+    gen_errors = sum(1 for r in terminals.values() if "error" in r)
+
+    return _BenchResult(
+        metric="serving_fleet_speedup", value=round(speedup, 2),
+        unit="x", mfu=None,
+        detail={"requests": requests, "batch_size": batch_size,
+                "stall_s": stall_s,
+                "single_records_per_sec": round(single["rps"], 1),
+                "routed3_records_per_sec": round(routed["rps"], 1),
+                "speedup_vs_single": round(speedup, 2),
+                "mid_run_kill": "a (SIGKILL at 35% answered; warm "
+                                "standby d registered)",
+                "rolling_reloads": reloads,
+                "error_results": routed["errors"],
+                "per_instance_served": {nm: r["served"] for nm, r in
+                                        routed["reports"].items()},
+                "gen_streams": n_streams,
+                "gen_tokens_per_sec": round(
+                    n_streams * new_tokens / gwall, 1),
+                "gen_failovers": gen_failovers,
+                "gen_error_results": gen_errors,
+                "note": "every request got exactly one terminal result "
+                        "(gated before publishing) across the SIGKILL, "
+                        "the spool reclaim + re-placement, and the "
+                        "rolling reload; the generative leg's orphaned "
+                        "streams finished on the survivor via "
+                        "token-identical prefix continuation"})
+
+
 def _kv_pool_hbm_gb(lm, num_pages: int, page_len: int,
                     int8: bool = False) -> float:
     """Paged KV pool HBM footprint across all blocks, in GB (int8 pools
@@ -2150,6 +2461,7 @@ _WORKLOADS = {
     "eval": bench_eval,
     "serving": bench_serving,
     "serving_slo": bench_serving_slo,
+    "serving_fleet": bench_serving_fleet,
     "generate": bench_generate,
     "obs_overhead": bench_obs_overhead,
     "quantized": bench_quantized,
@@ -2812,6 +3124,78 @@ def _ratio_etl():
                 round(t_gather / max(t_slab, 1e-9), 2)}
 
 
+def _ratio_fleet():
+    """Routed 3-instance fleet vs a single instance at equal offered
+    load — the serving_fleet workload's A/B shrunk to CPU scale. Fake
+    instances are threads draining their per-instance spool with a fixed
+    per-record stall, so the ratio isolates what the ROUTER buys
+    (placement spreading work) from accelerator throughput."""
+    import tempfile
+    import threading
+
+    from analytics_zoo_tpu.serving.fleet import (FleetInstance,
+                                                 FleetRouter,
+                                                 instance_queue)
+    from analytics_zoo_tpu.serving.queues import FileQueue
+
+    n, stall_s = 90, 0.004
+
+    def timed(k: int) -> float:
+        root = tempfile.mkdtemp(prefix="zoo_ratio_fleet_")
+        front = FileQueue(root)
+        insts, stop = [], threading.Event()
+
+        def worker(q):
+            while not stop.is_set():
+                batch = q.claim_batch(8)
+                if not batch:
+                    time.sleep(0.001)
+                    continue
+                for uri, _rec in batch:
+                    time.sleep(stall_s)
+                    q.put_result(uri, {"value": [1.0]})
+
+        for i in range(k):
+            q = instance_queue(root, f"s{i}")
+            hp = os.path.join(root, f"s{i}.health.json")
+            with open(hp, "w") as f:
+                json.dump({"state": "running", "time": time.time(),
+                           "queue_pending": 0, "in_flight": 0}, f)
+            insts.append(FleetInstance(f"s{i}", q, hp))
+        # one refresh, then optimistic depth bumps spread placement —
+        # no health churn in the timed region
+        router = FleetRouter(front, insts, stale_after_s=3600.0,
+                             health_refresh_s=1e9)
+        for i in range(n):
+            front.enqueue(f"u{i}", {"value": [0.0]})
+        threads = [threading.Thread(target=worker, args=(inst.queue,),
+                                    daemon=True) for inst in insts]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        done = {}
+        deadline = time.time() + 60
+        while len(done) < n and time.time() < deadline:
+            router.route_once()
+            done.update(front.all_results())
+            time.sleep(0.001)
+        dt = time.perf_counter() - t0
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        router.stop()
+        if len(done) < n:
+            raise RuntimeError(
+                f"ratio_fleet: only {len(done)}/{n} results at k={k}")
+        return dt
+
+    t1 = timed(1)
+    t3 = timed(3)
+    return {"single_records_per_sec": round(n / t1, 1),
+            "routed3_records_per_sec": round(n / t3, 1),
+            "routed3_vs_single_ratio": round(t1 / max(t3, 1e-9), 2)}
+
+
 _RATIO_IMPLS = {
     "transfer": _ratio_transfer,
     "transform": _ratio_transform,
@@ -2823,6 +3207,7 @@ _RATIO_IMPLS = {
     "embed": _ratio_embed,
     "generate": _ratio_generate,
     "etl": _ratio_etl,
+    "fleet": _ratio_fleet,
 }
 
 #: every workload → (proxy impl, the detail key that becomes the record's
@@ -2840,6 +3225,7 @@ _RATIO_PLAN = {
     "eval": ("eval", "async_vs_sync_eval_ratio"),
     "serving": ("serving", "batch16_vs_batch1_serving_ratio"),
     "serving_slo": ("serving", "batch16_vs_batch1_serving_ratio"),
+    "serving_fleet": ("fleet", "routed3_vs_single_ratio"),
     "obs_overhead": ("obs", "enabled_vs_disabled_record_ratio"),
     "recovery": ("recovery", "restore_vs_step_ratio"),
     "generate": ("generate", "batched_vs_serial_tokens_ratio"),

@@ -1,0 +1,391 @@
+"""Fleet tier: telemetry-driven routing, shed-before-enqueue admission,
+and continuation-on-failover (docs/fleet.md).
+
+The load-bearing invariant extends the generative-serving parity rule
+across instance death: a stream interrupted mid-flight — its server
+killed (health file goes stale) or drained (``handoff``) — must finish on
+another instance with EXACTLY the tokens serial ``generate()`` produces,
+greedy and sampled, and every request still gets exactly one terminal
+result."""
+import json
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import faults
+from analytics_zoo_tpu.common.utils import wall_clock
+from analytics_zoo_tpu.serving import (FleetInstance, FleetRouter,
+                                       GenerativeServing, ServingConfig)
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.fleet import (FLEET_SHED_ERROR,
+                                             _score_instances,
+                                             instance_queue, read_health)
+from analytics_zoo_tpu.serving import fleet as _fleet
+from analytics_zoo_tpu.serving.queues import FileQueue
+from analytics_zoo_tpu.serving.server import DEADLINE_ERROR
+
+from tests.test_generative_serving import _drive, _lm, _src
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _write_health(path, **kw):
+    snap = {"state": "running", "time": wall_clock(), "queue_pending": 0,
+            "in_flight": 0}
+    snap.update(kw)
+    with open(path, "w") as f:
+        f.write(json.dumps(snap))
+
+
+def _router(front, insts, **kw):
+    kw.setdefault("stale_after_s", 5.0)
+    kw.setdefault("health_refresh_s", 0.0)  # refresh every pass in tests
+    return FleetRouter(front, insts, **kw)
+
+
+class TestHealthAge:
+    def test_read_health_exposes_age(self, tmp_path):
+        p = str(tmp_path / "health.json")
+        _write_health(p)
+        assert read_health(p)["health_age_s"] < 1.0
+        _write_health(p, time=wall_clock() - 60.0)
+        assert read_health(p)["health_age_s"] > 59.0
+
+    def test_missing_or_torn_health_is_none(self, tmp_path):
+        assert read_health(str(tmp_path / "nope.json")) is None
+        p = str(tmp_path / "torn.json")
+        with open(p, "w") as f:
+            f.write("{not json")
+        assert read_health(p) is None
+
+    def test_stale_health_marks_instance_dead(self, tmp_path):
+        """A frozen health file must NOT be trusted: the router marks the
+        instance dead instead of placing work by its stale gauges."""
+        root = str(tmp_path / "fleet")
+        front = FileQueue(root)
+        hp = str(tmp_path / "h.json")
+        _write_health(hp, time=wall_clock() - 60.0, queue_pending=0)
+        router = _router(front, [
+            FleetInstance("a", instance_queue(root, "a"), hp)])
+        front.enqueue("r0", {"uri": "r0", "tensor": [1],
+                             "enqueue_t": wall_clock()})
+        assert router.route_once() == 0  # nowhere to place: parked
+        assert router.instances[0].health["health_age_s"] > 59.0
+        assert router.stats["backlog"] == 1
+        assert router.instances[0].queue.pending_count() == 0
+
+
+class TestPlacement:
+    def test_instance_queue_shares_front_results(self, tmp_path):
+        root = str(tmp_path / "f")
+        front = FileQueue(root)
+        qa = instance_queue(root, "a")
+        qa.put_result("u", {"value": [1]})
+        assert front.get_result("u")["value"] == [1]
+
+    def test_scoring_is_least_loaded_and_slot_aware(self):
+        # one-shot: the shallow queue wins regardless of slots
+        est = _score_instances(
+            np.array([True, True]), np.array([5.0, 0.0]), np.zeros(2),
+            np.zeros(2), np.full(2, -1.0), np.full(2, 0.1),
+            np.full(2, 0.02), np.float64(0), np.float64(0))
+        assert est[1] < est[0]
+        # generative: a free slot beats a busy instance with a deeper
+        # queue discount — the stream would wait for a retirement
+        est = _score_instances(
+            np.array([True, True]), np.array([0.0, 2.0]),
+            np.array([2.0, 0.0]), np.array([0.0, 1.0]),
+            np.full(2, -1.0), np.full(2, 0.1), np.full(2, 0.02),
+            np.float64(8), np.float64(0))
+        assert est[1] < est[0]
+        # page-aware: the instance whose free pages hold the stream wins
+        est = _score_instances(
+            np.array([True, True]), np.zeros(2), np.zeros(2),
+            np.ones(2), np.array([1.0, 64.0]), np.full(2, 0.1),
+            np.full(2, 0.02), np.float64(8), np.float64(4))
+        assert est[1] < est[0]
+        # dead is unplaceable
+        assert np.isinf(_score_instances(
+            np.array([False]), np.zeros(1), np.zeros(1), np.ones(1),
+            np.full(1, -1.0), np.ones(1), np.ones(1),
+            np.float64(1), np.float64(0))[0])
+
+    def test_routes_one_shot_to_least_loaded(self, tmp_path):
+        root = str(tmp_path / "fleet")
+        front = FileQueue(root)
+        insts = []
+        for name, pending in (("a", 5), ("b", 0), ("c", 9)):
+            hp = str(tmp_path / f"{name}.json")
+            _write_health(hp, queue_pending=pending,
+                          service_time_s_ewma=0.01)
+            insts.append(FleetInstance(name, instance_queue(root, name),
+                                       hp))
+        router = _router(front, insts)
+        front.enqueue("r0", {"uri": "r0", "tensor": [1],
+                             "enqueue_t": wall_clock()})
+        assert router.route_once() == 1
+        assert insts[1].queue.pending_count() == 1
+        assert insts[0].queue.pending_count() == 0
+        assert insts[2].queue.pending_count() == 0
+        assert router.stats["assigned"] == 1
+
+    def test_sheds_before_enqueue_when_deadline_unmeetable(self, tmp_path):
+        """Admission control answers NOW: a request no instance can finish
+        inside its deadline gets the shed error without ever touching an
+        instance queue."""
+        root = str(tmp_path / "fleet")
+        front = FileQueue(root)
+        hp = str(tmp_path / "a.json")
+        _write_health(hp, queue_pending=1000, service_time_s_ewma=1.0)
+        insts = [FleetInstance("a", instance_queue(root, "a"), hp)]
+        router = _router(front, insts)
+        front.enqueue("r0", {"uri": "r0", "tensor": [1],
+                             "enqueue_t": wall_clock(),
+                             "deadline_ms": 200})
+        router.route_once()
+        res = front.get_result("r0")
+        assert res is not None and res["error"] == FLEET_SHED_ERROR
+        assert insts[0].queue.pending_count() == 0
+        assert router.stats["assigned"] == 0
+
+    def test_expired_request_answers_deadline_error(self, tmp_path):
+        root = str(tmp_path / "fleet")
+        front = FileQueue(root)
+        hp = str(tmp_path / "a.json")
+        _write_health(hp)
+        router = _router(front, [
+            FleetInstance("a", instance_queue(root, "a"), hp)])
+        front.enqueue("r0", {"uri": "r0", "tensor": [1],
+                             "enqueue_t": wall_clock() - 10.0,
+                             "deadline_ms": 100})
+        router.route_once()
+        res = front.get_result("r0")
+        assert res is not None and res["error"] == DEADLINE_ERROR
+
+    def test_route_fault_parks_request_never_lost(self, tmp_path):
+        """The ``fleet.route`` chaos site: a failed placement pass must
+        park the request in the backlog and place it on the next pass —
+        exactly one copy ever reaches an instance."""
+        root = str(tmp_path / "fleet")
+        front = FileQueue(root)
+        hp = str(tmp_path / "a.json")
+        _write_health(hp)
+        insts = [FleetInstance("a", instance_queue(root, "a"), hp)]
+        router = _router(front, insts)
+        faults.arm("fleet.route", at=1)
+        front.enqueue("r0", {"uri": "r0", "tensor": [1],
+                             "enqueue_t": wall_clock()})
+        assert router.route_once() == 0
+        assert router.stats["backlog"] == 1
+        assert insts[0].queue.pending_count() == 0
+        assert router.route_once() == 1  # retried, placed exactly once
+        assert router.stats["backlog"] == 0
+        assert insts[0].queue.pending_count() == 1
+        assert faults.fire_count("fleet.route") == 1
+
+    def test_scale_signals_track_demand(self, tmp_path):
+        root = str(tmp_path / "fleet")
+        front = FileQueue(root)
+        hp = str(tmp_path / "a.json")
+        _write_health(hp, queue_pending=10, in_flight=2)
+        router = _router(front, [
+            FleetInstance("a", instance_queue(root, "a"), hp, slots=2)],
+            scale_headroom=1.25)
+        router.route_once()
+        assert int(_fleet._M_ALIVE.value()) == 1
+        # 12 outstanding items x 1.25 headroom / 2 slots -> wants 8
+        assert int(_fleet._M_DESIRED.value()) >= 2
+
+    def test_router_stop_returns_backlog_to_front(self, tmp_path):
+        root = str(tmp_path / "fleet")
+        front = FileQueue(root)
+        hp = str(tmp_path / "a.json")
+        _write_health(hp, time=wall_clock() - 60.0)  # dead: nothing places
+        router = _router(front, [
+            FleetInstance("a", instance_queue(root, "a"), hp)])
+        front.enqueue("r0", {"uri": "r0", "tensor": [1],
+                             "enqueue_t": wall_clock()})
+        router.route_once()
+        assert router.stats["backlog"] == 1
+        assert front.pending_count() == 0
+        router.stop()
+        assert front.pending_count() == 1  # never taken to the grave
+
+
+class TestContinuationOnFailover:
+    def _fleet_pair(self, tmp_path, lm, budget, **cfg_kw):
+        root = str(tmp_path / "fleet")
+        front = FileQueue(root)
+        qa, qb = instance_queue(root, "a"), instance_queue(root, "b")
+        ha, hb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+        a = GenerativeServing(
+            ServingConfig(data_src=root, slots=2, max_new_tokens=budget,
+                          stream_interval=2, health_path=ha,
+                          health_interval_s=0.001, **cfg_kw),
+            lm, queue=qa)
+        b = GenerativeServing(
+            ServingConfig(data_src=root, slots=2, max_new_tokens=budget,
+                          stream_interval=2, health_path=hb,
+                          health_interval_s=0.001, **cfg_kw),
+            lm, queue=qb)
+        router = _router(
+            front, [FleetInstance("a", qa, ha, slots=2),
+                    FleetInstance("b", qb, hb, slots=2)],
+            stale_after_s=0.35)
+        return root, front, a, b, router
+
+    def _run_failover(self, tmp_path, lm, prompt, budget, seed=None,
+                      **cfg_kw):
+        """Route a stream to instance A, freeze A mid-stream (its health
+        file goes stale), fail the stream over, finish it on B; return
+        the terminal result."""
+        root, front, a, b, router = self._fleet_pair(tmp_path, lm, budget,
+                                                     **cfg_kw)
+        a.serve_step()       # writes fresh health: A is alive
+        b.serve_step()
+        inq = InputQueue(root)
+        inq.enqueue_prompt("s0", prompt, seed=seed)
+        assert router.route_once() == 1
+        assert a.queue.pending_count() == 1  # equal gauges: first wins
+        # A decodes until a partial (the failover prefix) exists, then
+        # "dies": we stop stepping it, so its health file freezes
+        partial = None
+        for _ in range(200):
+            a.serve_step()
+            partial = front.get_result("s0")
+            if partial is not None and len(partial.get("stream") or []) >= 2:
+                break
+        assert partial is not None and partial.get("done") is False
+        k = len(partial["stream"])
+        assert 0 < k < budget
+        time.sleep(0.45)     # A's health ages past stale_after_s
+        b.serve_step()       # B's stays fresh
+        router.route_once()  # detects the orphan, re-routes with prefix
+        assert b.queue.pending_count() == 1
+        _drive(b)
+        res = front.get_result("s0")
+        assert res is not None and res.get("done") is True
+        return res, k
+
+    def test_greedy_failover_bit_identical(self, ctx, tmp_path):
+        lm = _lm()
+        prompt = np.random.RandomState(7).randint(0, 16, (5,)).tolist()
+        budget = 10
+        want = lm.generate(np.asarray([prompt]),
+                           max_new_tokens=budget)[0].tolist()
+        fo_before = int(_fleet._M_FAILOVERS.value())
+        res, k = self._run_failover(tmp_path, lm, prompt, budget)
+        assert res["value"] == want, (
+            f"adopted stream diverged after {k} pre-kill tokens")
+        assert int(_fleet._M_FAILOVERS.value()) == fo_before + 1
+
+    def test_sampled_failover_bit_identical(self, ctx, tmp_path):
+        """The adopting server resumes the ORIGINAL key schedule: keys are
+        split over the full budget and indexed by len(tokens), so token k
+        uses the same key whether or not the stream was interrupted."""
+        lm = _lm()
+        prompt = np.random.RandomState(8).randint(0, 16, (4,)).tolist()
+        budget = 10
+        want = lm.generate(np.asarray([prompt]), max_new_tokens=budget,
+                           temperature=0.9, top_k=8, seed=123)[0].tolist()
+        res, k = self._run_failover(tmp_path, lm, prompt, budget,
+                                    seed=123, temperature=0.9, top_k=8)
+        assert res["value"] == want, (
+            f"sampled continuation diverged after {k} pre-kill tokens")
+
+    def test_drain_handoff_continues_token_identically(self, ctx,
+                                                       tmp_path):
+        """``handoff()`` — the cooperative half of failover: a draining
+        server re-enqueues its live streams (prefix + seed) itself
+        instead of waiting to be declared dead. No partials needed."""
+        lm = _lm()
+        prompt = np.random.RandomState(9).randint(0, 16, (5,)).tolist()
+        budget = 10
+        want = lm.generate(np.asarray([prompt]),
+                           max_new_tokens=budget)[0].tolist()
+        src = _src(tmp_path)
+        a = GenerativeServing(
+            ServingConfig(data_src=src, slots=2, max_new_tokens=budget,
+                          stream_interval=100), lm)
+        b = GenerativeServing(
+            ServingConfig(data_src=src, slots=2, max_new_tokens=budget,
+                          stream_interval=100), lm)
+        inq, outq = InputQueue(src), OutputQueue(src)
+        inq.enqueue_prompt("d0", prompt)
+        for _ in range(4):
+            a.serve_step()
+        assert a.health_snapshot()["slots_occupied"] == 1
+        assert a.handoff(a.queue) == 1
+        snap = a.health_snapshot()
+        assert snap["state"] == "drained"
+        assert snap["slots_occupied"] == 0 and snap["in_flight"] == 0
+        _drive(b)
+        res = outq.query("d0", timeout_s=5)
+        assert res is not None and res["value"] == want
+
+    def test_finished_budget_on_adoption_settles_immediately(self, ctx,
+                                                             tmp_path):
+        """A prefix that already covers the budget has nothing left to
+        decode: the adopter posts the terminal without taking a slot."""
+        lm = _lm()
+        src = _src(tmp_path)
+        b = GenerativeServing(
+            ServingConfig(data_src=src, slots=2, max_new_tokens=4), lm)
+        inq, outq = InputQueue(src), OutputQueue(src)
+        inq.enqueue_prompt("f0", [3, 1, 2], prefix=[5, 4, 3, 2])
+        b.serve_step()
+        res = outq.query("f0", timeout_s=5)
+        assert res is not None and res["value"] == [5, 4, 3, 2]
+        assert b.health_snapshot()["slots_occupied"] == 0
+
+    @pytest.mark.slow
+    def test_exactly_one_terminal_per_stream_under_failover(self, ctx,
+                                                            tmp_path):
+        """Kill A with 2 resident streams + 2 still queued in its spool:
+        all four must finish on B, each with exactly the serial tokens —
+        re-routed streams included."""
+        lm = _lm()
+        rs = np.random.RandomState(11)
+        prompts = [rs.randint(0, 16, (n,)).tolist() for n in (4, 5, 3, 6)]
+        budget = 10
+        want = [lm.generate(np.asarray([p]),
+                            max_new_tokens=budget)[0].tolist()
+                for p in prompts]
+        root = str(tmp_path / "fleet")
+        front = FileQueue(root)
+        qa, qb = instance_queue(root, "a"), instance_queue(root, "b")
+        ha, hb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+        a = GenerativeServing(
+            ServingConfig(data_src=root, slots=2, max_new_tokens=budget,
+                          stream_interval=2, health_path=ha,
+                          health_interval_s=0.001), lm, queue=qa)
+        b = GenerativeServing(
+            ServingConfig(data_src=root, slots=2, max_new_tokens=budget,
+                          stream_interval=2, health_path=hb,
+                          health_interval_s=0.001), lm, queue=qb)
+        router = _router(
+            front, [FleetInstance("a", qa, ha, slots=2),
+                    FleetInstance("b", qb, hb, slots=2)],
+            stale_after_s=0.35)
+        a.serve_step()  # A alive; B has no health yet -> everything to A
+        inq = InputQueue(root)
+        for i, p in enumerate(prompts):
+            inq.enqueue_prompt(f"m{i}", p)
+        router.route_once()
+        assert qa.pending_count() == 4  # all placed on A, none claimed yet
+        for _ in range(6):  # a few tokens into the resident streams
+            a.serve_step()
+        time.sleep(0.45)    # A dies
+        b.serve_step()      # B comes up fresh
+        router.route_once()  # steal spool + fail over residents
+        _drive(b, steps=400)
+        for i, w in enumerate(want):
+            res = front.get_result(f"m{i}")
+            assert res is not None and res.get("done") is True, f"m{i}"
+            assert res["value"] == w, f"stream m{i} diverged"

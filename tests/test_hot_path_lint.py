@@ -138,6 +138,40 @@ def test_lint_catches_seeded_etl_regressions(tmp_path):
     assert {w for _, _, w in found} == {"pd.concat()", "float()"}
 
 
+def test_lint_covers_fleet_router_scoring():
+    """The fleet router's placement scoring must stay under the hot-path
+    policy — it runs once per routed request over the instance-gauge
+    arrays and must stay a single vectorized pass."""
+    files = {os.path.basename(row[0]) for row in _lint._CHECKS}
+    assert "fleet.py" in files
+    funcs = {fn for row in _lint._CHECKS for fn in row[2]}
+    assert "_score_instances" in funcs
+
+
+def test_lint_catches_seeded_router_scoring_regressions(tmp_path):
+    """A per-instance Python loop or host sync seeded into the router
+    scoring body must trip the fleet rule."""
+    bad = tmp_path / "fleet.py"
+    bad.write_text(
+        "def _score_instances(alive, depth, in_flight, slots_free,\n"
+        "                     pages_free, service_s, token_s,\n"
+        "                     need_tokens, need_pages):\n"
+        "    est = [float(depth[i]) * service_s[i]\n"
+        "           for i in range(len(depth))]\n"
+        "    return np.asarray(est)\n")
+    found = _lint._check_file(str(bad), None, ("_score_instances",), (),
+                              True, "body")
+    whats = {w for _, _, w in found}
+    assert {"per-record Python loop", "float()", "np.asarray()"} <= whats
+
+
+def test_fleet_scoring_is_policed_clean():
+    """The real router scoring body must currently satisfy its own policy
+    — direct check, independent of _CHECKS."""
+    assert _lint._check_file(_lint.FLEET_PY, None, ("_score_instances",),
+                             (), True, "body") == []
+
+
 def test_etl_bodies_are_policed_clean():
     """The real ETL kernels/tasks must currently satisfy their own policy
     — direct check, independent of _CHECKS."""

@@ -109,6 +109,20 @@ class FakeRedis:
                                    - g["delivered"])})
         return out
 
+    def xinfo_consumers(self, stream, group):
+        g = self.groups.get((stream, group))
+        if g is None:
+            raise RuntimeError("NOGROUP")
+        now = _time.monotonic()
+        counts, idle = {}, {}
+        for eid, (consumer, ts) in g["pel"].items():
+            counts[consumer] = counts.get(consumer, 0) + 1
+            idle[consumer] = min(idle.get(consumer, float("inf")),
+                                 (now - ts) * 1000.0)
+        return [{"name": c.encode() if isinstance(c, str) else c,
+                 "pending": n, "idle": int(idle[c])}
+                for c, n in sorted(counts.items())]
+
     def xlen(self, stream):
         return len(self.streams.get(stream, []))
 
@@ -250,6 +264,36 @@ class TestAtMostOnceFix:
         assert self._pel() == {}  # shed entries are settled, not pending
         # the newest max_pending survive and serve normally
         assert [u for u, _ in q.claim_batch(10)] == ["u6", "u7", "u8", "u9"]
+
+
+class TestPerConsumerPending:
+    """XINFO CONSUMERS surfaces the true per-instance backlog — what each
+    consumer has claimed and not yet answered (the fleet router's
+    placement signal) — where group lag only shows undelivered work."""
+
+    def test_per_consumer_pending_counts(self, fake_redis):
+        from analytics_zoo_tpu.serving.queues import RedisQueue
+        qa = RedisQueue()
+        qb = RedisQueue()
+        for i in range(5):
+            qa.enqueue(f"u{i}", {"tensor": [i]})
+        assert [u for u, _ in qa.claim_batch(3)] == ["u0", "u1", "u2"]
+        assert [u for u, _ in qb.claim_batch(10)] == ["u3", "u4"]
+        assert qa.consumer_pending() == {qa.consumer: 3, qb.consumer: 2}
+        # answering settles the claim: the consumer's count drops
+        qa.put_result("u0", {"value": [0]})
+        qa.put_result("u1", {"value": [1]})
+        assert qa.consumer_pending()[qa.consumer] == 1
+        assert qa.consumer_pending()[qb.consumer] == 2
+
+    def test_degrades_to_empty_without_xinfo_consumers(self, fake_redis,
+                                                       monkeypatch):
+        from analytics_zoo_tpu.serving.queues import RedisQueue
+        q = RedisQueue()
+        q.enqueue("a", {"tensor": [1]})
+        q.claim_batch(10)
+        monkeypatch.delattr(FakeRedis, "xinfo_consumers")
+        assert q.consumer_pending() == {}
 
 
 class TestServingOverFakeRedis:

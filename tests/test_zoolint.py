@@ -277,6 +277,9 @@ HOST_STAGING_ROWS = {
     "_bucket_order", "_exchange_task", "_filter_task", "_gather_dest",
     "_groupby_task", "_handoff_task", "_join_match", "_join_task",
     "_mix64", "_stack_into", "_take_cols_into",
+    # fleet router placement scoring: host-side numpy over instance-gauge
+    # arrays — never traced, so jit discovery can't see it
+    "_score_instances",
 }
 
 
