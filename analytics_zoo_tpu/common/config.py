@@ -285,3 +285,27 @@ _global_config.register("fleet.scale_headroom", 1.25,
                         "Multiplier on observed demand when computing the "
                         "fleet.desired_instances scale signal (>1 keeps "
                         "spare capacity for failover).")
+_global_config.register("ingest.buffer_records", 4096,
+                        "Bounded-buffer capacity of the streaming ingest "
+                        "tier (journaled-but-unconsumed plus claimed-but-"
+                        "unreleased records); at capacity the ingest "
+                        "thread stops claiming, so backpressure surfaces "
+                        "as queue depth.")
+_global_config.register("ingest.watermark_s", 0.0,
+                        "Event-time watermark: a claimed record is "
+                        "released to the journal once its timestamp is "
+                        "at least this old (0 releases immediately); a "
+                        "full buffer force-releases regardless.")
+_global_config.register("ingest.poll_interval_s", 0.02,
+                        "Sleep between ingest polls when the queue is "
+                        "quiet, and between journal-growth checks on the "
+                        "consumer side.")
+_global_config.register("online.snapshot_interval_s", 30.0,
+                        "Default wall-time snapshot cadence for "
+                        "Estimator.train_online (unbounded streams "
+                        "checkpoint by time, not epoch boundaries).")
+_global_config.register("online.rollout_verify_timeout_s", 5.0,
+                        "How long the promotion coordinator polls an "
+                        "instance's health_snapshot for the new "
+                        "model_version before declaring the rollout "
+                        "failed and rolling back.")

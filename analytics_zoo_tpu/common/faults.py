@@ -142,6 +142,11 @@ REGISTRY: Dict[str, Site] = {
         "fleet router placement, once per routed request — a failed "
         "placement pass must park the request in the router backlog and "
         "retry it next pass (never lost, never double-enqueued)"),
+    "online.promote": Site(
+        "trainer→server promotion, once per instance before its reload "
+        "(canary is the 1st) — a rollout that dies at any instance must "
+        "roll every already-promoted instance back to the prior "
+        "model_version with zero dropped requests"),
 }
 
 

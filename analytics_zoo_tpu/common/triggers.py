@@ -136,6 +136,44 @@ class MinLoss(Trigger):
         return state.loss is not None and state.loss < self.min_loss
 
 
+class TimeInterval(Trigger):
+    """Fires when ``interval_s`` of wall time has elapsed since the last
+    fire (monotonic clock, immune to clock steps).  The online fine-tune
+    mode's snapshot cadence: unbounded streams have no meaningful epoch
+    boundary, so checkpoints are paced by time, not progress.  The timer
+    arms at the first check, so the first fire comes one full interval
+    into training."""
+
+    requires_loss = False
+
+    def __init__(self, interval_s: float):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.interval_s = float(interval_s)
+        self._last: Optional[float] = None
+
+    def __call__(self, state: TrainingState) -> bool:
+        import time
+        now = time.monotonic()
+        if self._last is None:
+            self._last = now
+            return False
+        if now - self._last >= self.interval_s:
+            self._last = now
+            return True
+        return False
+
+
+class Never(Trigger):
+    """Never fires — the end trigger for unbounded online training, which
+    runs until preempted (SIGTERM snapshot-and-exit) or killed."""
+
+    requires_loss = False
+
+    def __call__(self, state: TrainingState) -> bool:
+        return False
+
+
 class And(Trigger):
     def __init__(self, *triggers: Trigger):
         self.triggers = triggers

@@ -733,6 +733,44 @@ class Estimator:
         finally:
             restore_handler()
 
+    def train_online(self, train_set: FeatureSet, batch_size: int,
+                     max_steps: Optional[int] = None,
+                     end_trigger: Optional[Trigger] = None,
+                     snapshot_interval_s: Optional[float] = None,
+                     validation_set: Optional[FeatureSet] = None,
+                     validation_trigger: Optional[Trigger] = None,
+                     steps_per_dispatch: int = 1) -> Dict[str, Any]:
+        """Continual training off a stream: unbounded by default (runs
+        until SIGTERM preemption or ``max_steps``/``end_trigger``), with
+        snapshots paced by wall time (``snapshot_interval_s``, default
+        config ``online.snapshot_interval_s``) instead of epoch
+        boundaries — an unbounded stream has none worth waiting for.
+
+        This is :meth:`train` with online-shaped triggers; everything
+        else — resumable ``data_state`` capture, async checksummed
+        snapshots, elastic retry, preemption protection — is the same
+        loop.  Pair with a :class:`~analytics_zoo_tpu.online.stream.
+        QueueFeatureSet` (``FeatureSet.from_queue``) for exact resume:
+        its journal cursor rides in every snapshot's data_state.  Sparse
+        embedding updates (``sparse_rows``) make the per-step cost scale
+        with rows *touched* by the stream, not table size — see
+        docs/online.md."""
+        from ..common.triggers import MaxIteration, Never, TimeInterval
+        if snapshot_interval_s is None:
+            snapshot_interval_s = float(
+                global_config().get("online.snapshot_interval_s"))
+        if end_trigger is None:
+            end_trigger = (MaxIteration(int(max_steps))
+                           if max_steps is not None else Never())
+        checkpoint_trigger = (TimeInterval(snapshot_interval_s)
+                              if self._ckpt_dir else None)
+        return self.train(
+            train_set, batch_size, end_trigger=end_trigger,
+            validation_set=validation_set,
+            validation_trigger=validation_trigger,
+            checkpoint_trigger=checkpoint_trigger,
+            steps_per_dispatch=steps_per_dispatch)
+
     def _install_preemption_handler(self):
         """Install the SIGTERM→preempt-flag handler for the duration of a
         train() call; returns the undo callable. Signals only land on the

@@ -238,6 +238,19 @@ class FeatureSet(HostDataset):
         return cls(stack_records(records), None, **kwargs)
 
     @classmethod
+    def from_queue(cls, backend, journal_dir: str, epoch_records: int,
+                   **kwargs):
+        """Streaming ingest off a queue backend (FileQueue / RedisQueue
+        instance, or a ``dir://``/``redis://`` src string): a bounded-
+        buffer dataset with watermark/epoch release semantics and exact
+        ``data_state`` resume.  Returns a
+        :class:`~analytics_zoo_tpu.online.stream.QueueFeatureSet`; see
+        docs/online.md for the ingest model."""
+        from ..online.stream import QueueFeatureSet
+        return QueueFeatureSet(backend, journal_dir, epoch_records,
+                               **kwargs)
+
+    @classmethod
     def from_tfrecord(cls, paths: Union[str, Sequence[str]],
                       parser: Callable[[Dict[str, Any]],
                                        Union[Tuple[Any, Any], Any]],

@@ -1,0 +1,9 @@
+"""Online learning loop: streaming ingest → continual training →
+trainer→server promotion.  See docs/online.md."""
+
+from .promote import (PromotionError, Promoter, RollbackError,
+                      export_servable)
+from .stream import QueueFeatureSet
+
+__all__ = ["Promoter", "PromotionError", "QueueFeatureSet",
+           "RollbackError", "export_servable"]

@@ -1,0 +1,239 @@
+"""Trainer→server promotion: snapshot → canary reload → fleet rollout.
+
+``Promoter`` drives a servable export (a ``ZooModel.save_model`` dir)
+onto an ordered set of serving instances: the designated **canary**
+first, then the rest of the fleet one by one.  Each instance's reload
+runs the full canary machinery already inside
+:meth:`ClusterServing.reload_model` (load + prewarm + synthetic-batch
+predict off the serve path), and the promoter then verifies the
+instance *reports* the new version live via ``health_snapshot()`` —
+the stamp only lands on a successful swap, so a lying rollout is
+impossible.
+
+The rollback state machine is two-phase and exception-driven:
+
+    PROMOTING(inst_i)  --ok-->  PROMOTING(inst_{i+1})  --all ok-->  LANDED
+         |failure
+         v
+    ROLLING_BACK: every already-promoted instance reloads its prior
+    (path, version), newest-first; then PromotionError raises.
+
+A failure at the canary therefore touches nothing else; a failure
+mid-rollout restores the fleet to a single consistent prior version.
+Instances keep serving throughout — ``reload_model`` swaps atomically
+and never drops a request — so a mid-rollout chaos kill costs zero
+terminals.  Fault-injectable at ``online.promote`` (fires per-instance,
+before that instance's reload) on top of the existing
+``serving.reload`` site inside the reload itself.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..common import faults
+from ..common import metrics as zoo_metrics
+from ..common.config import global_config
+
+logger = logging.getLogger(__name__)
+
+_M_PROMOTIONS = zoo_metrics.counter(
+    "online.promotions_total",
+    "Promotion attempts by terminal outcome (landed / rolled_back).",
+    labels=("outcome",))
+_M_PROMOTE_S = zoo_metrics.histogram(
+    "online.promote_seconds",
+    "Wall time from promotion start to the new version live fleet-wide "
+    "(or to rollback complete on failure).")
+
+
+def export_servable(zoo_model, estimator, path: str) -> str:
+    """Materialize the trainer's live params as a servable ZooModel
+    export (``zoo_model.json`` + ``weights/``) at ``path``, whose
+    basename becomes the promotion version label.
+
+    The export *unshards*: serving replicas do whole-table dense
+    lookups, so sharded embedding tables drop their mesh-padding rows
+    and the exported config pins ``shard_embeddings=False``.  Non-param
+    model state (e.g. batchnorm statistics) is carried over where the
+    unsharded twin has a same-shaped slot; the sharded engine's
+    exchange-blob stash is not a servable artifact and is left behind.
+    """
+    import jax
+    import numpy as np
+
+    config = dict(zoo_model.get_config())
+    if "shard_embeddings" in config:
+        config["shard_embeddings"] = False
+    serve = type(zoo_model)(**config)
+    serve._ensure_built()
+    if not hasattr(serve.model, "loss_fn"):
+        serve.default_compile()
+    params0, state0 = serve.model.build(jax.random.PRNGKey(0))
+
+    def _fit(ref_tree, trained_tree, strict):
+        out = {}
+        for lname, group in ref_tree.items():
+            src = (trained_tree or {}).get(lname, {})
+            out[lname] = {}
+            for k, ref in group.items():
+                ref = np.asarray(ref)
+                w = src.get(k)
+                w = None if w is None else np.asarray(w)
+                if w is not None and w.ndim == ref.ndim \
+                        and w.shape[1:] == ref.shape[1:] \
+                        and w.shape[0] >= ref.shape[0]:
+                    w = w[:ref.shape[0]]  # drop mesh-padding rows
+                if w is None or w.shape != ref.shape:
+                    if strict:
+                        raise ValueError(
+                            f"cannot export {lname}/{k}: trained shape "
+                            f"{None if w is None else w.shape} does not "
+                            f"map onto servable shape {ref.shape}")
+                    w = ref  # derived state: fall back to fresh init
+                out[lname][k] = w
+        return out
+
+    trained = jax.device_get(estimator.params)
+    trained_state = jax.device_get(estimator.model_state)
+    est_s = serve.model.get_estimator()
+    est_s.set_params(_fit(params0, trained, strict=True))
+    est_s.set_model_state(_fit(state0 or {}, trained_state, strict=False))
+    serve.save_model(path)
+    return path
+
+
+class PromotionError(RuntimeError):
+    """A rollout failed; the fleet was rolled back to the prior version."""
+
+
+class RollbackError(PromotionError):
+    """A rollout failed AND rolling an instance back also failed — the
+    fleet may be version-split and needs operator attention."""
+
+
+class Promoter:
+    """Canary-first rollout coordinator over serving handles.
+
+    ``servers`` is an ordered ``{name: server}`` mapping; each server
+    exposes ``reload_model(path, model_type=..., version=...)``,
+    ``health_snapshot()`` and a ``config`` with ``model_path`` —
+    :class:`~analytics_zoo_tpu.serving.server.ClusterServing` qualifies
+    directly, in-process or driven over its queue.  ``canary`` names
+    the instance that takes the new version first (default: the first
+    mapping entry)."""
+
+    def __init__(self, servers: Dict[str, Any],
+                 canary: Optional[str] = None,
+                 model_type: str = "zoo",
+                 verify_timeout_s: Optional[float] = None):
+        if not servers:
+            raise ValueError("Promoter needs at least one server")
+        self.servers = dict(servers)
+        self.canary = canary if canary is not None else next(iter(servers))
+        if self.canary not in self.servers:
+            raise ValueError(f"canary {self.canary!r} not in servers")
+        self.model_type = model_type
+        cfg = global_config()
+        self.verify_timeout_s = float(
+            verify_timeout_s if verify_timeout_s is not None
+            else cfg.get("online.rollout_verify_timeout_s"))
+
+    # -- internals ------------------------------------------------------------
+
+    def _rollout_order(self) -> List[str]:
+        rest = [n for n in self.servers if n != self.canary]
+        return [self.canary] + rest
+
+    def _reload_one(self, name: str, path: Optional[str], version: str,
+                    model_type: Optional[str]) -> None:
+        """The single fault-injectable promotion step (one call site for
+        the ``online.promote`` chaos schedule: arm ``at=k`` — 1-based —
+        to kill the rollout at the k-th instance, canary being the 1st)."""
+        faults.inject("online.promote")
+        srv = self.servers[name]
+        srv.reload_model(path, model_type=model_type, version=version)
+
+    def _verify_live(self, name: str, version: str) -> None:
+        srv = self.servers[name]
+        deadline = time.monotonic() + self.verify_timeout_s
+        live = None
+        while True:
+            live = srv.health_snapshot().get("model_version")
+            if live == version:
+                return
+            if time.monotonic() >= deadline:
+                raise PromotionError(
+                    f"instance {name!r} reports model_version={live!r} "
+                    f"after reload, expected {version!r}")
+            time.sleep(0.01)
+
+    def _rollback(self, done: List[str], prior: Dict[str, Any]) -> None:
+        failures = []
+        for name in reversed(done):
+            path, version, model = prior[name]
+            try:
+                srv = self.servers[name]
+                if path:
+                    srv.reload_model(path, model_type=self.model_type,
+                                     version=version)
+                else:
+                    # instance was born with an inline model object —
+                    # swap the retained object back in (and undo the
+                    # model_path stamp the forward reload left behind)
+                    srv.reload_model(model=model, version=version)
+                    srv.config.model_path = ""
+                self._verify_live(name, version)
+            except Exception as e:  # keep unwinding; report at the end
+                logger.exception("rollback of %s to %r failed", name,
+                                 version)
+                failures.append((name, e))
+        if failures:
+            raise RollbackError(
+                "rollback failed on %s — fleet may be version-split" %
+                ", ".join(f"{n} ({e!r})" for n, e in failures))
+
+    # -- API ------------------------------------------------------------------
+
+    def promote(self, model_path: str, version: Optional[str] = None,
+                model_type: Optional[str] = None) -> str:
+        """Roll ``model_path`` across the fleet, canary first.  Returns
+        the landed version label.  On any failure the already-promoted
+        instances are rolled back to their prior (path, version) and
+        :class:`PromotionError` raises — the fleet never stays split."""
+        import os
+        version = version or (os.path.basename(
+            str(model_path).rstrip("/")) or "unversioned")
+        model_type = model_type or self.model_type
+        # retain (path, version, live model object) per instance so a
+        # rollback works even for instances born with inline models
+        prior = {n: (getattr(s.config, "model_path", None) or None,
+                     getattr(s, "model_version", "inline-0"),
+                     getattr(s, "model", None))
+                 for n, s in self.servers.items()}
+        t0 = time.monotonic()
+        done: List[str] = []
+        try:
+            for name in self._rollout_order():
+                self._reload_one(name, model_path, version, model_type)
+                self._verify_live(name, version)
+                done.append(name)
+                logger.info("promotion %s live on %s%s", version, name,
+                            " (canary)" if name == self.canary else "")
+        except Exception as e:
+            try:
+                self._rollback(done, prior)
+            finally:
+                _M_PROMOTIONS.labels(outcome="rolled_back").inc()
+                _M_PROMOTE_S.observe(time.monotonic() - t0)
+            if isinstance(e, PromotionError):
+                raise
+            raise PromotionError(
+                f"promotion of {version!r} failed at instance "
+                f"{self._rollout_order()[len(done)]!r} ({e!r}); fleet "
+                f"rolled back to prior versions") from e
+        _M_PROMOTIONS.labels(outcome="landed").inc()
+        _M_PROMOTE_S.observe(time.monotonic() - t0)
+        return version

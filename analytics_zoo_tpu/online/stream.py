@@ -1,0 +1,368 @@
+"""Streaming ingest: a bounded-buffer FeatureSet over a queue backend.
+
+``QueueFeatureSet`` turns a :class:`~analytics_zoo_tpu.serving.queues.
+QueueBackend` (FileQueue / RedisQueue) into a dataset the Estimator can
+train on forever.  The design separates two concerns:
+
+* **Ingest** — a daemon thread claims records from the queue into a
+  small in-memory pending list and *releases* them to an append-only
+  JSONL journal when the watermark passes (``wall_clock() - record_ts
+  >= watermark_s``) or the bounded buffer fills.  The thread stops
+  claiming while the buffer (journaled-but-unconsumed + pending) is at
+  ``ingest.buffer_records``, so backpressure is visible to producers as
+  queue depth.
+
+* **Consumption** — batches are a pure function of journal order.  The
+  dataset keeps a single committed cursor ``(records, bytes, crc)``
+  into the journal; ``train_iterator`` reads forward from it and
+  commits it once per epoch window, just before handing out the
+  window's last batch.  ``data_state()`` serializes the cursor —
+  queue offset plus a rolling CRC-32 buffer digest — and
+  ``set_data_state`` verifies the digest against the journal before
+  rewinding, so a killed consumer resumes bit-reproducibly: the
+  journal replays in the identical order the first run saw.
+
+The journal is the durability and reproducibility boundary.  A crash
+between queue claim and journal append can drop (FileQueue) or
+redeliver (RedisQueue pending-entry reclaim) the claimed-but-unreleased
+records — the same window any consumer with a local pre-commit buffer
+has — but everything past the journal replays exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common import file_io
+from ..common import metrics as zoo_metrics
+from ..common.config import global_config
+from ..common.utils import wall_clock
+from ..feature.featureset import HostDataset
+from ..serving.queues import QueueBackend, make_queue
+
+_M_RECORDS = zoo_metrics.counter(
+    "ingest.records_total",
+    "Records released from the queue into the streaming journal "
+    "(past the watermark or on buffer-full force release).")
+_M_DEPTH = zoo_metrics.gauge(
+    "ingest.buffer_depth",
+    "Fill level of the bounded ingest buffer: journaled-but-unconsumed "
+    "plus claimed-but-unreleased records.")
+_M_LAG = zoo_metrics.gauge(
+    "ingest.watermark_lag_seconds",
+    "Ingest-time age of the newest record released past the watermark "
+    "(how far behind event time the journal is running).")
+
+
+def _default_record_fn(rec: Dict[str, Any]) -> Tuple[Any, Any]:
+    """Queue payload → ``(x, y)`` training record.  JSON numbers decode
+    as float64/int64; narrow to the f32/i32 the accelerators use so a
+    journal replay is dtype-identical to live ingest."""
+    def narrow(v):
+        a = np.asarray(v)
+        if a.dtype == np.float64:
+            return a.astype(np.float32)
+        if a.dtype == np.int64:
+            return a.astype(np.int32)
+        return a
+    x = rec["x"]
+    x = tuple(narrow(v) for v in x) if isinstance(x, (list, tuple)) and \
+        x and isinstance(x[0], (list, tuple)) else narrow(x)
+    y = narrow(rec["y"]) if "y" in rec else None
+    return x, y
+
+
+class QueueFeatureSet(HostDataset):
+    """Bounded-buffer streaming dataset over a queue backend.
+
+    ``epoch_records`` defines the *epoch window*: the Estimator sees a
+    dataset of that size and runs its normal epoch loop; each "epoch"
+    consumes the next ``epoch_records`` records off the journal.  The
+    committed cursor only ever advances at window boundaries, so the
+    Estimator's epoch-start ``data_state()`` capture and mid-epoch
+    ``skip_batches`` replay compose with it unchanged — and throwaway
+    iterators (the sample draw the Estimator uses for model init) never
+    lose records, because an uncommitted read position dies with its
+    iterator.
+    """
+
+    def __init__(self, backend, journal_dir: str, epoch_records: int,
+                 buffer_records: Optional[int] = None,
+                 watermark_s: Optional[float] = None,
+                 poll_interval_s: Optional[float] = None,
+                 record_fn: Optional[Callable[[Dict[str, Any]],
+                                              Tuple[Any, Any]]] = None,
+                 claim_chunk: int = 64):
+        cfg = global_config()
+        if isinstance(backend, str):
+            backend = make_queue(backend)
+        if not isinstance(backend, QueueBackend):
+            raise TypeError("backend must be a QueueBackend or src string, "
+                            "got %r" % (backend,))
+        if epoch_records < 1:
+            raise ValueError("epoch_records must be >= 1")
+        self.backend = backend
+        self.journal_dir = journal_dir
+        self.journal_path = os.path.join(journal_dir, "journal.jsonl")
+        self.epoch_records = int(epoch_records)
+        self.buffer_records = int(
+            buffer_records if buffer_records is not None
+            else cfg.get("ingest.buffer_records"))
+        self.watermark_s = float(
+            watermark_s if watermark_s is not None
+            else cfg.get("ingest.watermark_s"))
+        self.poll_interval_s = float(
+            poll_interval_s if poll_interval_s is not None
+            else cfg.get("ingest.poll_interval_s"))
+        self.record_fn = record_fn or _default_record_fn
+        self.claim_chunk = max(1, int(claim_chunk))
+
+        # FeatureSet contract surface.
+        self.size = self.epoch_records
+        self.num_slices = 1
+        self.shuffle = False  # order is journal order, by construction
+
+        file_io.makedirs(journal_dir)
+        # Resume-aware append position: scan whatever journal already
+        # exists so a restarted ingest thread appends, never truncates.
+        self._append_lock = threading.Lock()
+        self._journal_records = 0
+        self._journal_bytes = 0
+        if os.path.exists(self.journal_path):
+            with open(self.journal_path, "rb") as f:
+                data = f.read()
+            # Ignore a torn trailing line (crash mid-append): appends
+            # resume at the last newline so the journal stays parseable.
+            keep = data.rfind(b"\n") + 1
+            if keep < len(data):
+                with open(self.journal_path, "r+b") as f:
+                    f.truncate(keep)
+                data = data[:keep]
+            self._journal_records = data.count(b"\n")
+            self._journal_bytes = len(data)
+
+        # Committed consumption cursor (the resume point).
+        self._cursor = {"records": 0, "bytes": 0, "crc": 0}
+        # High-water mark of records actually DELIVERED to a consumer —
+        # distinct from the cursor, which only advances at epoch
+        # boundaries: buffer accounting off the cursor would wedge
+        # (ingest stops claiming mid-epoch while the consumer starves).
+        self._consumed_hwm = 0
+
+        self._closed = False
+        self._ingest_thread: Optional[threading.Thread] = None
+        self._ingest_error: Optional[BaseException] = None
+
+    # -- contract -------------------------------------------------------------
+
+    def num_batches(self, batch_size: int, drop_remainder: bool = True) -> int:
+        if drop_remainder:
+            return self.size // batch_size
+        return (self.size + batch_size - 1) // batch_size
+
+    def slice_boundaries(self, batch_size: int) -> Sequence[int]:
+        return [self.num_batches(batch_size)]
+
+    # -- data_state: queue offset + buffer digest -----------------------------
+
+    def data_state(self) -> str:
+        """Committed cursor as JSON: record/byte offsets into the journal
+        plus the CRC-32 of every consumed byte (the buffer digest)."""
+        return json.dumps(dict(self._cursor))
+
+    def set_data_state(self, state: str) -> None:
+        """Rewind to a saved cursor, verifying the journal prefix still
+        hashes to the saved digest — a resume against a journal that
+        diverged (wrong dir, lost records) fails loudly, not silently."""
+        pos = json.loads(state)
+        cur = {"records": int(pos["records"]), "bytes": int(pos["bytes"]),
+               "crc": int(pos["crc"])}
+        if cur["bytes"]:
+            try:
+                with open(self.journal_path, "rb") as f:
+                    prefix = f.read(cur["bytes"])
+            except FileNotFoundError:
+                prefix = b""
+            if len(prefix) < cur["bytes"]:
+                raise ValueError(
+                    "journal %s is shorter (%d bytes) than the saved "
+                    "cursor (%d bytes): cannot resume" %
+                    (self.journal_path, len(prefix), cur["bytes"]))
+            crc = zlib.crc32(prefix)
+            if crc != cur["crc"]:
+                raise ValueError(
+                    "journal digest mismatch at byte %d: saved crc=%d, "
+                    "journal crc=%d — the journal is not the one this "
+                    "data_state was taken against" %
+                    (cur["bytes"], cur["crc"], crc))
+        self._cursor = cur
+        if cur["records"] > self._consumed_hwm:
+            self._consumed_hwm = cur["records"]
+
+    # -- ingest side ----------------------------------------------------------
+
+    def _ensure_ingest(self) -> None:
+        if self._closed:
+            raise RuntimeError("QueueFeatureSet is closed")
+        if self._ingest_thread is None or not self._ingest_thread.is_alive():
+            self._ingest_thread = threading.Thread(
+                target=self._ingest_loop, daemon=True, name="queue-ingest")
+            self._ingest_thread.start()
+
+    def _backlog(self) -> int:
+        return self._journal_records - max(self._consumed_hwm,
+                                           self._cursor["records"])
+
+    def _append_journal(self, recs) -> None:
+        payload = b"".join(
+            json.dumps(r, sort_keys=True).encode() + b"\n" for r in recs)
+        with self._append_lock:
+            with open(self.journal_path, "ab") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            self._journal_records += len(recs)
+            self._journal_bytes += len(payload)
+        _M_RECORDS.inc(len(recs))
+
+    def _ingest_loop(self) -> None:
+        pending: "deque" = deque()  # (event_ts, record)
+        try:
+            while not self._closed:
+                free = self.buffer_records - self._backlog() - len(pending)
+                claimed = []
+                if free > 0:
+                    claimed = self.backend.claim_batch(
+                        min(free, self.claim_chunk))
+                    now = wall_clock()
+                    for _uri, rec in claimed:
+                        pending.append((float(rec.get("ts", now)), rec))
+                # Release: watermark passed, or buffer full forces the
+                # oldest out so ingest never deadlocks on a quiet stream.
+                now = wall_clock()
+                full = (self._backlog() + len(pending)) \
+                    >= self.buffer_records
+                released = []
+                while pending and (full or
+                                   now - pending[0][0] >= self.watermark_s):
+                    released.append(pending.popleft()[1])
+                if released:
+                    self._append_journal(released)
+                    _M_LAG.set(max(0.0, now - float(
+                        released[-1].get("ts", now))))
+                _M_DEPTH.set(self._backlog() + len(pending))
+                if not claimed and not released:
+                    time.sleep(self.poll_interval_s)
+        except BaseException as e:  # surfaced by the consumer side
+            self._ingest_error = e
+
+    # -- consumption side -----------------------------------------------------
+
+    def _read_records(self, pos: Dict[str, int], n: int):
+        """Read ``n`` journal records starting at ``pos``, blocking on
+        journal growth.  Advances ``pos`` in place (records/bytes/crc)."""
+        out = []
+        f = None
+        try:
+            while len(out) < n:
+                if self._ingest_error is not None:
+                    raise self._ingest_error
+                if self._closed:
+                    raise RuntimeError("QueueFeatureSet closed mid-read")
+                if f is None:
+                    if not os.path.exists(self.journal_path):
+                        time.sleep(self.poll_interval_s)
+                        continue
+                    f = open(self.journal_path, "rb")
+                    f.seek(pos["bytes"])
+                line = f.readline()
+                if not line.endswith(b"\n"):
+                    # Torn tail or end of journal: rewind and wait for
+                    # the ingest thread to finish the line.
+                    f.seek(pos["bytes"])
+                    time.sleep(self.poll_interval_s)
+                    continue
+                pos["bytes"] += len(line)
+                pos["crc"] = zlib.crc32(line, pos["crc"])
+                pos["records"] += 1
+                if pos["records"] > self._consumed_hwm:
+                    self._consumed_hwm = pos["records"]
+                out.append(json.loads(line))
+                _M_DEPTH.set(max(0, self._backlog()))
+        finally:
+            if f is not None:
+                f.close()
+        return out
+
+    def _assemble(self, recs) -> Tuple[Any, Any]:
+        from ..feature.preprocessing import stack_records
+        pairs = [self.record_fn(r) for r in recs]
+        xs = stack_records([p[0] for p in pairs])
+        ys = None
+        if pairs[0][1] is not None:
+            ys = stack_records([p[1] for p in pairs])
+        return xs, ys
+
+    def train_iterator(self, batch_size: int, skip_batches: int = 0
+                       ) -> Iterator[Tuple[Any, Any]]:
+        """One epoch window per call: yields ``epoch_records //
+        batch_size`` batches read forward from the committed cursor,
+        then stops.  The cursor commits just before the last batch is
+        handed out, so by the time the train loop observes the epoch
+        end, ``data_state()`` is the post-epoch resume point — and a
+        finite iterator means an eager prefetcher can never read past
+        the window into records the next epoch's iterator must see."""
+        self._ensure_ingest()
+        per_epoch = self.num_batches(batch_size)
+        if per_epoch < 2:
+            raise ValueError(
+                "epoch_records (%d) must cover at least 2 batches of %d: "
+                "the Estimator draws one throwaway batch for model init "
+                "and a 1-batch window would commit the cursor on it" %
+                (self.epoch_records, batch_size))
+        pos = dict(self._cursor)
+        for i in range(per_epoch):
+            batch = self._assemble(self._read_records(pos, batch_size))
+            if i == per_epoch - 1:
+                self._cursor = dict(pos)
+            if i >= skip_batches:
+                yield batch
+
+    def eval_iterator(self, batch_size: int, pad_remainder: bool = False
+                      ) -> Iterator[Tuple[Any, Any, int]]:
+        """Evaluates on the most recent full window *behind* the cursor
+        (the records just trained on) without moving it — online eval is
+        a rearview mirror, not a second consumer of the stream."""
+        start_rec = max(0, self._cursor["records"] - self.epoch_records)
+        pos = {"records": 0, "bytes": 0, "crc": 0}
+        if start_rec:
+            self._read_records(pos, start_rec)  # cheap scan to the window
+        avail = min(self.epoch_records,
+                    self._cursor["records"] - start_rec)
+        done = 0
+        while done + batch_size <= avail:
+            recs = self._read_records(pos, batch_size)
+            x, y = self._assemble(recs)
+            yield x, y, batch_size
+            done += batch_size
+        rem = avail - done
+        if rem:
+            recs = self._read_records(pos, rem)
+            if pad_remainder:
+                recs = recs + [recs[-1]] * (batch_size - rem)
+            x, y = self._assemble(recs)
+            yield x, y, rem
+
+    def close(self) -> None:
+        self._closed = True
+        t = self._ingest_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)

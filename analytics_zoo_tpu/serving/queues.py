@@ -25,7 +25,7 @@ import os
 from ..common import file_io
 from ..common.utils import wall_clock
 import uuid
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 
 class QueueBackend:
@@ -33,6 +33,14 @@ class QueueBackend:
 
     def enqueue(self, uri: str, payload: Dict[str, Any]) -> None:
         raise NotImplementedError
+
+    def enqueue_many(self, items: Sequence[Tuple[str, Dict[str, Any]]]
+                     ) -> None:
+        """Enqueue a batch of ``(uri, payload)`` records. Backends override
+        this with an amortized publish (one rename / one pipeline round-trip
+        per batch); the default is the per-record loop."""
+        for uri, payload in items:
+            self.enqueue(uri, payload)
 
     def claim_batch(self, max_items: int) -> List[Tuple[str, Dict[str, Any]]]:
         """Atomically claim up to ``max_items`` pending requests."""
@@ -96,6 +104,67 @@ class FileQueue(QueueBackend):
         with file_io.fopen(tmp, "w") as f:
             f.write(json.dumps({"uri": uri, **payload}))
         file_io.replace(tmp, file_io.join(self.req_dir, name))  # atomic publish
+
+    def enqueue_many(self, items: Sequence[Tuple[str, Dict[str, Any]]]
+                     ) -> None:
+        """Batched publish: all records are written into a hidden staging
+        dir and made visible with ONE directory rename — a streaming
+        producer pays one atomic publish per batch instead of one
+        tmp-write + rename per record. Consumers flatten published batch
+        dirs back into the spool lazily (see :meth:`_flatten_batches`).
+        Remote spools rename by copy+delete (not atomic), so they fall
+        back to the per-record loop."""
+        items = list(items)
+        if not items:
+            return
+        if file_io.is_remote(self.req_dir):
+            for uri, payload in items:
+                self.enqueue(uri, payload)
+            return
+        stage = file_io.join(self.req_dir, f".stage-{uuid.uuid4().hex[:8]}")
+        file_io.makedirs(stage, exist_ok=True)
+        for uri, payload in items:
+            name = (f"{int(wall_clock() * 1e9):020d}-"
+                    f"{uuid.uuid4().hex[:8]}.json")
+            with file_io.fopen(file_io.join(stage, name), "w") as f:
+                f.write(json.dumps({"uri": uri, **payload}))
+        batch = file_io.join(
+            self.req_dir,
+            f"batch-{int(wall_clock() * 1e9):020d}-{uuid.uuid4().hex[:8]}")
+        file_io.replace(stage, batch)  # one rename publishes the batch
+
+    def _flatten_batches(self, names: List[str]) -> List[str]:
+        """Expand ``batch-*`` dirs published by :meth:`enqueue_many` into
+        top-level record files and return the claimable names. Each member
+        move is an atomic rename, so a consumer crashing mid-flatten
+        leaves the rest claimable by the next lister; concurrent
+        flatteners race per file and the loser skips (same stance as
+        claims)."""
+        out = [n for n in names if not n.startswith("batch-")]
+        for bname in names:
+            if not bname.startswith("batch-"):
+                continue
+            bdir = file_io.join(self.req_dir, bname)
+            try:
+                members = file_io.listdir(bdir, refresh=True)
+            except (FileNotFoundError, NotADirectoryError, OSError):
+                continue
+            for m in members:
+                try:
+                    file_io.replace(file_io.join(bdir, m),
+                                    file_io.join(self.req_dir, m))
+                    out.append(m)
+                except (OSError, FileNotFoundError):
+                    pass  # another consumer moved it first
+            try:
+                # drop the dir only once it is verifiably empty — a move
+                # that failed for any reason other than losing a race
+                # must leave its record claimable on the next pass
+                if not file_io.listdir(bdir, refresh=True):
+                    file_io.rmtree(bdir)
+            except (OSError, FileNotFoundError):
+                pass
+        return out
 
     def _claim_one(self, name: str) -> Optional[str]:
         """Claim one request; returns the path to read it from, or None if
@@ -224,7 +293,8 @@ class FileQueue(QueueBackend):
         try:
             # refresh: another process's enqueues must be visible despite
             # fsspec listing caches (remote spools)
-            names = sorted(file_io.listdir(self.req_dir, refresh=True))
+            names = sorted(self._flatten_batches(
+                file_io.listdir(self.req_dir, refresh=True)))
         except FileNotFoundError:
             return out
         for name in names:
@@ -250,8 +320,8 @@ class FileQueue(QueueBackend):
     def shed(self, max_pending: int,
              reason: str = "shed: queue overloaded") -> List[str]:
         try:
-            names = sorted(n for n in file_io.listdir(self.req_dir,
-                                                      refresh=True)
+            names = sorted(n for n in self._flatten_batches(
+                file_io.listdir(self.req_dir, refresh=True))
                            if not n.startswith("."))
         except FileNotFoundError:
             return []
@@ -300,14 +370,30 @@ class FileQueue(QueueBackend):
         return out
 
     def pending_count(self) -> int:
+        """Backlog depth, counting INTO published-but-unflattened batch
+        dirs (read-only — depth accounting must not mutate the spool)."""
         try:
-            return sum(1 for n in file_io.listdir(self.req_dir, refresh=True)
-                       if not n.startswith("."))
+            count = 0
+            for n in file_io.listdir(self.req_dir, refresh=True):
+                if n.startswith("."):
+                    continue
+                if n.startswith("batch-"):
+                    try:
+                        count += sum(
+                            1 for m in file_io.listdir(
+                                file_io.join(self.req_dir, n), refresh=True)
+                            if not m.startswith("."))
+                    except (FileNotFoundError, NotADirectoryError, OSError):
+                        pass
+                else:
+                    count += 1
+            return count
         except FileNotFoundError:
             return 0
 
     def trim(self, max_pending: int) -> int:
-        names = sorted(n for n in file_io.listdir(self.req_dir, refresh=True)
+        names = sorted(n for n in self._flatten_batches(
+            file_io.listdir(self.req_dir, refresh=True))
                        if not n.startswith("."))
         dropped = 0
         for name in names[:max(0, len(names) - max_pending)]:
@@ -358,6 +444,19 @@ class RedisQueue(QueueBackend):
     def enqueue(self, uri: str, payload: Dict[str, Any]) -> None:
         self.db.xadd(self.STREAM, {"uri": uri,
                                    "data": json.dumps(payload)})
+
+    def enqueue_many(self, items: Sequence[Tuple[str, Dict[str, Any]]]
+                     ) -> None:
+        """Pipelined XADD: one round-trip per batch instead of one per
+        record (order within the batch is preserved — a pipeline executes
+        commands in submission order)."""
+        items = list(items)
+        if not items:
+            return
+        pipe = self.db.pipeline()
+        for uri, payload in items:
+            pipe.xadd(self.STREAM, {"uri": uri, "data": json.dumps(payload)})
+        pipe.execute()
 
     def _reclaim_stale(self, max_items: int) -> List:
         """XAUTOCLAIM entries whose claiming consumer died before acking

@@ -115,6 +115,14 @@ _M_SPEC_ACCEPT = _metrics.gauge(
 _instance_ids = itertools.count()
 
 
+def _model_version_of(path: Optional[str]) -> str:
+    """Version label for a servable path: its basename (snapshot export
+    dirs are named by version), or ``inline-0`` for models handed over
+    as live objects with no path to name them by."""
+    base = os.path.basename(str(path or "").rstrip("/"))
+    return base or "inline-0"
+
+
 class ModelReloadError(RuntimeError):
     """``reload_model`` failed; the PREVIOUS model is still serving."""
 
@@ -138,6 +146,12 @@ class ClusterServing:
         self.config = config
         self.queue = queue if queue is not None else make_queue(config.data_src)
         self.model = model if model is not None else self._load_model()
+        # which snapshot is live: stamped here and on every successful
+        # reload_model — the promotion canary verifies it via health
+        self.model_version = _model_version_of(
+            config.model_path if (model is None or config.model_path)
+            else None)
+        self._inline_versions = itertools.count(1)
         # compile warmth before traffic: the first claimed micro-batch must
         # hit an already-compiled program, not eat a multi-second XLA
         # compile while clients poll (InferenceModel.compile_counts proves
@@ -601,6 +615,7 @@ class ClusterServing:
                            "window": self._m_latency.count()},
             "counters": counters,
             "prewarmed": self.prewarmed,
+            "model_version": self.model_version,
             "error": repr(err) if err is not None else None,
         }
 
@@ -649,7 +664,8 @@ class ClusterServing:
 
     def reload_model(self, model_path: Optional[str] = None, *,
                      model: Optional[InferenceModel] = None,
-                     model_type: Optional[str] = None) -> InferenceModel:
+                     model_type: Optional[str] = None,
+                     version: Optional[str] = None) -> InferenceModel:
         """Hot-swap the serving model with canary + rollback. The candidate
         loads and prewarms OFF the serve path (the old model keeps serving
         the whole time), canary-predicts one synthetic batch, and only then
@@ -696,6 +712,15 @@ class ClusterServing:
                     cfg.model_path = model_path
                     if model_type:
                         cfg.model_type = model_type
+                # stamp only on success: a failed reload leaves both the
+                # old model AND its version label live
+                if version is not None:
+                    self.model_version = version
+                elif model_path is not None:
+                    self.model_version = _model_version_of(model_path)
+                else:
+                    self.model_version = \
+                        f"inline-{next(self._inline_versions)}"
                 self._count("reloads")
                 logger.info("model reloaded%s",
                             f" from {model_path}" if model_path else "")
@@ -1006,6 +1031,7 @@ class GenerativeServing:
 
         self.config = config
         self.lm = lm
+        self.model_version = _model_version_of(config.model_path)
         self.queue = (queue if queue is not None
                       else make_queue(config.data_src))
         if config.slots < 1:
@@ -2062,6 +2088,7 @@ class GenerativeServing:
                            "p99": _pct(self._m_latency, 0.99),
                            "window": self._m_latency.count()},
             "counters": self.counters,
+            "model_version": self.model_version,
             "error": repr(err) if err is not None else None,
         }
 

@@ -2449,6 +2449,101 @@ def bench_recovery(batch_size: int = 256, steps_per_epoch: int = 8,
                         "+ replay of the failed step + feed re-setup"})
 
 
+def bench_online_learning(windows: int = 4, batch_size: int = 4096,
+                          users: int = 200_000, items: int = 100_000):
+    """Online loop throughput: clicks/s from queue → journal →
+    `train_online` on a sharded NCF, with one trainer→server promotion
+    timed on top (export_servable + canaried rollout, verified live).
+    The metric is the END-TO-END stream rate — ingest thread, journal
+    fsync, and the row-subset sparse step all on the clock."""
+    import tempfile
+
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.estimator import Estimator
+    from analytics_zoo_tpu.feature import FeatureSet
+    from analytics_zoo_tpu.keras import objectives, optimizers
+    from analytics_zoo_tpu.models import NeuralCF
+    from analytics_zoo_tpu.online import Promoter, export_servable
+    from analytics_zoo_tpu.serving.queues import make_queue
+    from analytics_zoo_tpu.serving.server import (ClusterServing,
+                                                  ServingConfig)
+
+    ctx = init_tpu_context()
+    batch_size = max(ctx.num_devices,
+                     (batch_size // ctx.num_devices) * ctx.num_devices)
+    epoch_records = batch_size * 2
+    clicks = epoch_records * windows
+
+    root = tempfile.mkdtemp(prefix="zoo_bench_online_")
+    q = make_queue(f"dir://{root}/clicks")
+    rs = np.random.RandomState(0)
+    uid = rs.randint(1, users + 1, clicks)
+    iid = rs.randint(1, items + 1, clicks)
+    lab = ((uid % 2) == (iid % 2)).astype(int)
+    t0 = time.perf_counter()
+    for lo in range(0, clicks, 8192):
+        q.enqueue_many([
+            (f"c{i}", {"x": [int(uid[i]), int(iid[i])], "y": int(lab[i]),
+                       "ts": 0.0})
+            for i in range(lo, min(lo + 8192, clicks))])
+    enqueue_s = time.perf_counter() - t0
+    _note_partial(enqueue_mrec_per_sec=round(clicks / enqueue_s / 1e6, 3))
+
+    ncf = NeuralCF(users, items, 2, user_embed=16, item_embed=16,
+                   hidden_layers=(32, 16), mf_embed=16,
+                   shard_embeddings=True)
+    est = Estimator(model=ncf.build_model(),
+                    loss_fn=objectives.get(
+                        "sparse_categorical_crossentropy"),
+                    optimizer=optimizers.SGD(0.1), mesh=ctx.mesh, seed=7)
+    fs = FeatureSet.from_queue(q, os.path.join(root, "journal"),
+                               epoch_records=epoch_records, watermark_s=0.0)
+    try:
+        # warm: first window pays compile + ingest spin-up
+        est.train_online(fs, batch_size=batch_size,
+                         max_steps=epoch_records // batch_size)
+        t0 = time.perf_counter()
+        est.train_online(fs, batch_size=batch_size,
+                         max_steps=(clicks // batch_size))
+        train_s = time.perf_counter() - t0
+        timed_clicks = clicks - epoch_records
+        _note_partial(metric="online_clicks_per_sec",
+                      value=round(timed_clicks / train_s, 1), unit="rec/s",
+                      steps=int(est.global_step))
+
+        # promotion on top: export the live params, roll a 1-instance
+        # fleet forward with the live-version verification on the clock
+        t0 = time.perf_counter()
+        export = export_servable(ncf, est, f"{root}/exports/v1")
+        export_s = time.perf_counter() - t0
+        # instance born on the first export; the second promotes onto it
+        srv = ClusterServing(ServingConfig(
+            data_src=f"dir://{root}/srv", model_path=export,
+            model_type="zoo", image_shape=(2,), batch_size=4,
+            batch_wait_ms=5))
+        export2 = export_servable(ncf, est, f"{root}/exports/v2")
+        t0 = time.perf_counter()
+        version = Promoter({"canary": srv}).promote(export2)
+        promote_s = time.perf_counter() - t0
+    finally:
+        fs.close()
+
+    return _BenchResult(
+        metric="online_clicks_per_sec",
+        value=round(timed_clicks / train_s, 1),
+        unit="rec/s", mfu=None,
+        detail={"windows": windows, "batch_size": batch_size,
+                "epoch_records": epoch_records, "clicks": clicks,
+                "steps": int(est.global_step),
+                "enqueue_mrec_per_sec": round(clicks / enqueue_s / 1e6, 3),
+                "export_ms": round(export_s * 1e3, 1),
+                "promote_ms": round(promote_s * 1e3, 1),
+                "promoted_version": version,
+                "note": "clicks/s through queue→journal→train_online on "
+                        "sharded NCF (row-subset updates); promote_ms = "
+                        "canaried rollout incl. load+prewarm+verify-live"})
+
+
 _WORKLOADS = {
     "resnet50": bench_resnet50,
     "recovery": bench_recovery,
@@ -2467,6 +2562,7 @@ _WORKLOADS = {
     "quantized": bench_quantized,
     "pipeline": bench_input_pipeline,
     "etl_to_train": bench_etl_to_train,
+    "online_learning": bench_online_learning,
 }
 
 # spelling aliases accepted on the CLI (resolved in main, NOT in the dict —
@@ -3196,6 +3292,78 @@ def _ratio_fleet():
             "routed3_vs_single_ratio": round(t1 / max(t3, 1e-9), 2)}
 
 
+def _ratio_online():
+    """Online row-subset continual training vs full-batch retrain at
+    equal clicks — the online_learning workload's win shrunk to CPU
+    scale. Each of W click windows either (a) advances ONE continual
+    trainer by a window of steps off the stream journal, or (b)
+    retrains a fresh model from scratch on every click seen so far —
+    the offline baseline an online loop replaces. Equal clicks served
+    to the serving fleet either way; the ratio is wall time."""
+    import tempfile
+
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.estimator import Estimator
+    from analytics_zoo_tpu.feature import FeatureSet
+    from analytics_zoo_tpu.keras import objectives, optimizers
+    from analytics_zoo_tpu.models import NeuralCF
+    from analytics_zoo_tpu.serving.queues import make_queue
+
+    init_tpu_context()
+    users, items, batch, windows = 400, 360, 32, 4
+    window_records = batch * 4
+    rs = np.random.RandomState(0)
+    uid = rs.randint(1, users + 1, window_records * windows)
+    iid = rs.randint(1, items + 1, window_records * windows)
+    lab = ((uid % 2) == (iid % 2)).astype(np.float32)
+
+    def make_est():
+        ncf = NeuralCF(users, items, 2, user_embed=8, item_embed=8,
+                       hidden_layers=(16, 8), mf_embed=8)
+        return Estimator(model=ncf.build_model(),
+                         loss_fn=objectives.get(
+                             "sparse_categorical_crossentropy"),
+                         optimizer=optimizers.SGD(0.1), seed=7)
+
+    # (a) continual: one trainer follows the stream journal
+    root = tempfile.mkdtemp(prefix="zoo_ratio_online_")
+    q = make_queue(f"dir://{root}/clicks")
+    q.enqueue_many([(f"c{i}", {"x": [int(uid[i]), int(iid[i])],
+                               "y": int(lab[i]), "ts": 0.0})
+                    for i in range(window_records * windows)])
+    fs = FeatureSet.from_queue(q, os.path.join(root, "journal"),
+                               epoch_records=window_records,
+                               watermark_s=0.0)
+    est = make_est()
+    est.train_online(fs, batch_size=batch,
+                     max_steps=window_records // batch)  # warm: compile
+    t0 = time.perf_counter()
+    for w in range(2, windows + 1):
+        est.train_online(fs, batch_size=batch,
+                         max_steps=w * (window_records // batch))
+    online_s = time.perf_counter() - t0
+    fs.close()
+
+    # (b) full retrain: fresh model over ALL clicks so far, per window
+    x_all = np.stack([uid, iid], 1).astype(np.float32)
+    make_est().train(FeatureSet.from_ndarrays(
+        x_all[:window_records], lab[:window_records], shuffle=False),
+        batch_size=batch, epochs=1)  # warm: compile
+    t0 = time.perf_counter()
+    for w in range(2, windows + 1):
+        n = window_records * w
+        make_est().train(FeatureSet.from_ndarrays(
+            x_all[:n], lab[:n], shuffle=False),
+            batch_size=batch, epochs=1)
+    retrain_s = time.perf_counter() - t0
+
+    return {"online_continual_s": round(online_s, 4),
+            "full_retrain_s": round(retrain_s, 4),
+            "windows": windows, "window_records": window_records,
+            "online_vs_retrain_ratio":
+                round(retrain_s / max(online_s, 1e-9), 2)}
+
+
 _RATIO_IMPLS = {
     "transfer": _ratio_transfer,
     "transform": _ratio_transform,
@@ -3208,6 +3376,7 @@ _RATIO_IMPLS = {
     "generate": _ratio_generate,
     "etl": _ratio_etl,
     "fleet": _ratio_fleet,
+    "online": _ratio_online,
 }
 
 #: every workload → (proxy impl, the detail key that becomes the record's
@@ -3230,6 +3399,7 @@ _RATIO_PLAN = {
     "recovery": ("recovery", "restore_vs_step_ratio"),
     "generate": ("generate", "batched_vs_serial_tokens_ratio"),
     "etl_to_train": ("etl", "zero_copy_vs_gather_ratio"),
+    "online_learning": ("online", "online_vs_retrain_ratio"),
 }
 
 #: impl results shared across the workloads that proxy to the same impl

@@ -1,0 +1,110 @@
+"""Online learning loop (docs/online.md): click feedback streams into a
+`FeatureSet.from_queue`, a sharded NCF retrains on it continually with
+`train_online`, and each snapshot is promoted onto a serving fleet —
+canary first, verified live via `model_version`, rolled back on failure
+— while the fleet keeps answering recommendation requests.
+"""
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+
+def simulated_clicks(users, items, n, seed=0):
+    """Click records as queue payloads: features, label, event time."""
+    rs = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        u = int(rs.integers(1, users + 1))
+        v = int(rs.integers(1, items + 1))
+        # planted structure: users click items whose id shares parity
+        out.append((f"click-{i}", {"x": [u, v], "y": int(u % 2 == v % 2),
+                                   "ts": 0.0}))
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny CI config")
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="train→export→promote rounds")
+    args = ap.parse_args()
+
+    import jax
+    from jax.sharding import Mesh
+
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.estimator import Estimator
+    from analytics_zoo_tpu.feature import FeatureSet
+    from analytics_zoo_tpu.keras import objectives
+    from analytics_zoo_tpu.keras.optimizers import SGD
+    from analytics_zoo_tpu.models import NeuralCF
+    from analytics_zoo_tpu.online import Promoter, export_servable
+    from analytics_zoo_tpu.serving import ClusterServing, ServingConfig
+    from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+    from analytics_zoo_tpu.serving.queues import make_queue
+
+    init_tpu_context()
+    root = tempfile.mkdtemp(prefix="zoo_online_example_")
+    users, items = (40, 36) if args.smoke else (6040, 3706)
+    steps_per_round = 4 if args.smoke else 200
+    batch = 16 if args.smoke else 512
+    epoch_records = 4 * batch
+
+    # 1. the click stream: producers enqueue_many; the ingest thread
+    # journals past the watermark under backpressure
+    clicks = make_queue(f"dir://{root}/clicks")
+    clicks.enqueue_many(simulated_clicks(
+        users, items, epoch_records * (args.rounds + 1)))
+    fs = FeatureSet.from_queue(clicks, os.path.join(root, "journal"),
+                               epoch_records=epoch_records, watermark_s=0.0)
+
+    # 2. continual trainer: sharded embeddings, row-subset updates
+    ndev = len(jax.devices())
+    mesh = Mesh(np.asarray(jax.devices()[:min(4, ndev)]), ("data",))
+    ncf = NeuralCF(users, items, 2, user_embed=8, item_embed=8,
+                   hidden_layers=(16, 8), mf_embed=8, shard_embeddings=True)
+    est = Estimator(model=ncf.build_model(),
+                    loss_fn=objectives.get("sparse_categorical_crossentropy"),
+                    optimizer=SGD(0.1), mesh=mesh, seed=7)
+    est.set_checkpoint(os.path.join(root, "ckpt"))
+
+    # 3. a two-instance serving fleet born on the v0 export
+    est.train_online(fs, batch_size=batch, max_steps=1)
+    v0 = export_servable(ncf, est, f"{root}/exports/v0")
+    servers = {}
+    for name in ("canary", "replica"):
+        cfg = ServingConfig(data_src=f"dir://{root}/srv-{name}",
+                            model_path=v0, model_type="zoo",
+                            image_shape=(2,), batch_size=4, batch_wait_ms=5)
+        servers[name] = ClusterServing(cfg)
+    prom = Promoter(servers, canary="canary")
+    inq = InputQueue(f"dir://{root}/srv-canary")
+    outq = OutputQueue(f"dir://{root}/srv-canary")
+
+    # 4. the loop: train on the stream, serve it, promote each snapshot
+    for r in range(1, args.rounds + 1):
+        est.train_online(fs, batch_size=batch,
+                         max_steps=est.global_step + steps_per_round,
+                         snapshot_interval_s=30.0)
+        inq.enqueue_tensor(f"round-{r}",
+                           np.array([1.0 + r, 2.0], np.float32))
+        while servers["canary"].serve_once():
+            pass
+        print(f"round {r}: step={est.global_step} "
+              f"served={outq.query(f'round-{r}', timeout_s=30)}")
+        version = prom.promote(
+            export_servable(ncf, est, f"{root}/exports/v{r}"))
+        live = {n: s.health_snapshot()["model_version"]
+                for n, s in servers.items()}
+        print(f"round {r}: promoted {version}, fleet live on {live}")
+        assert set(live.values()) == {version}
+
+    fs.close()
+    print(f"done: {args.rounds} promotions, final step {est.global_step}, "
+          f"clicks left in queue: {clicks.pending_count()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,32 @@ class TestQueues:
         uris = [u for u, _ in q.claim_batch(10)]
         assert uris == ["u6", "u7", "u8", "u9"]
 
+    def test_enqueue_many_parity_with_singles(self, tmp_path):
+        """A batch enqueue must be observationally identical to the same
+        records enqueued one by one: same claim order, same payloads."""
+        from analytics_zoo_tpu.serving import FileQueue
+        recs = [(f"u{i}", {"tensor": [i, i + 1]}) for i in range(6)]
+        single = FileQueue(str(tmp_path / "single"))
+        for uri, payload in recs:
+            single.enqueue(uri, payload)
+        batched = FileQueue(str(tmp_path / "batched"))
+        batched.enqueue_many(recs[:4])   # one rename publishes all four
+        batched.enqueue_many(recs[4:])
+        assert batched.claim_batch(10) == single.claim_batch(10)
+
+    def test_enqueue_many_depth_and_trim_accounting(self, tmp_path):
+        """pending_count / trim / shed see through batch files: depth is
+        records, not files, and trimming drops oldest records first."""
+        from analytics_zoo_tpu.serving import FileQueue
+        q = FileQueue(str(tmp_path))
+        q.enqueue_many([(f"b{i}", {"tensor": [i]}) for i in range(5)])
+        q.enqueue("tail", {"tensor": [99]})
+        assert q.pending_count() == 6
+        dropped = q.trim(3)
+        assert dropped == 3
+        assert q.pending_count() == 3
+        assert [u for u, _ in q.claim_batch(10)] == ["b3", "b4", "tail"]
+
     def test_make_queue_dispatch(self, tmp_path):
         from analytics_zoo_tpu.serving import FileQueue, make_queue
         assert isinstance(make_queue(f"dir://{tmp_path}"), FileQueue)
@@ -333,11 +359,15 @@ class TestHotReload:
             inq.enqueue_tensor("pre", np.full(4, 1.0))
             pre = outq.query("pre", timeout_s=20.0)
             assert pre["value"] == [pytest.approx(4.0)]  # sum model
+            assert serving.model_version == "inline-0"  # stamped at load
             serving.reload_model(model=_mean_model())
             inq.enqueue_tensor("post", np.full(4, 1.0))
             post = outq.query("post", timeout_s=20.0)
             assert post["value"] == [pytest.approx(1.0)]  # mean model
             assert serving.counters["reloads"] == 1
+            # version advanced with the swap and health reports it
+            assert serving.model_version == "inline-1"
+            assert serving.health_snapshot()["model_version"] == "inline-1"
             serving.check_health()
         finally:
             serving.stop()
@@ -360,6 +390,9 @@ class TestHotReload:
             serving.reload_model(model=bad)
         assert serving.model is old
         assert serving.counters["reload_failures"] == 1
+        # a failed reload must NOT advance the advertised version
+        assert serving.model_version == "inline-0"
+        assert serving.health_snapshot()["model_version"] == "inline-0"
         # ...and the old model still answers traffic
         InputQueue(src).enqueue_tensor("r0", np.full(4, 1.0))
         serving.serve_once()
@@ -408,9 +441,11 @@ class TestDeepHealth:
         assert snap["latency_ms"]["p50"] <= snap["latency_ms"]["p99"]
         assert snap["counters"]["shed"] == 0
         assert snap["counters"]["expired"] == 0
+        assert snap["model_version"] == "inline-0"
         # the same snapshot streams to the health file on the serve path
         on_disk = json.loads(health.read_text())
         assert on_disk["records_served"] >= 2
+        assert on_disk["model_version"] == "inline-0"
         serving.stop()
         assert json.loads(health.read_text())["state"] == "stopped"
 
