@@ -309,3 +309,10 @@ _global_config.register("online.rollout_verify_timeout_s", 5.0,
                         "instance's health_snapshot for the new "
                         "model_version before declaring the rollout "
                         "failed and rolling back.")
+_global_config.register("kernels.fused_embedding", True,
+                        "Route embedding lookups through the fused "
+                        "gather/pool/scatter kernels in "
+                        "ops/embedding_kernels.py (pallas on TPU, "
+                        "bit-identical lax elsewhere). Off = the "
+                        "historical unfused layer ops, kept as the "
+                        "bit-parity reference.")

@@ -30,7 +30,8 @@ class Embedding(Layer):
                  input_length: Optional[int] = None,
                  weights: Optional[np.ndarray] = None, trainable: bool = True,
                  name: Optional[str] = None,
-                 shard: Union[bool, str, None] = None, cold_rows: int = 0):
+                 shard: Union[bool, str, None] = None, cold_rows: int = 0,
+                 fused: Optional[bool] = None):
         super().__init__(name)
         self.input_dim = input_dim
         self.output_dim = output_dim
@@ -38,6 +39,10 @@ class Embedding(Layer):
         self.input_length = input_length
         self.weights = weights
         self.trainable = trainable
+        #: per-layer override of the ``kernels.fused_embedding`` knob:
+        #: None follows the config, False pins this layer to the unfused
+        #: bit-parity reference path, True forces the fused kernels on.
+        self.fused = fused
         #: False/None = replicated table (historical layout); True = shard
         #: the vocab axis over the default embedding mesh axis; a string
         #: names the mesh axis explicitly.
@@ -55,6 +60,17 @@ class Embedding(Layer):
     def hot_dim(self) -> int:
         """Rows resident on device (input_dim minus the cold tail)."""
         return self.input_dim - self.cold_rows
+
+    def _fused_kernels(self):
+        """Fused-kernel module for this layer's lookups (or None for the
+        unfused reference ops): the per-layer ``fused`` override wins,
+        else the global ``kernels.fused_embedding`` knob decides."""
+        if self.fused is False:
+            return None
+        ek = _embed.fused_kernels()
+        if ek is None and self.fused:
+            from ...ops import embedding_kernels as ek  # forced on
+        return ek
 
     def _make_spec(self):
         if not self.shard:
@@ -109,7 +125,10 @@ class Embedding(Layer):
         exchange blob stashed for the estimator's sparse update."""
         idx = _embed.validate_ids(idx, self.input_dim)
         spec, tier = self._shard_spec, self._cold_tier
+        ek = self._fused_kernels()
         if spec is None and tier is None:
+            if ek is not None:
+                return ek.gather_rows_clip(table, idx), state
             return jnp.take(table, idx, axis=0), state
         flat = idx.reshape(-1)
         is_cold = (flat >= self.hot_dim) if tier is not None else None
@@ -123,7 +142,8 @@ class Embedding(Layer):
         else:
             safe = flat if is_cold is None \
                 else jnp.minimum(flat, self.hot_dim - 1)
-            out_flat = jnp.take(table, safe, axis=0)
+            out_flat = ek.gather_rows_clip(table, safe) if ek is not None \
+                else jnp.take(table, safe, axis=0)
         if is_cold is not None:
             rel = jnp.where(is_cold, flat - self.hot_dim, -1)
             cold = _embed.cold_lookup(tier, rel, table[0, 0])
@@ -224,9 +244,11 @@ class SparseEmbedding(Embedding):
     def __init__(self, input_dim: int, output_dim: int, combiner: str = "sum",
                  init="uniform", weights=None, trainable: bool = True,
                  name: Optional[str] = None,
-                 shard: Union[bool, str, None] = None):
+                 shard: Union[bool, str, None] = None,
+                 fused: Optional[bool] = None):
         super().__init__(input_dim, output_dim, init=init, weights=weights,
-                         trainable=trainable, name=name, shard=shard)
+                         trainable=trainable, name=name, shard=shard,
+                         fused=fused)
         if combiner not in ("sum", "mean", "sqrtn", None):
             raise ValueError(f"unknown combiner {combiner}")
         self.combiner = combiner
@@ -249,6 +271,11 @@ class SparseEmbedding(Embedding):
             new_state[_embed.ROWS_PREFIX + "embeddings"] = rows
             emb = emb_flat.reshape(idx.shape + (self.output_dim,)) * valid
         else:
+            ek = self._fused_kernels()
+            if ek is not None:
+                # fused gather + mask + pool in one pass (pallas on TPU;
+                # the identical op chain off-TPU — bit-parity reference)
+                return ek.gather_pool(table, idx, self.combiner), new_state
             emb = jnp.take(table, jnp.maximum(idx, 0), axis=0) * valid
         if self.combiner is None:
             return emb, new_state

@@ -46,6 +46,24 @@ ENGINE_PY = os.path.join(REPO_ROOT, "analytics_zoo_tpu", "xshard",
 EMBED_BODIES = ("_routing", "_lookup_body", "_lookup_bwd_body",
                 "_update_body")
 
+EMBED_KERNELS_PY = os.path.join(REPO_ROOT, "analytics_zoo_tpu", "ops",
+                                "embedding_kernels.py")
+
+#: fused embedding kernels (ops/embedding_kernels.py). The KERNEL_BODIES
+#: are the per-row hot cores — the pallas kernel bodies and the fused
+#: lookup/pool/backward primitives the engine and layers trace per step:
+#: loop-free outright (fori_loop is a traced call, not a Python loop), no
+#: one_hot densification, no host syncs. The WRAPPERS (multi-table
+#: dispatch, table quantization) may loop over the static table count but
+#: still must not sync or densify.
+EMBED_KERNEL_BODIES = ("gather_rows", "gather_rows_clip", "segment_grads",
+                       "scatter_rows", "gather_pool", "gather_pool_int8",
+                       "_gather_pool_ref", "_gather_kernel",
+                       "_gather_int8_kernel", "_gather_pool_kernel",
+                       "_scatter_add_kernel")
+EMBED_KERNEL_WRAPPERS = ("multi_table_lookup", "quantize_table",
+                         "fused_enabled")
+
 SLOT_OPS = ("init_slot_cache", "slot_join", "slot_evict", "slot_insert",
             "slot_attention")
 
@@ -82,6 +100,8 @@ _CHECKS: List[Tuple[str, Optional[str], Sequence[str], Sequence[str],
      "loops"),
     (DEVICE_FEED_PY, None, ("_produce",), (), False, "loops"),
     (EMBEDDING_PY, None, EMBED_BODIES, (), True, "body"),
+    (EMBED_KERNELS_PY, None, EMBED_KERNEL_BODIES, (), True, "body"),
+    (EMBED_KERNELS_PY, None, EMBED_KERNEL_WRAPPERS, (), False, "body"),
     (DECODE_PY, None, SLOT_OPS, (), True, "body"),
     (DECODE_PY, None, PAGED_OPS, (), True, "body"),
     (LM_PY, "TransformerLM",

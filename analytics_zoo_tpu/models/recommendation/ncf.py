@@ -23,7 +23,7 @@ class NeuralCF(Recommender):
                  user_embed: int = 20, item_embed: int = 20,
                  hidden_layers: Sequence[int] = (40, 20, 10),
                  include_mf: bool = True, mf_embed: int = 20,
-                 shard_embeddings=None):
+                 shard_embeddings=None, fused_embeddings=None):
         super().__init__()
         self.user_count = user_count
         self.item_count = item_count
@@ -36,6 +36,10 @@ class NeuralCF(Recommender):
         #: None/False = replicated tables; True/axis-name = vocab-shard all
         #: four tables over the mesh (parallel/embedding.py)
         self.shard_embeddings = shard_embeddings
+        #: per-model override of the ``kernels.fused_embedding`` knob
+        #: (ops/embedding_kernels.py): None follows the config, False pins
+        #: all four tables to the unfused bit-parity reference path.
+        self.fused_embeddings = fused_embeddings
 
     def get_config(self):
         return {
@@ -44,6 +48,7 @@ class NeuralCF(Recommender):
             "item_embed": self.item_embed, "hidden_layers": self.hidden_layers,
             "include_mf": self.include_mf, "mf_embed": self.mf_embed,
             "shard_embeddings": self.shard_embeddings,
+            "fused_embeddings": self.fused_embeddings,
         }
 
     def build_model(self) -> Model:
@@ -52,12 +57,15 @@ class NeuralCF(Recommender):
         item = Lambda(lambda x: x[:, 1:2], name="item_select")(pairs)
 
         shard = self.shard_embeddings
+        fused = self.fused_embeddings
         mlp_user = Flatten(name="mlp_user_flat")(
             Embedding(self.user_count + 1, self.user_embed, init="normal",
-                      name="mlp_user_table", shard=shard)(user))
+                      name="mlp_user_table", shard=shard,
+                      fused=fused)(user))
         mlp_item = Flatten(name="mlp_item_flat")(
             Embedding(self.item_count + 1, self.item_embed, init="normal",
-                      name="mlp_item_table", shard=shard)(item))
+                      name="mlp_item_table", shard=shard,
+                      fused=fused)(item))
         h = merge([mlp_user, mlp_item], mode="concat")
         for i, units in enumerate(self.hidden_layers):
             h = Dense(units, activation="relu", name=f"mlp_dense_{i}")(h)
@@ -67,10 +75,12 @@ class NeuralCF(Recommender):
                 raise ValueError("mf_embed must be positive when include_mf")
             mf_user = Flatten(name="mf_user_flat")(
                 Embedding(self.user_count + 1, self.mf_embed, init="normal",
-                          name="mf_user_table", shard=shard)(user))
+                          name="mf_user_table", shard=shard,
+                          fused=fused)(user))
             mf_item = Flatten(name="mf_item_flat")(
                 Embedding(self.item_count + 1, self.mf_embed, init="normal",
-                          name="mf_item_table", shard=shard)(item))
+                          name="mf_item_table", shard=shard,
+                          fused=fused)(item))
             gmf = merge([mf_user, mf_item], mode="mul")
             h = merge([h, gmf], mode="concat")
         out = Dense(self.num_classes, activation="softmax", name="prediction")(h)

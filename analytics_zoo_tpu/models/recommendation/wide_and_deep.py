@@ -128,11 +128,14 @@ class _WideLinear(Layer):
     device and its gradient stops being a dense-table allreduce."""
 
     def __init__(self, total_dim: int, num_classes: int, name=None,
-                 shard=None):
+                 shard=None, fused=None):
         super().__init__(name)
         self.total_dim = total_dim
         self.num_classes = num_classes
         self.shard = shard
+        #: per-layer override of ``kernels.fused_embedding`` (None follows
+        #: the config; False pins the unfused take+sum reference path)
+        self.fused = fused
         self._shard_spec = None
 
     def _make_spec(self):
@@ -171,6 +174,13 @@ class _WideLinear(Layer):
             new_state = dict(state)
             new_state[_embed.ROWS_PREFIX + "table"] = blob
             return out, new_state
+        ek = None if self.fused is False else _embed.fused_kernels()
+        if ek is not None:
+            # fused gather+sum over the pre-validated bucket ids (pallas
+            # on TPU; the identical take+sum chain elsewhere)
+            out = ek.gather_pool(params["table"], idx, "sum",
+                                 mask_negative=False) + params["bias"]
+            return out, state
         out = jnp.take(params["table"], idx, axis=0).sum(1) + params["bias"]
         return out, state
 
@@ -203,7 +213,8 @@ class WideAndDeep(Recommender):
     def __init__(self, model_type: str = "wide_n_deep", num_classes: int = 2,
                  column_info: Optional[ColumnFeatureInfo] = None,
                  hidden_layers: Sequence[int] = (40, 20, 10),
-                 shard_embeddings=None, **column_kwargs):
+                 shard_embeddings=None, fused_embeddings=None,
+                 **column_kwargs):
         super().__init__()
         if model_type not in ("wide", "deep", "wide_n_deep"):
             raise ValueError(f"unknown model_type {model_type}")
@@ -218,6 +229,10 @@ class WideAndDeep(Recommender):
         #: None/False = replicated tables; True/axis-name = vocab-shard the
         #: wide table and per-column embed tables (parallel/embedding.py)
         self.shard_embeddings = shard_embeddings
+        #: per-model override of the ``kernels.fused_embedding`` knob
+        #: (ops/embedding_kernels.py): None follows the config, False pins
+        #: the wide table and embed columns to the unfused reference path.
+        self.fused_embeddings = fused_embeddings
 
     def get_config(self) -> Dict[str, Any]:
         ci = self.column_info
@@ -225,6 +240,7 @@ class WideAndDeep(Recommender):
             "model_type": self.model_type, "num_classes": self.num_classes,
             "hidden_layers": self.hidden_layers,
             "shard_embeddings": self.shard_embeddings,
+            "fused_embeddings": self.fused_embeddings,
             "column_info": {
                 "wide_base_cols": list(ci.wide_base_cols),
                 "wide_base_dims": list(ci.wide_base_dims),
@@ -252,7 +268,8 @@ class WideAndDeep(Recommender):
         if ci.wide_cols:
             wide_out = _WideLinear(sum(ci.wide_dims), self.num_classes,
                                    name="wide_linear",
-                                   shard=self.shard_embeddings)(in_wide)
+                                   shard=self.shard_embeddings,
+                                   fused=self.fused_embeddings)(in_wide)
 
         deep_out = None
         deep_parts = []
@@ -263,7 +280,8 @@ class WideAndDeep(Recommender):
                 ci.embed_cols, ci.embed_in_dims, ci.embed_out_dims)):
             col = Lambda(lambda x, i=i: x[:, i:i + 1], name=f"embed_col_{i}")(in_emb)
             e = Embedding(din, dout, init="normal", name=f"embed_table_{c}",
-                          shard=self.shard_embeddings)(col)
+                          shard=self.shard_embeddings,
+                          fused=self.fused_embeddings)(col)
             deep_parts.append(Flatten(name=f"embed_flat_{c}")(e))
         if ci.continuous_cols:
             deep_parts.append(in_cont)

@@ -18,3 +18,15 @@ from .decode import (  # noqa: F401
     init_kv_cache,
     sample_generate,
 )
+from .embedding_kernels import (  # noqa: F401
+    fused_enabled,
+    gather_pool,
+    gather_pool_int8,
+    gather_rows,
+    gather_rows_clip,
+    int8_error_bound,
+    multi_table_lookup,
+    quantize_table,
+    scatter_rows,
+    segment_grads,
+)

@@ -255,6 +255,19 @@ def validate_ids(idx, vocab: int, allow_negative: bool = False):
 # per-shard bodies (module-level: policed by scripts/check_hot_path_syncs.py
 # — no densified one-hot, no per-row Python loops, no host syncs)
 
+def fused_kernels():
+    """Trace-time resolution of the fused local-compute kernels
+    (``ops/embedding_kernels.py``). Returns the module when the
+    ``kernels.fused_embedding`` knob is on, else None — callers then trace
+    the inline lax ops below, the bit-parity reference. The fused CPU path
+    traces the SAME ops in the same order, so toggling the knob off-TPU is
+    a jaxpr no-op (tests/test_fused_embedding.py pins this bitwise)."""
+    if not global_config().get("kernels.fused_embedding"):
+        return None
+    from ..ops import embedding_kernels as _ek
+    return _ek
+
+
 def _routing(spec, ids):
     """Shared dedup-unique routing: sorted uniques, owning shard, and the
     (destination, slot) address of each unique in the request matrix."""
@@ -280,7 +293,14 @@ def _lookup_body(spec, tshard, ids):
     req = req.at[d, slot].set(local_row)
     recv = lax.all_to_all(req, spec.axis, split_axis=0, concat_axis=0,
                           tiled=True)
-    rows = jnp.take(tshard, recv.ravel(), axis=0, mode="fill", fill_value=0)
+    ek = fused_kernels()
+    if ek is not None:
+        # fused local gather (pallas row-DMA kernel on TPU; identical
+        # fill-mode take elsewhere)
+        rows = ek.gather_rows(tshard, recv.ravel())
+    else:
+        rows = jnp.take(tshard, recv.ravel(), axis=0, mode="fill",
+                        fill_value=0)
     back = lax.all_to_all(rows.reshape(spec.shards, n, spec.dim), spec.axis,
                           split_axis=0, concat_axis=0, tiled=True)
     out = jnp.take(back[d, slot], inv, axis=0)
@@ -293,10 +313,21 @@ def _lookup_bwd_body(spec, g, ids, recv):
     touched rows of the local shard (SENTINEL rows drop)."""
     n = ids.shape[0]
     _u, inv, d, _local_row, slot = _routing(spec, ids)
-    g_u = jax.ops.segment_sum(g, inv, num_segments=n)
-    g_req = jnp.zeros((spec.shards, n, spec.dim), g.dtype).at[d, slot].set(g_u)
+    ek = fused_kernels()
+    if ek is not None:
+        # fused segment-sum straight into the request-shaped buffer, and
+        # (post-exchange) a fused scatter-add into the row-subset
+        # cotangent — [rows_per_shard, dim], never a dense [vocab, dim]
+        g_req = ek.segment_grads(g, inv, d, slot, spec.shards)
+    else:
+        g_u = jax.ops.segment_sum(g, inv, num_segments=n)
+        g_req = jnp.zeros((spec.shards, n, spec.dim),
+                          g.dtype).at[d, slot].set(g_u)
     g_recv = lax.all_to_all(g_req, spec.axis, split_axis=0, concat_axis=0,
                             tiled=True)
+    if ek is not None:
+        return ek.scatter_rows(g_recv.reshape(spec.shards * n, spec.dim),
+                               recv.ravel(), spec.rows_per_shard)
     ct = jnp.zeros((spec.rows_per_shard, spec.dim), g.dtype)
     ct = ct.at[recv.ravel()].add(g_recv.reshape(spec.shards * n, spec.dim),
                                  mode="drop")

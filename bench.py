@@ -112,6 +112,22 @@ def _roofline_fields(flops, bytes_per_step, elapsed, steps):
     return out
 
 
+def _roofline_utilization(mfu, roofline):
+    """Headline utilization for gather-dominated steps: embedding gathers
+    do almost no FLOPs, so MFU reads ~0 even when the step sits at the
+    HBM roofline — the honest single number is max(mfu,
+    hbm_roofline_fraction), the same max() the live profiler's
+    ``roofline_utilization_ratio`` gauge publishes. ``roofline_bound``
+    names which bound won so the number can't be misread as MFU."""
+    frac = roofline.get("hbm_roofline_fraction")
+    cands = [(v, s) for v, s in ((mfu, "mfu"), (frac, "hbm"))
+             if isinstance(v, (int, float))]
+    if not cands:
+        return {}
+    v, bound = max(cands)
+    return {"roofline_utilization": v, "roofline_bound": bound}
+
+
 def _run_steps_differenced(est, bx, by, steps, flops_override=None):
     """Differenced device timing with ONE compiled executable.
 
@@ -176,6 +192,57 @@ def _run_steps_differenced(est, bx, by, steps, flops_override=None):
             return t2 - t1, flops, bytes_per_step
     raise RuntimeError(
         f"differenced timing collapsed (t1={t1:.4f} t2={t2:.4f})")
+
+
+def _embedding_fused_ab(make_est, bx, by, steps, parity_steps=3):
+    """Fused-vs-unfused embedding kernel A/B: time the same workload with
+    ``kernels.fused_embedding`` on and off (same differenced N-step scan
+    as the headline number), and train ``parity_steps`` real steps each
+    way. The params must come out bit-identical — the bench refuses to
+    publish a speedup whose numerics changed (same contract as the flash
+    numerics gate). Off-TPU both settings trace the identical jaxpr, so
+    the ratio there reads ~1.0 by construction; on the TPU it is the
+    pallas-fusion win."""
+    import jax
+    from analytics_zoo_tpu.common.config import global_config
+
+    cfg = global_config()
+    had_override = "kernels.fused_embedding" in cfg._overrides
+    saved = cfg.get("kernels.fused_embedding")
+    times, params = {}, {}
+    try:
+        for mode, enabled in (("fused", True), ("unfused", False)):
+            cfg.set("kernels.fused_embedding", enabled)
+            est = make_est()
+            t, _f, _b = _run_steps_differenced(est, bx, by, steps)
+            times[mode] = t
+            step_fn = est._build_train_step()
+            p, o, m = est.params, est.opt_state, est.model_state
+            rng = jax.random.PRNGKey(0)
+            for _ in range(parity_steps):
+                p, o, m, _loss = step_fn(p, o, m, rng, bx, by)
+            params[mode] = jax.device_get(p)
+    finally:
+        if had_override:
+            cfg.set("kernels.fused_embedding", saved)
+        else:
+            cfg.unset("kernels.fused_embedding")
+    flat_f, tree_f = jax.tree_util.tree_flatten(params["fused"])
+    flat_u, tree_u = jax.tree_util.tree_flatten(params["unfused"])
+    if tree_f != tree_u or any(
+            not np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(flat_f, flat_u)):
+        raise RuntimeError(
+            "embedding fused A/B parity FAILED: trained params diverge "
+            "between kernels.fused_embedding on/off — refusing to publish "
+            "embedding_fused_speedup")
+    return {"embedding_fused_speedup":
+                round(times["unfused"] / max(times["fused"], 1e-9), 3),
+            "embedding_fused_step_ms":
+                round(times["fused"] / steps * 1e3, 3),
+            "embedding_unfused_step_ms":
+                round(times["unfused"] / steps * 1e3, 3),
+            "embedding_fused_parity_ok": True}
 
 
 def _fed_rate(est, train_set, batch_size: int, iters: int = 24,
@@ -538,26 +605,35 @@ def bench_ncf(batch_size: int = 32768, steps: int = 50, warmup: int = 5):
     x = np.stack([rs.randint(1, users + 1, batch_size),
                   rs.randint(1, items + 1, batch_size)], 1).astype(np.float32)
     y = rs.randint(0, 2, batch_size).astype(np.float32)
-    ncf = NeuralCF(users, items, 2, user_embed=64, item_embed=64,
-                   hidden_layers=[128, 64, 32], mf_embed=32)
-    est = Estimator(model=ncf._ensure_built(),
-                    loss_fn=objectives.get("sparse_categorical_crossentropy"),
-                    optimizer=optimizers.Adam(1e-3))
+    def make_est():
+        ncf = NeuralCF(users, items, 2, user_embed=64, item_embed=64,
+                       hidden_layers=[128, 64, 32], mf_embed=32)
+        return Estimator(
+            model=ncf._ensure_built(),
+            loss_fn=objectives.get("sparse_categorical_crossentropy"),
+            optimizer=optimizers.Adam(1e-3))
+
+    est = make_est()
     bx, by = shard_batch(est.mesh, (x, y))
     del warmup
     elapsed, flops, bytes_step = _run_steps_differenced(est, bx, by, steps)
+    ab = _embedding_fused_ab(make_est, bx, by, steps)
     rate = round(batch_size * steps / elapsed, 1)
+    mfu = _mfu(flops, steps, elapsed)
+    roofline = _roofline_fields(flops, bytes_step, elapsed, steps)
     return _BenchResult(
         metric="ncf_train_samples_per_sec",
         value=rate,
         unit="samples/s",
-        mfu=_mfu(flops, steps, elapsed),
+        mfu=mfu,
         detail={"fixed_device_batch": True, "model": "NeuralCF ml-1m (embed 64, mlp 128-64-32, mf 32)",
                 "batch_size": batch_size,
                 "device_samples_per_sec": rate,
                 "loop": "differenced: chained double-dispatch of one "
                         "compiled N-step scan",
-                **_roofline_fields(flops, bytes_step, elapsed, steps),
+                **roofline,
+                **_roofline_utilization(mfu, roofline),
+                **ab,
                 "flops_per_step": flops})
 
 
@@ -593,15 +669,20 @@ def bench_widedeep(batch_size: int = 8192, steps: int = 30, warmup: int = 5):
                     for d in ci.embed_in_dims], 1)
     cont = rs.rand(batch_size, 2).astype(np.float32)
     y = rs.randint(0, 2, batch_size).astype(np.float32)
-    est = Estimator(model=wnd._ensure_built(),
-                    loss_fn=objectives.get("sparse_categorical_crossentropy"),
-                    optimizer=optimizers.Adam(1e-3))
+    def make_est():
+        return Estimator(
+            model=wnd._ensure_built(),
+            loss_fn=objectives.get("sparse_categorical_crossentropy"),
+            optimizer=optimizers.Adam(1e-3))
+
+    est = make_est()
     batch = shard_batch(est.mesh, ([wide.astype(np.int32),
                                     ind.astype(np.int32),
                                     emb.astype(np.int32), cont], y))
     bx, by = batch
     del warmup
     elapsed, flops, bytes_step = _run_steps_differenced(est, bx, by, steps)
+    ab = _embedding_fused_ab(make_est, bx, by, steps)
     # Criteo-scale host feature prep: 1M rows through the hashed-cross path
     # (vectorized unique-gather crc32, models/recommendation/wide_and_deep.py)
     import pandas as pd
@@ -617,16 +698,20 @@ def bench_widedeep(batch_size: int = 8192, steps: int = 30, warmup: int = 5):
     cross_columns(prep_df, ["c1", "c2"], 100000)
     prep_rows_per_sec = round(n_prep / (time.perf_counter() - t0), 1)
     rate = round(batch_size * steps / elapsed, 1)
+    mfu = _mfu(flops, steps, elapsed)
+    roofline = _roofline_fields(flops, bytes_step, elapsed, steps)
     return _BenchResult(
         metric="widedeep_train_samples_per_sec",
         value=rate,
         unit="samples/s",
-        mfu=_mfu(flops, steps, elapsed),
+        mfu=mfu,
         detail={"fixed_device_batch": True, "batch_size": batch_size, "wide_dim": sum(ci.wide_dims),
                 "device_samples_per_sec": rate,
                 "loop": "differenced: chained double-dispatch of one "
                         "compiled N-step scan",
-                **_roofline_fields(flops, bytes_step, elapsed, steps),
+                **roofline,
+                **_roofline_utilization(mfu, roofline),
+                **ab,
                 "roofline_note": "logical-bytes fraction understates the "
                                  "physical roofline: the census MLP's "
                                  "40/20/10-wide activations pad to 128 "
@@ -760,11 +845,13 @@ def bench_widedeep_sharded(batch_size: int = 8192, steps: int = 20,
 
     exch = embed_engine.exchange_cost_bytes(spec, batch_size) \
         if spec is not None else {}
+    mfu = _mfu(flops, steps, elapsed)
+    roofline = _roofline_fields(flops, bytes_step, elapsed, steps)
     return _BenchResult(
         metric="widedeep_sharded_train_samples_per_sec",
         value=rate,
         unit="samples/s",
-        mfu=_mfu(flops, steps, elapsed),
+        mfu=mfu,
         detail={"fixed_device_batch": True, "batch_size": batch_size,
                 "wide_dim": total_dim, "shards": shards,
                 "device_samples_per_sec": rate,
@@ -781,7 +868,8 @@ def bench_widedeep_sharded(batch_size: int = 8192, steps: int = 20,
                 "loop": "differenced: chained double-dispatch of one "
                         "compiled N-step scan",
                 **{k: round(v / 1e6, 3) for k, v in exch.items()},
-                **_roofline_fields(flops, bytes_step, elapsed, steps),
+                **roofline,
+                **_roofline_utilization(mfu, roofline),
                 "roofline_note": "gather/exchange-bound: judge this "
                                  "workload by hbm_roofline_fraction (and "
                                  "profile.roofline_utilization_ratio in "
@@ -2970,6 +3058,79 @@ def _ratio_embed():
     return out
 
 
+def _ratio_embed_fused():
+    """The fused multi-table embedding lookup (ops/embedding_kernels.py,
+    ``kernels.fused_embedding``) vs the unfused per-table chain, measured
+    on CPU where the win it can show is dispatch amortization: K tables
+    of (gather + bag pool) plus the feature concat as K+1 separate jitted
+    dispatches vs ONE jitted ``multi_table_lookup`` call — the shape of
+    an NCF/Wide&Deep embedding tower. On the TPU the same fusion also
+    keeps rows in VMEM through the pool and halves gather bytes in the
+    int8 variant; neither is measurable here, so this probe is the
+    dispatch-side proxy. Both paths are asserted bitwise identical
+    before the ratio is published."""
+    import jax
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.ops import embedding_kernels as ek
+
+    init_tpu_context()
+    rs = np.random.RandomState(0)
+    n_tables, vocab, dim, batch, bag = 24, 1 << 12, 8, 128, 2
+    tables = [jnp.asarray((rs.randn(vocab, dim) * 0.01).astype(np.float32))
+              for _ in range(n_tables)]
+    indices = [jnp.asarray(rs.randint(0, vocab, (batch, bag))
+                           .astype(np.int32)) for _ in range(n_tables)]
+    combiners = ["sum"] * n_tables
+
+    # the unfused reference: one jitted dispatch per table + the concat,
+    # exactly the op chain the pre-fusion layers traced
+    pool_one = jax.jit(partial(ek._gather_pool_ref, combiner="sum",
+                               mask_negative=True))
+    concat = jax.jit(lambda parts: jnp.concatenate(parts, axis=-1))
+
+    def unfused():
+        return concat([pool_one(t, i) for t, i in zip(tables, indices)])
+
+    fused_call = jax.jit(lambda ts, ids: ek.multi_table_lookup(
+        ts, ids, combiners))
+
+    def fused():
+        return fused_call(tables, indices)
+
+    got_u = np.asarray(unfused())
+    got_f = np.asarray(fused())
+    parity_ok = bool(np.array_equal(got_u, got_f))
+    if not parity_ok:
+        raise RuntimeError(
+            "fused multi_table_lookup diverged from the per-table "
+            "reference — refusing to publish embedding_fused_speedup")
+
+    def timed(fn, calls=50, repeats=3):
+        jax.block_until_ready(fn())  # compile warm
+        best = float("inf")
+        for _ in range(repeats):  # min-of-repeats: scheduler-noise proof
+            t0 = time.perf_counter()
+            for _ in range(calls):
+                out = fn()
+            jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - t0) / calls)
+        return best
+
+    unfused_s, fused_s = timed(unfused), timed(fused)
+    return {"tables": n_tables, "vocab": vocab, "dim": dim,
+            "batch": batch, "bag": bag,
+            "unfused_dispatches": n_tables + 1, "fused_dispatches": 1,
+            "unfused_lookup_ms": round(unfused_s * 1e3, 3),
+            "fused_lookup_ms": round(fused_s * 1e3, 3),
+            "embedding_fused_speedup":
+                round(unfused_s / max(fused_s, 1e-9), 2),
+            "parity_ok": parity_ok,
+            "fused_note": ("dispatch-amortization proxy; on TPU the "
+                           "pallas path additionally pools in VMEM and "
+                           "halves gather bytes at int8")}
+
+
 def _ratio_generate():
     """Continuous batching's core bet, isolated at the decode-engine
     level: one fused step over 32 occupied KV slots vs 32 serial
@@ -3373,6 +3534,7 @@ _RATIO_IMPLS = {
     "obs": _ratio_obs,
     "recovery": _ratio_recovery,
     "embed": _ratio_embed,
+    "embed_fused": _ratio_embed_fused,
     "generate": _ratio_generate,
     "etl": _ratio_etl,
     "fleet": _ratio_fleet,
@@ -3386,8 +3548,8 @@ _RATIO_PLAN = {
     "resnet50_int8": ("transfer", "uint8_vs_f32_transfer_ratio"),
     "quantized": ("transfer", "uint8_vs_f32_transfer_ratio"),
     "pipeline": ("transform", "mp_vs_thread_transform_ratio"),
-    "ncf": ("dispatch", "multi_dispatch_speedup"),
-    "widedeep": ("dispatch", "multi_dispatch_speedup"),
+    "ncf": ("embed_fused", "embedding_fused_speedup"),
+    "widedeep": ("embed_fused", "embedding_fused_speedup"),
     "widedeep_sharded": ("embed", "sparse_vs_dense_grad_ratio"),
     "bert": ("dispatch", "multi_dispatch_speedup"),
     "longseq": ("dispatch", "multi_dispatch_speedup"),
@@ -3511,7 +3673,8 @@ def _load_baseline() -> dict:
 _BASELINE_DETAIL_KEYS = {
     "generate": ("tokens_per_sec_c32", "ttft_p99_ms_c32",
                  "tokens_per_s_per_hbm_gb"),
-    "widedeep": ("hbm_roofline_fraction",),
+    "ncf": ("hbm_roofline_fraction", "embedding_fused_speedup"),
+    "widedeep": ("hbm_roofline_fraction", "embedding_fused_speedup"),
     "widedeep_sharded": ("hbm_roofline_fraction",
                          "sharded_vs_dense_samples_ratio"),
     "resnet50": ("hbm_roofline_fraction",),
@@ -3565,6 +3728,8 @@ def _write_baseline(results) -> None:
         if not isinstance(r.get("value"), (int, float)):
             continue
         entry = {"value": r.get("value"), "unit": r.get("unit", "")}
+        if isinstance(r.get("mfu"), (int, float)):
+            entry["mfu"] = r["mfu"]  # the roofline gate compares it
         tracked = {k: (r.get("detail") or {}).get(k)
                    for k in _BASELINE_DETAIL_KEYS.get(n, ())}
         tracked = {k: v for k, v in tracked.items()
@@ -3576,6 +3741,79 @@ def _write_baseline(results) -> None:
     with open(tmp, "w") as f:
         json.dump(doc, f, indent=1)
     os.replace(tmp, path)
+
+
+# -- roofline-regression gate --------------------------------------------------
+# A fast kernel swap can hold samples/s while sliding off the roofline
+# (e.g. doubling HBM traffic, or silently falling back to the unfused
+# path). The gate makes such a slide fail the round loudly: each gated
+# workload's hbm_roofline_fraction and MFU must not drop more than
+# _GATE_TOL relative to the values --write-baseline recorded.
+
+_GATE_WORKLOADS = ("ncf", "widedeep", "widedeep_sharded")
+_GATE_KEYS = ("hbm_roofline_fraction", "mfu")
+_GATE_TOL = float(os.environ.get("BENCH_GATE_TOL", "0.10"))
+
+
+def _gate_check(results, baseline=None, tolerance=None):
+    """Compare the gated workloads' roofline fractions and MFU against
+    BASELINE.json; return human-readable failure strings (empty = pass).
+    Exempt: cpu_ratio / failed records (no roofline to regress),
+    workloads or keys absent from the baseline, and baseline values below
+    1e-3 (a 10% slice of a 0.0001 MFU is measurement noise, not signal —
+    gather-bound steps are judged by hbm_roofline_fraction instead)."""
+    tol = _GATE_TOL if tolerance is None else tolerance
+    doc = baseline if baseline is not None else _load_baseline()
+    base = doc.get("workloads") or {}
+    failures = []
+    for name in _GATE_WORKLOADS:
+        r, b = results.get(name), base.get(name)
+        if not isinstance(r, dict) or not isinstance(b, dict):
+            continue
+        detail = r.get("detail") or {}
+        if detail.get("mode") == "cpu_ratio" or "error" in detail \
+                or str(r.get("metric", "")).endswith(("_failed",
+                                                      "_skipped")):
+            continue
+        bdetail = b.get("detail") or {}
+        for key in _GATE_KEYS:
+            cur = r.get("mfu") if key == "mfu" else detail.get(key)
+            ref = b.get("mfu") if key == "mfu" else bdetail.get(key)
+            if not isinstance(cur, (int, float)) \
+                    or not isinstance(ref, (int, float)) or ref < 1e-3:
+                continue
+            if cur < ref * (1.0 - tol):
+                failures.append(
+                    f"{name}.{key}: {cur:.6g} is more than {tol:.0%} "
+                    f"below baseline {ref:.6g}")
+    return failures
+
+
+def _apply_gate(results, no_gate=False, baseline=None):
+    """Run the gate and stamp the verdict into each gated record — the
+    failure must be explicit in the emitted JSON, not only an exit code
+    the driver may or may not keep. Returns the failure list (empty when
+    passing, or when skipped via --no-gate)."""
+    if no_gate:
+        for name in _GATE_WORKLOADS:
+            r = results.get(name)
+            if isinstance(r, dict):
+                r.setdefault("detail", {})["roofline_gate"] = "skipped"
+        return []
+    failures = _gate_check(results, baseline=baseline)
+    failed = {f.split(".", 1)[0] for f in failures}
+    for name in _GATE_WORKLOADS:
+        r = results.get(name)
+        if not isinstance(r, dict):
+            continue
+        d = r.setdefault("detail", {})
+        if d.get("mode") == "cpu_ratio":
+            continue  # exempt records carry no verdict
+        d["roofline_gate_ok"] = name not in failed
+        mine = [f for f in failures if f.startswith(name + ".")]
+        if mine:
+            d["roofline_gate_failures"] = mine
+    return failures
 
 
 def _validate_record(rec) -> list:
@@ -3603,10 +3841,14 @@ _COMPACT_KEYS = {
     "resnet50_int8": ("bytes_per_step", "hbm_roofline_fraction"),
     "bert": ("fed_samples_per_sec", "numerics_ok"),
     "longseq": ("numerics_ok",),
-    "ncf": ("hbm_roofline_fraction",),
-    "widedeep": ("hbm_roofline_fraction",),
-    "widedeep_sharded": ("hbm_roofline_fraction", "hbm_footprint_ok",
-                         "sharded_vs_dense_samples_ratio"),
+    "ncf": ("hbm_roofline_fraction", "roofline_utilization",
+            "embedding_fused_speedup", "roofline_gate_ok"),
+    "widedeep": ("hbm_roofline_fraction", "roofline_utilization",
+                 "embedding_fused_speedup", "roofline_gate_ok"),
+    "widedeep_sharded": ("hbm_roofline_fraction", "roofline_utilization",
+                         "hbm_footprint_ok",
+                         "sharded_vs_dense_samples_ratio",
+                         "roofline_gate_ok"),
     "eval": ("sync_eval_records_per_sec", "eval_speedup",
              "predict_speedup"),
     "quantized": ("fp32_images_per_sec",),
@@ -3683,10 +3925,10 @@ def _parse_args(argv):
     """Tiny hand parser (argparse would swallow workload names that look
     like flags in driver logs): positional workload (or ``all``), plus
     --one NAME, --budget S, --ratio, --full, --shard i/n, --resume,
-    --write-baseline."""
+    --write-baseline, --no-gate."""
     args = {"which": "all", "one": None, "ratio": False, "full": False,
             "shard": None, "resume": False, "budget": None,
-            "write_baseline": False}
+            "write_baseline": False, "no_gate": False}
     it = iter(argv)
     for a in it:
         if a == "--one":
@@ -3702,6 +3944,8 @@ def _parse_args(argv):
             args["resume"] = True
         elif a == "--write-baseline":
             args["write_baseline"] = True
+        elif a == "--no-gate":
+            args["no_gate"] = True
         elif a == "--shard":
             i, n = next(it).split("/")
             args["shard"] = (int(i), int(n))
@@ -3852,6 +4096,11 @@ def main():
         platform = probed_platform or "cpu"
         if args["write_baseline"]:
             _write_baseline(results)
+        gate_failures = _apply_gate(results, no_gate=args["no_gate"])
+        if gate_failures:
+            _log("roofline regression gate FAILED: "
+                 + "; ".join(gate_failures))
+            _finish(partial=False, code=3)
         _finish(partial=False)
 
     if not isolate:
@@ -3900,6 +4149,10 @@ def main():
         platform, num_devices = ctx.platform, ctx.num_devices
     if args["write_baseline"]:
         _write_baseline(results)
+    gate_failures = _apply_gate(results, no_gate=args["no_gate"])
+    if gate_failures:
+        _log("roofline regression gate FAILED: " + "; ".join(gate_failures))
+        _finish(partial=False, code=3)
     _finish(partial=False)
 
 

@@ -157,12 +157,154 @@ class TestRecordSchema:
             bench._PARTIAL["detail"] = saved[1]
 
 
+def _gate_baseline():
+    return {"workloads": {
+        "ncf": {"value": 9.4e6, "unit": "samples/s", "mfu": 0.0075,
+                "detail": {"hbm_roofline_fraction": 0.5,
+                           "embedding_fused_speedup": 1.3}},
+        "widedeep": {"value": 2.9e6, "unit": "samples/s", "mfu": 0.0001,
+                     "detail": {"hbm_roofline_fraction": 0.4}},
+    }}
+
+
+def _real_record(name, mfu, frac):
+    return bench._BenchResult(
+        metric=f"{name}_train_samples_per_sec", value=1e6,
+        unit="samples/s", mfu=mfu,
+        detail={"hbm_roofline_fraction": frac})
+
+
+class TestRooflineGate:
+    def test_healthy_round_passes(self):
+        results = {"ncf": _real_record("ncf", 0.0074, 0.49),
+                   "widedeep": _real_record("widedeep", 0.0001, 0.41)}
+        assert bench._gate_check(results, _gate_baseline()) == []
+        assert bench._apply_gate(results, baseline=_gate_baseline()) == []
+        assert results["ncf"]["detail"]["roofline_gate_ok"] is True
+
+    def test_synthetic_regression_fails_with_explicit_fields(self):
+        """A regressed round — roofline fraction halves while samples/s
+        holds — must fail the gate AND stamp the failure into the record,
+        not just the exit code."""
+        results = {"ncf": _real_record("ncf", 0.003, 0.2),
+                   "widedeep": _real_record("widedeep", 0.00005, 0.1)}
+        failures = bench._apply_gate(results, baseline=_gate_baseline())
+        kinds = {f.split(":")[0] for f in failures}
+        # widedeep.mfu is exempt: its 0.0001 baseline is below the noise
+        # floor (gather-bound steps are judged by the hbm fraction)
+        assert kinds == {"ncf.hbm_roofline_fraction", "ncf.mfu",
+                         "widedeep.hbm_roofline_fraction"}
+        assert results["ncf"]["detail"]["roofline_gate_ok"] is False
+        assert results["ncf"]["detail"]["roofline_gate_failures"]
+        assert results["widedeep"]["detail"]["roofline_gate_ok"] is False
+
+    def test_tolerance_is_relative(self):
+        results = {"ncf": _real_record("ncf", 0.0075, 0.46)}  # -8% ok
+        assert bench._gate_check(results, _gate_baseline()) == []
+        results = {"ncf": _real_record("ncf", 0.0075, 0.44)}  # -12% not
+        assert len(bench._gate_check(results, _gate_baseline())) == 1
+
+    def test_ratio_failed_and_unbaselined_records_are_exempt(self):
+        ratio = bench._BenchResult(metric="ncf_cpu_ratio", value=2.5,
+                                   unit="ratio", mfu=None,
+                                   detail={"mode": "cpu_ratio"})
+        failed = bench._BenchResult(metric="widedeep_failed", value=None,
+                                    unit="", mfu=None,
+                                    detail={"error": "boom"})
+        fresh = _real_record("widedeep_sharded", 0.001, 0.01)  # no base
+        results = {"ncf": ratio, "widedeep": failed,
+                   "widedeep_sharded": fresh}
+        assert bench._gate_check(results, _gate_baseline()) == []
+        bench._apply_gate(results, baseline=_gate_baseline())
+        assert "roofline_gate_ok" not in ratio["detail"]
+
+    def test_no_gate_skips_and_stamps(self):
+        results = {"ncf": _real_record("ncf", 0.001, 0.01)}  # regressed
+        assert bench._apply_gate(results, no_gate=True,
+                                 baseline=_gate_baseline()) == []
+        assert results["ncf"]["detail"]["roofline_gate"] == "skipped"
+        assert "roofline_gate_ok" not in results["ncf"]["detail"]
+
+    def test_write_baseline_records_mfu_and_fused_speedup(
+            self, tmp_path, monkeypatch):
+        """--write-baseline must persist everything the gate and the
+        fused-A/B diff later compare: mfu at the top level, the roofline
+        fraction and embedding_fused_speedup in the tracked detail."""
+        monkeypatch.setattr(bench, "__file__",
+                            str(tmp_path / "bench.py"))
+        results = {"ncf": bench._BenchResult(
+            metric="ncf_train_samples_per_sec", value=9.4e6,
+            unit="samples/s", mfu=0.0075,
+            detail={"hbm_roofline_fraction": 0.5,
+                    "embedding_fused_speedup": 1.3})}
+        bench._write_baseline(results)
+        doc = __import__("json").loads(
+            (tmp_path / "BASELINE.json").read_text())
+        entry = doc["workloads"]["ncf"]
+        assert entry["mfu"] == 0.0075
+        assert entry["detail"]["hbm_roofline_fraction"] == 0.5
+        assert entry["detail"]["embedding_fused_speedup"] == 1.3
+        # and the round that just wrote it gates green against it
+        assert bench._gate_check(results, doc) == []
+
+    def test_regressed_resumed_round_exits_nonzero(self, tmp_path):
+        """End-to-end: a real bench.py invocation whose (resumed) round
+        regressed vs BASELINE.json must exit nonzero with the gate
+        verdict in the compact line; --no-gate is the escape hatch."""
+        import json as _json
+        import subprocess
+        import sys as _sys
+        baseline = tmp_path / "BASELINE.json"
+        baseline.write_text(_json.dumps(_gate_baseline()))
+        state = {"results": {"ncf": dict(_real_record("ncf", 0.003, 0.2))}}
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   BENCH_BASELINE=str(baseline))
+        saved = None
+        if os.path.exists(bench._STATE_PATH):
+            saved = open(bench._STATE_PATH).read()
+        # the subprocess rewrites the repo's BENCH_DETAIL.json — a tracked
+        # bench artifact — so park the original for the finally block
+        detail_path = os.path.join(os.path.dirname(_BENCH_PATH),
+                                   "BENCH_DETAIL.json")
+        saved_detail = None
+        if os.path.exists(detail_path):
+            saved_detail = open(detail_path).read()
+        try:
+            with open(bench._STATE_PATH, "w") as f:
+                _json.dump(state, f)
+            proc = subprocess.run(
+                [_sys.executable, _BENCH_PATH, "ncf", "--resume"],
+                capture_output=True, text=True, timeout=240, env=env)
+            assert proc.returncode == 3, proc.stdout + proc.stderr
+            final = _json.loads(proc.stdout.strip().splitlines()[-1])
+            row = final["detail"]["workloads"]["ncf"]
+            assert row["roofline_gate_ok"] is False
+
+            with open(bench._STATE_PATH, "w") as f:
+                _json.dump(state, f)
+            proc = subprocess.run(
+                [_sys.executable, _BENCH_PATH, "ncf", "--resume",
+                 "--no-gate"],
+                capture_output=True, text=True, timeout=240, env=env)
+            assert proc.returncode == 0, proc.stdout + proc.stderr
+        finally:
+            if saved is not None:
+                open(bench._STATE_PATH, "w").write(saved)
+            else:
+                bench._clear_state()
+            if saved_detail is not None:
+                open(detail_path, "w").write(saved_detail)
+            elif os.path.exists(detail_path):
+                os.remove(detail_path)
+
+
 class TestArgs:
     def test_defaults(self):
         args = bench._parse_args([])
         assert args["which"] == "all" and args["one"] is None
         assert not args["ratio"] and not args["resume"]
         assert args["shard"] is None and args["budget"] is None
+        assert not args["no_gate"]
 
     def test_flags_and_aliases(self):
         args = bench._parse_args(["--one", "input_pipeline",
@@ -171,9 +313,10 @@ class TestArgs:
         assert args["budget"] == 120.5
         args = bench._parse_args(["--ratio", "--resume", "--full",
                                   "--write-baseline", "--shard", "1/4",
-                                  "eval"])
+                                  "--no-gate", "eval"])
         assert args["ratio"] and args["resume"] and args["full"]
         assert args["write_baseline"]
+        assert args["no_gate"]
         assert args["shard"] == (1, 4)
         assert args["which"] == "eval"
 

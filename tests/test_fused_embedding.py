@@ -1,0 +1,281 @@
+"""The fused embedding kernels (``ops/embedding_kernels.py``) against
+their bit-parity contract (ISSUE 16): off-TPU the fused wrappers must
+trace EXACTLY the unfused reference op chain — same ops, same order, same
+dtypes — so toggling ``kernels.fused_embedding`` is a jaxpr no-op and
+N-step Estimator training lands bit-identical params with the knob on or
+off, sharded and unsharded. The int8 variant must stay inside its
+documented ``int8_error_bound``. The bench-side fused A/B helper must
+publish ``embedding_fused_speedup`` only behind a passing parity fence.
+"""
+import contextlib
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from analytics_zoo_tpu.common.config import global_config
+from analytics_zoo_tpu.estimator import Estimator
+from analytics_zoo_tpu.feature import FeatureSet
+from analytics_zoo_tpu.keras import objectives
+from analytics_zoo_tpu.keras.layers.embedding import (Embedding,
+                                                      SparseEmbedding)
+from analytics_zoo_tpu.keras.optimizers import SGD
+from analytics_zoo_tpu.models.recommendation.ncf import NeuralCF
+from analytics_zoo_tpu.ops import embedding_kernels as ek
+
+KNOB = "kernels.fused_embedding"
+USERS, ITEMS, B = 40, 36, 16
+
+
+@contextlib.contextmanager
+def _knob(value):
+    cfg = global_config()
+    had = KNOB in cfg._overrides
+    saved = cfg.get(KNOB)
+    cfg.set(KNOB, value)
+    try:
+        yield
+    finally:
+        if had:
+            cfg.set(KNOB, saved)
+        else:
+            cfg.unset(KNOB)
+
+
+def _ragged_idx(rs, rows, bag, vocab):
+    """Bag indices with ragged tails: -1 padding of varying lengths,
+    including an all-padding row (the count-clamp edge case)."""
+    idx = rs.randint(0, vocab, (rows, bag)).astype(np.int32)
+    for i in range(rows):
+        idx[i, bag - (i % (bag + 1)):] = -1
+    idx[0, :] = -1
+    return jnp.asarray(idx)
+
+
+def _ref_pool(table, idx, combiner):
+    """The unfused SparseEmbedding op chain, restated independently."""
+    valid = (idx >= 0).astype(table.dtype)[..., None]
+    emb = jnp.take(table, jnp.maximum(idx, 0), axis=0) * valid
+    if combiner is None:
+        return emb
+    total = jnp.sum(emb, axis=-2)
+    if combiner == "sum":
+        return total
+    n = jnp.maximum(jnp.sum(valid, axis=-2), 1.0)
+    if combiner == "mean":
+        return total / n
+    return total / jnp.sqrt(n)  # sqrtn
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("combiner", [None, "sum", "mean", "sqrtn"])
+    def test_gather_pool_forward_bitwise(self, combiner):
+        rs = np.random.RandomState(0)
+        table = jnp.asarray(rs.randn(64, 8).astype(np.float32))
+        idx = _ragged_idx(rs, 10, 5, 64)
+        got = ek.gather_pool(table, idx, combiner)
+        want = _ref_pool(table, idx, combiner)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    @pytest.mark.parametrize("combiner", ["sum", "mean", "sqrtn"])
+    def test_gather_pool_backward_bitwise(self, combiner):
+        rs = np.random.RandomState(1)
+        table = jnp.asarray(rs.randn(32, 4).astype(np.float32))
+        idx = _ragged_idx(rs, 8, 3, 32)
+
+        def loss_fused(t):
+            return jnp.sum(ek.gather_pool(t, idx, combiner) ** 2)
+
+        def loss_ref(t):
+            return jnp.sum(_ref_pool(t, idx, combiner) ** 2)
+
+        gf = jax.grad(loss_fused)(table)
+        gr = jax.grad(loss_ref)(table)
+        np.testing.assert_array_equal(np.asarray(gf), np.asarray(gr))
+
+    def test_multi_table_lookup_matches_per_table_concat(self):
+        rs = np.random.RandomState(2)
+        tables = [jnp.asarray(rs.randn(40, d).astype(np.float32))
+                  for d in (4, 8, 4)]
+        indices = [_ragged_idx(rs, 6, 3, 40) for _ in range(3)]
+        combiners = ["sum", "mean", "sqrtn"]
+        got = ek.multi_table_lookup(tables, indices, combiners)
+        want = jnp.concatenate(
+            [_ref_pool(t, i, c)
+             for t, i, c in zip(tables, indices, combiners)], axis=-1)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_gather_and_scatter_primitives_match_engine_ops(self):
+        rs = np.random.RandomState(3)
+        table = jnp.asarray(rs.randn(16, 8).astype(np.float32))
+        # gather_rows: fill-mode OOB semantics (the _lookup_body contract)
+        flat = jnp.asarray(
+            np.array([0, 5, 15, 16, 255], np.int32))  # 16+ are OOB
+        got = ek.gather_rows(table, flat)
+        want = jnp.take(table, flat, axis=0, mode="fill", fill_value=0)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # scatter_rows: drop-mode OOB semantics (the _lookup_bwd_body ct)
+        g = jnp.asarray(rs.randn(5, 8).astype(np.float32))
+        got = ek.scatter_rows(g, flat, 16)
+        want = jnp.zeros((16, 8), g.dtype).at[flat].add(g, mode="drop")
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # segment_grads: per-shard slot layout of the all-to-all request
+        inv = jnp.asarray(np.array([0, 1, 1, 2, 0], np.int32))
+        d = jnp.asarray(np.array([0, 0, 1, 1, 0], np.int32))
+        slot = jnp.asarray(np.array([0, 1, 2, 0, 3], np.int32))
+        gu = jax.ops.segment_sum(g, inv, num_segments=5)
+        want = jnp.zeros((2, 5, 8), g.dtype).at[d, slot].set(gu)
+        got = ek.segment_grads(g, inv, d, slot, 2)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestInt8Variant:
+    def test_pooled_lookup_stays_inside_documented_bound(self):
+        rs = np.random.RandomState(4)
+        table = jnp.asarray((rs.randn(128, 16) * 0.3).astype(np.float32))
+        bag = 6
+        idx = jnp.asarray(rs.randint(0, 128, (32, bag)).astype(np.int32))
+        qtable, scale, amax = ek.quantize_table(table)
+        got = ek.gather_pool_int8(qtable, scale, idx, "sum")
+        want = ek.gather_pool(table, idx, "sum")
+        err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                    - want.astype(jnp.float32))))
+        bound = float(ek.int8_error_bound(scale, bag_size=bag))
+        assert err <= bound, f"int8 err {err} exceeds bound {bound}"
+        assert qtable.dtype == jnp.int8  # half the gather bytes vs bf16
+
+    def test_delayed_scaling_follows_running_amax(self):
+        from analytics_zoo_tpu.ops.int8_dataflow import (next_amax,
+                                                         scale_of_amax)
+        table = jnp.asarray(np.full((4, 4), 0.5, np.float32))
+        running = jnp.asarray(np.float32(2.0))
+        _q, scale, amax = ek.quantize_table(table, running_amax=running)
+        want_amax = next_amax(running, jnp.float32(0.5))
+        np.testing.assert_allclose(np.asarray(amax), np.asarray(want_amax))
+        np.testing.assert_allclose(np.asarray(scale),
+                                   np.asarray(scale_of_amax(want_amax)))
+
+
+def _mesh4():
+    return Mesh(np.asarray(jax.devices()[:4]), ("data",))
+
+
+def _ncf_fs(n=64):
+    rs = np.random.default_rng(0)
+    x = np.stack([rs.integers(1, USERS + 1, size=(n,)),
+                  rs.integers(1, ITEMS + 1, size=(n,))], 1).astype(np.int32)
+    y = rs.integers(0, 2, size=(n,)).astype(np.int32)
+    return FeatureSet.from_ndarrays(x, y, shuffle=False)
+
+
+def _train_ncf(shard, mesh, epochs=2):
+    model = NeuralCF(USERS, ITEMS, 2, user_embed=8, item_embed=8,
+                     hidden_layers=(16, 8), mf_embed=8,
+                     shard_embeddings=shard).build_model()
+    est = Estimator(model=model,
+                    loss_fn=objectives.get(
+                        "sparse_categorical_crossentropy"),
+                    optimizer=SGD(0.1), mesh=mesh, seed=7)
+    est.train(_ncf_fs(), batch_size=B, epochs=epochs)
+    return jax.tree_util.tree_map(np.asarray, est.params)
+
+
+def _assert_trees_bitwise(a, b):
+    fa, ta = jax.tree_util.tree_flatten(a)
+    fb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(x, y)
+
+
+class TestEstimatorParity:
+    def test_unsharded_training_bitwise_knob_on_vs_off(self, ctx):
+        mesh = _mesh4()
+        with _knob(True):
+            fused = _train_ncf(False, mesh)
+        with _knob(False):
+            ref = _train_ncf(False, mesh)
+        _assert_trees_bitwise(fused, ref)
+
+    def test_sharded_training_bitwise_knob_on_vs_off(self, ctx):
+        mesh = _mesh4()
+        with _knob(True):
+            fused = _train_ncf(True, mesh)
+        with _knob(False):
+            ref = _train_ncf(True, mesh)
+        _assert_trees_bitwise(fused, ref)
+
+    def test_knob_off_is_the_old_path_byte_identical(self, ctx):
+        """Byte-level: the SparseEmbedding trace with the knob off must be
+        the same jaxpr STRING as with it on (the fused wrappers branch at
+        trace time and replay the identical op chain off-TPU)."""
+        layer = SparseEmbedding(12, 4, combiner="mean", name="t")
+        params, state = layer.build(jax.random.PRNGKey(0), (None, 3))
+        idx = jnp.asarray(
+            np.array([[0, 5, -1], [11, -1, -1]], np.int32))
+
+        def fwd(p, i):
+            out, _ = layer.call(p, state, i)
+            return out
+
+        with _knob(True):
+            on = str(jax.make_jaxpr(fwd)(params, idx))
+        with _knob(False):
+            off = str(jax.make_jaxpr(fwd)(params, idx))
+        assert on == off
+
+    def test_layer_level_fused_override_beats_the_knob(self, ctx):
+        with _knob(True):
+            assert Embedding(8, 4, name="a", fused=False) \
+                ._fused_kernels() is None
+        with _knob(False):
+            assert Embedding(8, 4, name="b", fused=True) \
+                ._fused_kernels() is not None
+            assert Embedding(8, 4, name="c")._fused_kernels() is None
+
+
+_BENCH_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench.py")
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("zoo_bench_fused",
+                                                  _BENCH_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestBenchFusedAB:
+    def test_ab_publishes_parity_gated_speedup(self, ctx):
+        """The bench A/B helper must land the embedding_fused_speedup
+        detail keys with the parity fence passing — through a real (tiny)
+        Estimator + the differenced N-step scan."""
+        bench = _load_bench()
+        from analytics_zoo_tpu.parallel.mesh import shard_batch
+        rs = np.random.RandomState(0)
+        x = np.stack([rs.randint(1, USERS + 1, 64),
+                      rs.randint(1, ITEMS + 1, 64)], 1).astype(np.float32)
+        y = rs.randint(0, 2, 64).astype(np.float32)
+
+        def make_est():
+            model = NeuralCF(USERS, ITEMS, 2, user_embed=8, item_embed=8,
+                             hidden_layers=(16, 8), mf_embed=8
+                             ).build_model()
+            return Estimator(
+                model=model,
+                loss_fn=objectives.get("sparse_categorical_crossentropy"),
+                optimizer=SGD(0.1), seed=7)
+
+        est = make_est()
+        bx, by = shard_batch(est.mesh, (x, y))
+        ab = bench._embedding_fused_ab(make_est, bx, by, steps=25)
+        assert ab["embedding_fused_parity_ok"] is True
+        assert ab["embedding_fused_speedup"] > 0
+        assert ab["embedding_fused_step_ms"] > 0
+        assert ab["embedding_unfused_step_ms"] > 0

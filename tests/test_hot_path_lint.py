@@ -187,3 +187,46 @@ def test_embedding_bodies_are_policed_clean():
     found = _lint._check_file(_lint.EMBEDDING_PY, None, _lint.EMBED_BODIES,
                               (), True, "body")
     assert found == []
+
+
+def test_lint_covers_fused_embedding_kernels():
+    """The fused embedding kernel bodies (ops/embedding_kernels.py) and
+    their multi-table/quantize wrappers must stay under the hot-path
+    policy — they ARE the recsys per-step hot path when
+    kernels.fused_embedding is on."""
+    files = {os.path.basename(row[0]) for row in _lint._CHECKS}
+    assert "embedding_kernels.py" in files
+    funcs = {fn for row in _lint._CHECKS for fn in row[2]}
+    assert {"gather_rows", "gather_rows_clip", "segment_grads",
+            "scatter_rows", "gather_pool", "gather_pool_int8",
+            "_gather_kernel", "_gather_pool_kernel",
+            "_scatter_add_kernel", "multi_table_lookup",
+            "quantize_table"} <= funcs
+
+
+def test_lint_catches_seeded_fused_kernel_regressions(tmp_path):
+    """A one-hot densified gather, a per-row Python loop, or a host sync
+    seeded into a fused kernel body must trip the kernel rules (guards
+    the new rows against rotting into a silent always-pass)."""
+    bad = tmp_path / "embedding_kernels.py"
+    bad.write_text(
+        "def gather_pool(table, idx, combiner=None, mask_negative=True):\n"
+        "    hot = jax.nn.one_hot(idx, table.shape[0])\n"
+        "    rows = [table[i] for i in idx]\n"
+        "    total = float(hot.sum())\n"
+        "    return hot @ table, rows, total\n")
+    found = _lint._check_file(str(bad), None, _lint.EMBED_KERNEL_BODIES,
+                              (), True, "body")
+    whats = {w for _, _, w in found}
+    assert {"one_hot()", "per-record Python loop", "float()"} <= whats
+
+
+def test_fused_kernel_bodies_are_policed_clean():
+    """The real fused kernel bodies and wrappers must currently satisfy
+    their own policy — direct check, independent of _CHECKS."""
+    assert _lint._check_file(_lint.EMBED_KERNELS_PY, None,
+                             _lint.EMBED_KERNEL_BODIES, (), True,
+                             "body") == []
+    assert _lint._check_file(_lint.EMBED_KERNELS_PY, None,
+                             _lint.EMBED_KERNEL_WRAPPERS, (), False,
+                             "body") == []
