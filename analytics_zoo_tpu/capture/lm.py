@@ -49,6 +49,9 @@ class TransformerLM:
     def __init__(self, vocab_size: int, hidden: int = 256, n_block: int = 4,
                  n_head: int = 4, max_len: int = 512,
                  intermediate: Optional[int] = None, optimizer="adam",
+                 mesh=None, tensor_parallel: bool = False,
+                 pipeline_stages: Optional[int] = None,
+                 pipeline_microbatches: Optional[int] = None,
                  seed: int = 0):
         if hidden % n_head:
             raise ValueError(f"hidden {hidden} not divisible by "
@@ -60,12 +63,71 @@ class TransformerLM:
         self.max_len = max_len
         self.intermediate = intermediate or 4 * hidden
         self._head_dim = hidden // n_head
+        from ..common.config import global_config
+        cfg = global_config()
+        if pipeline_stages is None:
+            pipeline_stages = int(cfg.get("parallel.pipeline_stages"))
+        if pipeline_microbatches is None:
+            pipeline_microbatches = int(
+                cfg.get("parallel.pipeline_microbatches"))
+        self.mesh = mesh
+        self.tensor_parallel = bool(tensor_parallel)
+        self._pipe_stages = int(pipeline_stages)
+        self._pipe_micro = int(pipeline_microbatches)
+        self._pipe_loss_cache: Dict[int, Any] = {}
+        if self._pipe_stages:
+            from ..parallel.pipeline import PIPE_AXIS, note_pipeline_build
+            if self.n_block % self._pipe_stages:
+                raise ValueError(
+                    f"n_block {self.n_block} not divisible by "
+                    f"pipeline_stages {self._pipe_stages}")
+            if self.mesh is None:
+                from jax.sharding import Mesh
+                devs = jax.devices()
+                if len(devs) < self._pipe_stages:
+                    raise ValueError(
+                        f"pipeline_stages={self._pipe_stages} needs that "
+                        f"many devices; have {len(devs)}")
+                self.mesh = Mesh(np.asarray(devs[:self._pipe_stages]),
+                                 (PIPE_AXIS,))
+            # profiler gauge: the schedule's idle fraction is known at
+            # build time (bytes-per-hop lands when fit sees the batch)
+            note_pipeline_build(self._pipe_stages, self._pipe_micro)
         from .graph_model import GraphModel
         self._graph = GraphModel.from_loss(
-            self._loss, self._init_params, optimizer=optimizer,
+            self._loss_pipelined if self._pipe_stages else self._loss,
+            self._init_params, optimizer=optimizer,
             forward_fn=self._forward)
         # thread the seed into the Estimator's init rng
         self._graph.estimator.root_rng = jax.random.PRNGKey(seed)
+        if self._pipe_stages:
+            # params/opt state must live on the pipe mesh's devices
+            # (replicated there; the shard_map in the loss stage-shards
+            # the stacked blocks at dispatch)
+            self._graph.estimator.mesh = self.mesh
+        if self.tensor_parallel:
+            from ..parallel.tensor import transformer_tp_rules
+            axis = str(cfg.get("parallel.tensor_axis"))
+            if self.mesh is None:
+                from jax.sharding import Mesh
+                self.mesh = Mesh(np.asarray(jax.devices()), (axis,))
+            if axis not in self.mesh.axis_names:
+                raise ValueError(
+                    f"tensor_parallel needs a mesh with a '{axis}' axis; "
+                    f"got {self.mesh.axis_names}")
+            n = dict(zip(self.mesh.axis_names,
+                         self.mesh.devices.shape))[axis]
+            # qkv column sharding splits heads across the axis; fc1 splits
+            # the FFN hidden dim — both must divide for equal shards
+            if self.n_head % n or self.intermediate % n:
+                raise ValueError(
+                    f"n_head {self.n_head} and intermediate "
+                    f"{self.intermediate} must both be divisible by the "
+                    f"'{axis}' axis size {n}")
+            est = self._graph.estimator
+            est.mesh = self.mesh
+            est.param_rules = (list(est.param_rules or [])
+                               + transformer_tp_rules(axis))
 
     # -- parameters -----------------------------------------------------------
 
@@ -132,6 +194,64 @@ class TransformerLM:
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
         return jnp.mean(nll)
+
+    # -- pipelined training (1F1B over the pipe mesh axis) --------------------
+
+    def _pipe_stage_fn(self, local, x):
+        """One pipeline stage: this device's ``n_block/P`` transformer
+        blocks, applied in order. ``local`` is the device's slice of the
+        ``[P, blocks_per_stage, ...]`` stage-stacked tree."""
+        blocks = jax.tree_util.tree_map(lambda l: l[0], local)
+        for i in range(self.n_block // self._pipe_stages):
+            p = jax.tree_util.tree_map(lambda l: l[i], blocks)
+            x = self._block(
+                p, x, lambda q, k, v: flash_attention(q, k, v, causal=True))
+        return x
+
+    def _pipe_head_loss(self, head, out, targets):
+        """Last-stage head: final LN + tied logits + next-token NLL for one
+        microbatch — the same arithmetic as ``_loss`` after the trunk."""
+        x = _layer_norm(head["ln_f"], out)
+        logits = x @ head["embed"].T
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return jnp.mean(nll)
+
+    def _pipe_loss_for(self, batch: int):
+        """The compiled 1F1B loss for a given batch size: microbatch count
+        is ``gcd(batch, pipeline_microbatches)`` so tail batches (smaller,
+        separately compiled shapes anyway) still divide evenly."""
+        import math
+        from ..parallel.pipeline import make_pipeline_loss
+        m = math.gcd(batch, self._pipe_micro) or 1
+        fn = self._pipe_loss_cache.get(m)
+        if fn is None:
+            fn = make_pipeline_loss(self._pipe_stage_fn,
+                                    self._pipe_head_loss, self.mesh,
+                                    n_microbatches=m)
+            self._pipe_loss_cache[m] = fn
+        return fn
+
+    def _loss_pipelined(self, params, x, y=None):
+        """``_loss`` with the block trunk running the 1F1B pipeline schedule
+        over ``mesh['pipe']``: embedding and the tied head stay outside the
+        custom_vjp (so the embedding-gather gradient rides the returned
+        ``dx``, summing with the head's tied-weight gradient), while the
+        blocks are stage-stacked and sharded one group per device.
+        Microbatch means average to the global mean at equal sizes, so
+        parity vs ``_loss`` is float32 tolerance, not bitwise (documented
+        in docs/parallelism.md)."""
+        from ..parallel.pipeline import stack_stage_params
+        tokens = x.astype(jnp.int32)
+        inp, targets = tokens[:, :-1], tokens[:, 1:]
+        s = inp.shape[1]
+        xe = params["embed"][inp] + params["pos"][None, :s]
+        bps = self.n_block // self._pipe_stages
+        stacked = stack_stage_params(
+            [stack_stage_params(params["blocks"][i * bps:(i + 1) * bps])
+             for i in range(self._pipe_stages)])
+        head = {"ln_f": params["ln_f"], "embed": params["embed"]}
+        return self._pipe_loss_for(xe.shape[0])(stacked, head, xe, targets)
 
     # -- generative prefill + slot decode (continuous batching) ---------------
 
@@ -291,8 +411,19 @@ class TransformerLM:
 
     def fit(self, tokens, batch_size: int = 32, epochs: int = 1, **kw):
         """``tokens``: [N, S] int sequences; trains next-token NLL."""
-        return self._graph.fit(np.asarray(tokens, np.float32),
-                               batch_size=batch_size, epochs=epochs, **kw)
+        tokens = np.asarray(tokens, np.float32)
+        if self._pipe_stages:
+            # per-hop ppermute traffic is known once the batch shape is:
+            # one [mb, S-1, hidden] float32 activation per tick per ring
+            from ..parallel.pipeline import note_pipeline_build
+            import math
+            m = math.gcd(batch_size, self._pipe_micro) or 1
+            micro_bytes = (batch_size // m) * (tokens.shape[1] - 1) \
+                * self.hidden * 4
+            note_pipeline_build(self._pipe_stages, m,
+                                micro_bytes=micro_bytes)
+        return self._graph.fit(tokens, batch_size=batch_size,
+                               epochs=epochs, **kw)
 
     def logits(self, tokens, batch_size: int = 32):
         return self._graph.predict(np.asarray(tokens, np.float32),

@@ -316,3 +316,32 @@ _global_config.register("kernels.fused_embedding", True,
                         "bit-identical lax elsewhere). Off = the "
                         "historical unfused layer ops, kept as the "
                         "bit-parity reference.")
+_global_config.register("parallel.tensor_axis", "model",
+                        "Mesh axis tensor-parallel (Megatron column/row) "
+                        "rules shard over; transformer_tp_rules() reads "
+                        "this when no axis is passed explicitly.")
+_global_config.register("parallel.pipeline_stages", 0,
+                        "Default pipeline-parallel stage count for "
+                        "TransformerLM training (0 = pipelining off; "
+                        "stages must divide n_block and equal the "
+                        "'pipe' mesh axis size).")
+_global_config.register("parallel.pipeline_microbatches", 4,
+                        "Microbatches per global batch in the 1F1B "
+                        "pipeline schedule; bubble fraction is "
+                        "2(P-1)/(M+2(P-1)) so larger M amortizes the "
+                        "pipeline fill/drain bubbles.")
+_global_config.register("parallel.moe_capacity_factor", 1.25,
+                        "Default MoE expert capacity factor (GShard "
+                        "k*tokens*C/experts convention) when MoE(...) "
+                        "is built without an explicit value; overflow "
+                        "tokens ride the residual path and are counted "
+                        "in parallel.moe_dropped_tokens_total.")
+_global_config.register("parallel.moe_exchange", "auto",
+                        "MoE expert dispatch: 'dense' = one-hot einsum "
+                        "dispatch with GSPMD-inserted collectives; "
+                        "'alltoall' = explicit fixed-size all-to-all "
+                        "exchange (route -> local expert compute -> "
+                        "reverse, the PR 7 embedding-exchange shape); "
+                        "'auto' = alltoall when a mesh with an 'expert' "
+                        "axis is active and shapes divide, dense "
+                        "otherwise.")

@@ -361,6 +361,30 @@ class Estimator:
         #: per-traced-step (exchange, grad) byte totals of the sharded
         #: embedding path; None until the first dispatch of a fresh step fn
         self._embed_step_bytes: Optional[Tuple[int, int]] = None
+        #: high-water mark of MoE drop counts already drained into the
+        #: parallel.moe_dropped_tokens_total counter (the __moe_dropped__
+        #: state contract accumulates a RUNNING total on device)
+        self._moe_drops_seen = 0
+
+    def _drain_moe_drops(self) -> None:
+        """Publish MoE capacity-drop counts at the per-epoch sync point.
+
+        MoE layers accumulate a running dropped-token count in model state
+        under the ``MOE_DROP_KEY`` contract (keras/engine.py); this drains
+        the delta since the last epoch into the
+        ``parallel.moe_dropped_tokens_total`` counter. Runs next to the
+        loss drain — already a sanctioned host sync — so capacity-factor
+        dropping is never silent yet never adds a per-step sync."""
+        from ..keras.engine import MOE_DROP_KEY
+        from ..parallel.moe import drain_drop_counter
+        flat = jax.tree_util.tree_flatten_with_path(self.model_state)[0]
+        total = 0
+        for path, leaf in flat:
+            if path and str(getattr(path[-1], "key", "")) == MOE_DROP_KEY:
+                total += int(jax.device_get(leaf))
+        if total:
+            self._moe_drops_seen = drain_drop_counter(
+                total, self._moe_drops_seen)
 
     # -- configuration (reference KerasNet setters, Topology.scala:111-127) ---
 
@@ -1070,6 +1094,7 @@ class Estimator:
                         # zoolint: disable=jit-host-sync — per-EPOCH drain, not per-step: the sanctioned pattern
                         history.extend(_flat_losses(jax.device_get(pending)))
                         pending.clear()
+                        self._drain_moe_drops()
                         state.epoch += 1
                         self.epoch = state.epoch
 

@@ -34,6 +34,11 @@ Shape = Tuple[Optional[int], ...]
 # activation regularizers, ...) under this key in their returned state; the
 # Estimator adds them to the training objective
 AUX_LOSS_KEY = "__aux_loss__"
+# state-contract key: capacity-limited layers (MoE) publish a RUNNING count
+# of tokens dropped to overflow under this key; the Estimator drains it at
+# its per-epoch host-sync point into parallel.moe_dropped_tokens_total so
+# capacity-factor dropping is never silent
+MOE_DROP_KEY = "__moe_dropped__"
 _name_counters: Dict[str, "itertools.count"] = defaultdict(lambda: itertools.count(1))
 
 

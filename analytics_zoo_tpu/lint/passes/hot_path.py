@@ -42,6 +42,26 @@ FLEET_PY = os.path.join(REPO_ROOT, "analytics_zoo_tpu", "serving",
                         "fleet.py")
 ENGINE_PY = os.path.join(REPO_ROOT, "analytics_zoo_tpu", "xshard",
                          "engine.py")
+PIPELINE_PY = os.path.join(REPO_ROOT, "analytics_zoo_tpu", "parallel",
+                           "pipeline.py")
+RING_PY = os.path.join(REPO_ROOT, "analytics_zoo_tpu", "parallel",
+                       "ring_attention.py")
+MOE_PY = os.path.join(REPO_ROOT, "analytics_zoo_tpu", "parallel",
+                      "moe.py")
+
+#: model-parallel traced bodies. The pipeline scan bodies run once per
+#: tick inside ``lax.scan`` under ``shard_map``, the ring bodies once per
+#: ppermute hop, the MoE exchange once per step — all pure device code:
+#: loop-free outright (scan/ppermute replace Python iteration), no host
+#: syncs, no ``np.*`` staging, no one_hot densification.
+PIPELINE_BODIES = ("pipeline_apply", "_pipe_fwd_body", "_pipe_1f1b_body")
+# ulysses_attention is deliberately NOT a row: it is a per-shard body the
+# CALLER wraps in shard_map, so the jit-boundary pass has no package-level
+# trace site to auto-discover it from (the discovery-coverage invariant in
+# tests/test_zoolint.py would break); the ring bodies below are reached
+# through ring_self_attention/ring_context's own shard_map wrappers.
+RING_BODIES = ("ring_attention", "ring_masked_context")
+MOE_BODIES = ("_expert_exchange",)
 
 EMBED_BODIES = ("_routing", "_lookup_body", "_lookup_bwd_body",
                 "_update_body")
@@ -117,6 +137,9 @@ _CHECKS: List[Tuple[str, Optional[str], Sequence[str], Sequence[str],
     (FLEET_PY, None, ("_score_instances",), (), True, "body"),
     (ENGINE_PY, None, ETL_KERNELS, (), True, "body"),
     (ENGINE_PY, None, ETL_TASKS, (), False, "body"),
+    (PIPELINE_PY, None, PIPELINE_BODIES, (), True, "body"),
+    (RING_PY, None, RING_BODIES, (), True, "body"),
+    (MOE_PY, None, MOE_BODIES, (), True, "body"),
 ]
 
 

@@ -256,6 +256,50 @@ def init_paged_pool(num_pages: int, heads: int, page_len: int,
             "v": jnp.zeros((num_pages, heads, page_len, head_dim), dtype)}
 
 
+#: mesh axis the paged pool's page dimension shards over
+KV_SHARD_AXIS = "kv"
+
+
+def shard_paged_pool(caches, n_shard: int,
+                     axis_name: str = KV_SHARD_AXIS):
+    """Spread each block's page pool across ``n_shard`` devices along the
+    PAGE axis (contiguous blocks of ``num_pages/n_shard`` pages per
+    device) — the sharded-KV serving tier for models whose cache exceeds
+    one device's HBM budget.
+
+    Pure placement, no program change: the decode step's page gather
+    pulls each stream's pages to the compute device and the attention
+    arithmetic runs on the gathered buffer exactly as it does over a
+    single-device pool, so decoded tokens are bit-identical to
+    ``n_shard=1`` (asserted by the serving parity tests). Scalars (int8
+    running amax) stay replicated. Allocators should hand out pages
+    round-robin across shards so writes spread evenly (serving/server.py
+    does)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    if n_shard < 1 or len(devs) % n_shard:
+        raise ValueError(f"kv shard count {n_shard} must divide the local "
+                         f"device count {len(devs)}")
+    num_pages = caches[0]["k"].shape[0]
+    if num_pages % n_shard:
+        raise ValueError(f"num_pages {num_pages} must be divisible by the "
+                         f"kv shard count {n_shard}")
+    import numpy as _np
+    # the mesh spans ALL local devices (jit needs one device set across
+    # the pool, params, and tables); pages split over the first axis and
+    # replicate over the remainder
+    mesh = Mesh(_np.asarray(devs).reshape(n_shard, -1),
+                (axis_name, "kv_repl"))
+
+    def put(leaf):
+        spec = (P(axis_name, *([None] * (leaf.ndim - 1)))
+                if leaf.ndim >= 1 and leaf.shape[0] == num_pages else P())
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return [jax.tree_util.tree_map(put, c) for c in caches]
+
+
 def page_table_set(table: jax.Array, slot, row: jax.Array) -> jax.Array:
     """Install ``row`` [W] as ``slot``'s page table. Both may be traced —
     joins never recompile."""

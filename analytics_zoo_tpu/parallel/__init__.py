@@ -7,9 +7,12 @@ from .embedding import (  # noqa: F401
     cold_lookup, init_row_state, make_shard_spec, set_default_mesh,
     sharded_lookup, validate_ids)
 from .ring_attention import (  # noqa: F401
-    ring_attention, ring_self_attention, ulysses_attention)
+    ring_attention, ring_context, ring_masked_context, ring_self_attention,
+    ulysses_attention)
 from .moe import MoE, moe_sharding_rule  # noqa: F401
 from .pipeline import (  # noqa: F401
-    PIPE_AXIS, gpipe, pipeline_apply, stack_stage_params)
+    PIPE_AXIS, bubble_fraction, gpipe, make_pipeline_loss,
+    note_pipeline_build, pipeline_apply, stack_stage_params)
 from .tensor import (  # noqa: F401
-    column_parallel, megatron_mlp_rules, row_parallel, vocab_parallel)
+    column_parallel, megatron_mlp_rules, row_parallel, transformer_tp_rules,
+    vocab_parallel)

@@ -26,7 +26,9 @@ def data_sharding(mesh: Mesh, ndim: int, batch_axis: int = 0) -> NamedSharding:
     ``batch_axis`` > 0 supports step-stacked batches ``[k, B, ...]`` (the
     multi-step dispatch path) where the STEP axis leads and must stay
     replicated."""
-    if ndim <= batch_axis:
+    if ndim <= batch_axis or DATA_AXIS not in mesh.axis_names:
+        # pure model/pipe/seq meshes have no data axis: the batch is
+        # replicated and the collectives partition the compute instead
         return NamedSharding(mesh, P())
     dims = [None] * ndim
     dims[batch_axis] = DATA_AXIS

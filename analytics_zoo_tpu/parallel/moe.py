@@ -23,11 +23,73 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
+from ..common import metrics as _metrics
 from ..keras import initializers
-from ..keras.engine import AUX_LOSS_KEY, Layer
+from ..keras.engine import AUX_LOSS_KEY, MOE_DROP_KEY, Layer
 
 EXPERT_AXIS = "expert"
+
+_M_DROPPED = _metrics.counter(
+    "parallel.moe_dropped_tokens_total",
+    "Tokens whose every top-k expert choice overflowed capacity and rode "
+    "the residual path untouched. MoE layers accumulate the count in "
+    "model state under the __moe_dropped__ contract; the Estimator "
+    "drains it here at its per-epoch sync point — capacity-factor "
+    "dropping is never silent.")
+
+
+def drain_drop_counter(total: int, seen: int) -> int:
+    """Host-side hook for the Estimator's per-epoch drain: publish the
+    delta between the state-accumulated drop ``total`` and the last
+    drained value, returning the new high-water mark."""
+    if total > seen:
+        _M_DROPPED.inc(int(total - seen))
+        return int(total)
+    return int(seen)
+
+
+def _expert_exchange(xin, w_in, b_in, w_out, b_out, act, axis_name):
+    """Per-shard expert FFN via the explicit fixed-size exchange — the
+    PR 7 embedding-exchange shape (route → local compute → reverse): token
+    groups arrive sharded over the expert axis, one ``all_to_all`` swaps
+    the sharding from groups to experts (every device sends each peer its
+    capacity slots for that peer's experts — fixed-size, so shapes stay
+    static and no host sync is needed), each device runs ONLY its local
+    experts' FFN, and the reverse ``all_to_all`` sends results home. The
+    per-slot arithmetic is identical to the dense einsum path, so the two
+    are bit-compatible."""
+    routed = lax.all_to_all(xin, axis_name, split_axis=1, concat_axis=0,
+                            tiled=True)
+    h = act(jnp.einsum("gecd,edh->gech", routed, w_in)
+            + b_in[None, :, None, :])
+    out = (jnp.einsum("gech,ehd->gecd", h, w_out)
+           + b_out[None, :, None, :])
+    return lax.all_to_all(out, axis_name, split_axis=0, concat_axis=1,
+                          tiled=True)
+
+
+def _exchange_mesh(g: int, e: int, mode: str):
+    """Static routing decision: the mesh to run the explicit all-to-all
+    exchange over, or None for the dense-dispatch path. ``alltoall``
+    demands it (raising when shapes can't ride the exchange); ``auto``
+    falls back to dense when no expert-axis mesh is active or the group/
+    expert counts don't divide over it."""
+    if mode == "dense":
+        return None
+    from .embedding import default_mesh
+    mesh = default_mesh()
+    has_axis = mesh is not None and EXPERT_AXIS in mesh.axis_names
+    n = (dict(zip(mesh.axis_names, mesh.devices.shape))[EXPERT_AXIS]
+         if has_axis else 0)
+    ok = has_axis and n > 0 and g % n == 0 and e % n == 0
+    if mode == "alltoall" and not ok:
+        raise ValueError(
+            f"moe exchange='alltoall' needs a mesh with an '{EXPERT_AXIS}' "
+            f"axis whose size divides groups ({g}) and experts ({e}); "
+            f"active mesh: {None if mesh is None else mesh.axis_names}")
+    return mesh if ok else None
 
 
 class MoE(Layer):
@@ -44,19 +106,34 @@ class MoE(Layer):
     """
 
     def __init__(self, num_experts: int, hidden_dim: int,
-                 capacity_factor: float = 1.25,
+                 capacity_factor: Optional[float] = None,
                  aux_loss_weight: float = 1e-2,
                  group_size: int = 4096,
                  activation: str = "relu",
                  init: str = "glorot_uniform",
                  k: int = 1,
+                 exchange: Optional[str] = None,
                  name: Optional[str] = None):
         super().__init__(name)
+        from ..common.config import global_config
         if not 1 <= k <= num_experts:
             raise ValueError(f"k={k} must be in [1, num_experts]")
         self.num_experts = num_experts
         self.hidden_dim = hidden_dim
+        if capacity_factor is None:
+            capacity_factor = float(
+                global_config().get("parallel.moe_capacity_factor"))
         self.capacity_factor = capacity_factor
+        # expert dispatch: dense one-hot einsums (XLA derives the
+        # collective from the shardings) vs the explicit fixed-size
+        # all-to-all exchange; 'auto' takes the exchange whenever an
+        # expert-axis mesh is active and the shapes divide over it
+        exchange = exchange if exchange is not None else str(
+            global_config().get("parallel.moe_exchange"))
+        if exchange not in ("dense", "alltoall", "auto"):
+            raise ValueError(f"exchange={exchange!r} must be 'dense', "
+                             f"'alltoall' or 'auto'")
+        self.exchange = exchange
         self.aux_loss_weight = aux_loss_weight
         # routing happens within fixed-size token GROUPS so the dispatch
         # one-hot stays linear in the token count (a single global group
@@ -81,8 +158,11 @@ class MoE(Layer):
             "b_out": jnp.zeros((self.num_experts, d)),
         }
         # the load-balance loss travels through state under the generic
-        # `__aux_loss__` contract: the Estimator adds it to the objective
-        return params, {AUX_LOSS_KEY: jnp.zeros((), jnp.float32)}
+        # `__aux_loss__` contract (the Estimator adds it to the objective);
+        # the drop counter accumulates under `__moe_dropped__` and is
+        # drained into parallel.moe_dropped_tokens_total per epoch
+        return params, {AUX_LOSS_KEY: jnp.zeros((), jnp.float32),
+                        MOE_DROP_KEY: jnp.zeros((), jnp.int32)}
 
     def call(self, params, state, inputs, *, training=False, rng=None):
         from ..keras.layers.core import get_activation
@@ -119,7 +199,7 @@ class MoE(Layer):
         onehots, gates = [], []
         for _ in range(self.k):
             idx_c = jnp.argmax(remaining, axis=-1)         # [g, t]
-            oh_c = jax.nn.one_hot(idx_c, e, dtype=jnp.float32)
+            oh_c = jax.nn.one_hot(idx_c, e, dtype=jnp.float32)  # zoolint: disable=jit-host-sync — expert-count one-hot (e static and small): the GShard dispatch tensor, not a vocab densification
             gates.append(jnp.sum(probs * oh_c, axis=-1))
             onehots.append(oh_c * valid.astype(jnp.float32)[..., None])
             remaining = remaining * (1.0 - oh_c)
@@ -143,7 +223,7 @@ class MoE(Layer):
             pos_in_expert = jnp.sum(pos, axis=-1).astype(jnp.int32)
             routed = jnp.sum(oh_c, axis=-1) > 0            # valid tokens
             keep = (pos_in_expert < cap) & routed          # capacity mask
-            slot_onehot = jax.nn.one_hot(pos_in_expert, cap,
+            slot_onehot = jax.nn.one_hot(pos_in_expert, cap,  # zoolint: disable=jit-host-sync — capacity-slot one-hot (cap static and small): the GShard combine layout, not a vocab densification
                                          dtype=flat.dtype)
             dispatch = (oh_c.astype(flat.dtype)[..., None]
                         * slot_onehot[..., None, :]
@@ -155,15 +235,37 @@ class MoE(Layer):
             claimed = claimed + jnp.sum(oh_c * keep[..., None].astype(
                 jnp.float32), axis=1, keepdims=True)
 
-        # expert inputs [g, e, cap, d] — the contraction over tokens is
-        # where XLA inserts the all-to-all under expert sharding
+        # expert inputs [g, e, cap, d] — the fixed-size dispatch the
+        # exchange routes (dense path: the contraction over tokens is
+        # where XLA inserts the all-to-all under expert sharding)
         xin = jnp.einsum("gtec,gtd->gecd", dispatch_total, grouped)
-        h = act(jnp.einsum("gecd,edh->gech", xin,
-                           params["w_in"].astype(flat.dtype))
-                + params["b_in"].astype(flat.dtype)[None, :, None, :])
-        out = (jnp.einsum("gech,ehd->gecd", h,
-                          params["w_out"].astype(flat.dtype))
-               + params["b_out"].astype(flat.dtype)[None, :, None, :])
+        w_in = params["w_in"].astype(flat.dtype)
+        b_in = params["b_in"].astype(flat.dtype)
+        w_out = params["w_out"].astype(flat.dtype)
+        b_out = params["b_out"].astype(flat.dtype)
+        ex_mesh = _exchange_mesh(g, e, self.exchange)
+        if ex_mesh is not None:
+            from functools import partial
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+            from .pipeline import note_collective_bytes
+            tok_spec = P(EXPERT_AXIS, None, None, None)
+            ex = shard_map(
+                partial(_expert_exchange, act=act, axis_name=EXPERT_AXIS),
+                mesh=ex_mesh,
+                in_specs=(tok_spec, P(EXPERT_AXIS, None, None),
+                          P(EXPERT_AXIS, None), P(EXPERT_AXIS, None, None),
+                          P(EXPERT_AXIS, None)),
+                out_specs=tok_spec)
+            # trace-time attribution: route + reverse move the full
+            # dispatch buffer across the expert axis once each per step
+            note_collective_bytes(2 * xin.size * xin.dtype.itemsize)
+            out = ex(xin, w_in, b_in, w_out, b_out)
+        else:
+            h = act(jnp.einsum("gecd,edh->gech", xin, w_in)
+                    + b_in[None, :, None, :])
+            out = (jnp.einsum("gech,ehd->gecd", h, w_out)
+                   + b_out[None, :, None, :])
         combined = jnp.einsum("gtec,gecd->gtd", combine_total, out)
         # tokens whose every choice was dropped ride the residual path
         y = jnp.where(any_kept[..., None], combined, grouped)
@@ -178,8 +280,13 @@ class MoE(Layer):
         vprobs = probs * valid.astype(probs.dtype)[..., None]
         frac_probs = jnp.sum(vprobs, axis=1) / denom
         aux = e * jnp.mean(jnp.sum(frac_tokens * frac_probs, axis=-1))
+        # tokens whose EVERY choice overflowed: accumulated in state (the
+        # Estimator drains the running count per epoch — never silent)
+        dropped = jnp.sum(valid & ~any_kept).astype(jnp.int32)
+        prev_drops = jnp.asarray(state.get(MOE_DROP_KEY, 0), jnp.int32)
         new_state = {AUX_LOSS_KEY: (aux * self.aux_loss_weight
-                                    ).astype(jnp.float32)}
+                                    ).astype(jnp.float32),
+                     MOE_DROP_KEY: prev_drops + dropped}
         return (y[:, 0, :] if squeeze else y), new_state
 
     def compute_output_shape(self, input_shape):

@@ -25,8 +25,14 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import flash_attention, flash_attention_lse, _NEG_INF
+from .pipeline import _axis_size, _vary
 
 SEQ_AXIS = "seq"
+
+
+def _rotate_perm(n: int):
+    """Ring rotation: device j sends its K/V shard to device j-1."""
+    return [(j, (j - 1) % n) for j in range(n)]
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -41,7 +47,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     ``causal=True`` the global position of each shard (this device's
     ``axis_index``) masks future tokens across shard boundaries.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, h, sq, d = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -90,7 +96,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         out, lse = merge(out, lse, out_h, lse_h)
         # rotate k/v to the next device on the ring (overlaps with the next
         # hop's compute under XLA's async collective scheduling)
-        perm = [(j, (j - 1) % n) for j in range(n)]
+        perm = _rotate_perm(n)
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
         return (out, lse, kc, vc), None
@@ -113,7 +119,7 @@ def ring_self_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array,
                         scale: Optional[float] = None) -> jax.Array:
     """Global entry: shards the seq axis of [b, h, s, d] over ``mesh['seq']``
     and runs the ring. Batch rides the ``data`` axis if present."""
-    from jax import shard_map
+    from jax.experimental.shard_map import shard_map
 
     batch_axis = "data" if "data" in mesh.axis_names else None
     spec = P(batch_axis, None, SEQ_AXIS, None)
@@ -121,6 +127,92 @@ def ring_self_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array,
         partial(ring_attention, causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
+
+
+def ring_masked_context(q: jax.Array, k_blk: jax.Array, v_blk: jax.Array,
+                        visible_blk: jax.Array,
+                        scale: float,
+                        axis_name: str = SEQ_AXIS) -> jax.Array:
+    """Per-shard decode-cache attention over a ``ppermute`` ring of KV
+    BLOCKS: the 100k+-token context path where no single device holds the
+    whole cache. Each device owns one ``[b, h, K/n, d]`` block of the key/
+    value buffers plus the matching slice of the visibility mask; ``q``
+    (the decode query, small ``t``) is replicated. Every ring step runs
+    the literal ``masked_context`` score arithmetic against the visiting
+    block — the same ``bhtd,bhkd`` float32 einsum, the same ``_NEG_INF``
+    masking — and folds it into running (max, numerator, denominator)
+    streaming-softmax statistics; blocks then rotate one hop. After n-1
+    rotations every block has visited every device and ``num/den``
+    reproduces ``masked_context`` over the full buffer (the reduction is
+    blockwise, so parity vs the single-device softmax is documented
+    float32 tolerance, not bitwise; a fully-masked row degrades to the
+    same uniform average ``softmax`` of an all-``_NEG_INF`` row yields).
+    """
+    n = _axis_size(axis_name)
+
+    def partial_scores(kc, vis):
+        # one ring step == masked_context's score arithmetic, verbatim
+        s = jnp.einsum("bhtd,bhkd->bhtk", q, kc,
+                       preferred_element_type=jnp.float32) * scale
+        return jnp.where(vis, s, _NEG_INF)
+
+    def fold(carry_m, carry_num, carry_den, kc, vc, vis):
+        s = partial_scores(kc, vis)
+        m_new = jnp.maximum(carry_m, jnp.max(s, axis=-1))
+        w_old = jnp.exp(carry_m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        num = (carry_num * w_old[..., None]
+               + jnp.einsum("bhtk,bhkd->bhtd", p.astype(vc.dtype), vc,
+                            preferred_element_type=jnp.float32))
+        den = carry_den * w_old + jnp.sum(p, axis=-1)
+        return m_new, num, den
+
+    def hop(carry, i):
+        m, num, den, kc, vc, vis = carry
+        m, num, den = fold(m, num, den, kc, vc, vis)
+        perm = _rotate_perm(n)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        vis = lax.ppermute(vis, axis_name, perm)
+        return (m, num, den, kc, vc, vis), None
+
+    # accumulators derive from q so they inherit its varying-axis type
+    m0 = q[..., 0].astype(jnp.float32) * 0 + _NEG_INF
+    num0 = q.astype(jnp.float32) * 0.0
+    den0 = q[..., 0].astype(jnp.float32) * 0.0
+    (m, num, den, kc, vc, vis), _ = lax.scan(
+        hop, (m0, num0, den0, k_blk, v_blk, visible_blk),
+        jnp.arange(n - 1))
+    m, num, den = fold(m, num, den, kc, vc, vis)
+    return (num / den[..., None]).astype(q.dtype)
+
+
+def ring_context(mesh: Mesh, q: jax.Array, k_buf: jax.Array,
+                 v_buf: jax.Array, visible: jax.Array,
+                 scale: float) -> jax.Array:
+    """Global entry: ``masked_context`` semantics with the KEY axis of the
+    ``[b, h, K, d]`` K/V buffers (and the matching ``[b, h, t, K]`` mask)
+    sharded over ``mesh['seq']`` — the whole cache never materializes on
+    one device. Drop-in for ``masked_context(q, k, v, visible, scale)``
+    at documented float32 tolerance."""
+    from jax.experimental.shard_map import shard_map
+
+
+    def body(qr, kc, vc, vis):
+        ctx = ring_masked_context(_vary(qr, SEQ_AXIS), kc, vc, vis, scale)
+        # every device computed the same logical result off the full ring;
+        # the masked psum (exact zeros elsewhere) makes that invariance
+        # visible to shard_map's replication check without changing values
+        return lax.psum(
+            jnp.where(lax.axis_index(SEQ_AXIS) == 0, ctx,
+                      jnp.zeros_like(ctx)), SEQ_AXIS)
+
+    kv_spec = P(None, None, SEQ_AXIS, None)
+    vis_spec = P(None, None, None, SEQ_AXIS)
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(P(), kv_spec, kv_spec, vis_spec),
+                   out_specs=P())
+    return fn(q, k_buf, v_buf, visible)
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -134,7 +226,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     Per-shard body for ``shard_map``; local shapes ``[b, h, seq/n, d]``.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     b, h, sq, d = q.shape
     if h % n:
         raise ValueError(f"heads {h} not divisible by seq-axis size {n}")

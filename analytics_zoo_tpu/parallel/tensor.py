@@ -106,3 +106,24 @@ def megatron_mlp_rules(up: Sequence[str], down: Sequence[str],
     if embeddings:
         rules.append(vocab_parallel(embeddings, axis))
     return rules
+
+
+def transformer_tp_rules(axis: str = None) -> list:
+    """TransformerLM's Megatron block layout as a rule list: ``qkv`` and
+    ``fc1`` column-parallel (the attention head dim and the FFN hidden dim
+    shard over ``axis``), ``attn_out`` and ``fc2`` row-parallel (XLA inserts
+    the single reduce bringing activations back to the residual). This is
+    the f/g collective pair per block — one all-gather entering the sharded
+    region forward, one reduce-scatter leaving it backward — derived by the
+    SPMD partitioner from these declared layouts rather than hand-written.
+
+    ``qkv`` column sharding splits the fused ``[d, 3d]`` projection on its
+    output features, which the head reshape ``[b, s, H, hd]`` inherits, so
+    attention (flash / fused short-seq kernel) runs on head-sharded inputs
+    with no extra collective. Requires ``n_head % axis_size == 0``.
+    """
+    if axis is None:
+        from ..common.config import global_config
+        axis = global_config().get("parallel.tensor_axis")
+    return [column_parallel(("qkv", "fc1"), axis),
+            row_parallel(("attn_out", "fc2"), axis)]

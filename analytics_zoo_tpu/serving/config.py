@@ -51,6 +51,11 @@ class ServingConfig:
     kv_page_len: int = 16  # tokens per page; must divide the LM's max_len
     #   and be a power of two <= 16 (so it divides every prefill bucket)
     kv_int8: bool = False  # int8 KV pool (delayed-scaling quantization)
+    kv_shard: int = 1  # devices the pool's PAGE axis shards over (a model
+    #   whose KV exceeds one device's HBM spreads pages across the mesh;
+    #   decode gathers each stream's pages to the compute device, so
+    #   sharded output is token-identical to kv_shard=1). Must divide
+    #   kv_pages and be <= the local device count.
     spec_k: int = 0  # speculative decoding: draft tokens per verify round;
     #   0 = disabled. Requires kv_pages and a draft_lm, greedy-only.
 
@@ -112,6 +117,7 @@ class ServingConfig:
             cfg.kv_pages = int(params["kv_pages"])
         cfg.kv_page_len = int(params.get("kv_page_len", cfg.kv_page_len))
         cfg.kv_int8 = bool(params.get("kv_int8", cfg.kv_int8))
+        cfg.kv_shard = int(params.get("kv_shard", cfg.kv_shard))
         cfg.spec_k = int(params.get("spec_k", cfg.spec_k))
         cfg.log_dir = raw.get("log_dir", cfg.log_dir)
         cfg.health_path = raw.get("health_path", cfg.health_path)

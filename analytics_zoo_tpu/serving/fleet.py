@@ -243,6 +243,14 @@ class FleetRouter:
                 slots_free[i] = max(0, inst.slots - in_flight[i])
             kv = snap.get("kv_pages_free")
             if kv is not None:
+                # sharded pools: capacity is bounded by the emptiest page
+                # shard (the round-robin allocator stalls on a full shard
+                # even when the pool-wide free count looks ample), so the
+                # effective free count is min_shard x shards
+                min_shard = snap.get("kv_pages_free_min_shard")
+                shards = snap.get("kv_shards") or 1
+                if min_shard is not None and shards > 1:
+                    kv = min_shard * shards
                 pages_free[i] = kv
             ewma = snap.get("service_time_s_ewma")
             p99 = (snap.get("latency_ms") or {}).get("p99")

@@ -1080,15 +1080,25 @@ class GenerativeServing:
             self._table_w = (lm.max_len + self._spec_k + pl - 1) // pl
             self._caches = lm.init_paged_caches(num_pages, pl,
                                                 int8=config.kv_int8)
+            self._kv_shard = int(getattr(config, "kv_shard", 1) or 1)
+            if self._kv_shard > 1:
+                from ..ops.decode import shard_paged_pool
+                # page axis spread over kv_shard devices; decode gathers
+                # each stream's pages to the compute device, so tokens
+                # stay bit-identical to the single-device pool
+                self._caches = shard_paged_pool(self._caches,
+                                                self._kv_shard)
             self._table = jnp.zeros((self.slots, self._table_w), jnp.int32)
             # host-side allocator: free-page stack, refcounts, and the
             # pages each slot holds (shared prefix pages appear in many)
-            self._free_pages = list(range(num_pages - 1, 0, -1))
+            self._free_pages = self._initial_free_pages(num_pages,
+                                                        self._kv_shard)
             self._page_refs = np.zeros(num_pages, np.int64)
             self._slot_pages: List[List[int]] = [[] for _ in
                                                  range(self.slots)]
             self._prefixes: List[Dict[str, Any]] = []
         else:
+            self._kv_shard = 1
             self._caches = lm.init_slot_caches(self.slots)
         self._state = init_slot_state(self.slots)
         if self._spec:
@@ -1348,6 +1358,32 @@ class GenerativeServing:
         if self._paged:
             self._release_pages(slot)
         self._clear_slot(slot)
+
+    @staticmethod
+    def _initial_free_pages(num_pages: int, kv_shard: int):
+        """Allocatable pages ``1..num_pages-1`` as a pop()-able stack.
+        Sharded pools interleave the stack round-robin across page shards
+        so consecutive allocations land on different devices — without it
+        a cold pool would fill shard 0 solid before touching shard 1,
+        hot-spotting its HBM and its gather traffic."""
+        if kv_shard <= 1:
+            return list(range(num_pages - 1, 0, -1))
+        per = num_pages // kv_shard  # pages per shard (validated to divide)
+        order = sorted(range(1, num_pages),
+                       key=lambda p: (p % per, p // per))
+        return order[::-1]  # .pop() walks shards round-robin
+
+    def _pages_free_per_shard(self):
+        """Free-page count per pool shard (shard of page p: ``p // per``).
+        The fleet router sizes sharded capacity by the MIN shard: an
+        allocation needs a free page on whichever shard the round-robin
+        stack surfaces, and a full shard stalls placement even when other
+        shards have room."""
+        per = self.num_pages // self._kv_shard
+        counts = [0] * self._kv_shard
+        for p in self._free_pages:
+            counts[p // per] += 1
+        return counts
 
     def _release_pages(self, slot: int) -> None:
         """Decrement every page the slot holds; refcount-0 pages return to
@@ -2077,6 +2113,10 @@ class GenerativeServing:
                                     if self._ewma_token_s > 0 else None),
             "kv_pages_free": (len(self._free_pages) if self._paged
                               else None),
+            "kv_shards": (self._kv_shard if self._paged else None),
+            "kv_pages_free_min_shard": (
+                min(self._pages_free_per_shard())
+                if self._paged and self._kv_shard > 1 else None),
             "spec_accept_ratio": (
                 round(float(self._m_spec_accept.value()), 4)
                 if self._spec else None),

@@ -13,7 +13,8 @@ from analytics_zoo_tpu.lint.passes.hot_path import (  # noqa: E402,F401
     DECODE_PY, DEVICE_FEED_PY, EMBED_BODIES, EMBED_KERNEL_BODIES,
     EMBED_KERNEL_WRAPPERS, EMBED_KERNELS_PY, EMBEDDING_PY, ENGINE_PY,
     ESTIMATOR_PY, ETL_KERNELS, ETL_TASKS, FEATURESET_PY, FLEET_PY,
-    HOT_FUNCS, LM_PY, PAGED_OPS, SERVER_PY, SLOT_OPS, _CHECKS,
+    HOT_FUNCS, LM_PY, MOE_BODIES, MOE_PY, PAGED_OPS, PIPELINE_BODIES,
+    PIPELINE_PY, RING_BODIES, RING_PY, SERVER_PY, SLOT_OPS, _CHECKS,
     _banned_call, _check_file, _iter_functions, _scan_stmts, check, main,
     policed_functions)
 

@@ -30,7 +30,8 @@ class TestRatioMode:
             assert impl_key in bench._RATIO_IMPLS
 
     @pytest.mark.parametrize("name", [
-        pytest.param(n, marks=pytest.mark.slow) if n == "generate" else n
+        pytest.param(n, marks=pytest.mark.slow)
+        if n in ("generate", "tp_decode") else n
         for n in sorted(bench._RATIO_PLAN)])
     def test_every_workload_lands_a_valid_record(self, name, ctx):
         """The outage contract: with no accelerator at all, each workload

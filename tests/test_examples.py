@@ -15,6 +15,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SLOW = {
     "examples/imageclassification/int8_dataflow_train.py",
     "examples/objectdetection/ssd_example.py",
+    # heaviest smokes re-tiered for the tier-1 870s budget
+    "examples/textgeneration/lm_generate_example.py",
+    "examples/textclassification/bert_classifier_example.py",
 }
 
 EXAMPLES = [

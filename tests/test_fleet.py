@@ -285,6 +285,23 @@ class TestContinuationOnFailover:
             f"adopted stream diverged after {k} pre-kill tokens")
         assert int(_fleet._M_FAILOVERS.value()) == fo_before + 1
 
+    @pytest.mark.slow  # paged+sharded decode compile on two instances
+    def test_failover_onto_sharded_kv_pool(self, ctx, tmp_path):
+        """Both instances serve from paged pools sharded over the mesh
+        (``kv_shard``): the adopted stream re-prefills into B's SHARDED
+        pool and must still finish with exactly serial generate's tokens
+        — failover continuation composes with KV sharding."""
+        lm = _lm()
+        prompt = np.random.RandomState(9).randint(0, 16, (5,)).tolist()
+        budget = 10
+        want = lm.generate(np.asarray([prompt]),
+                           max_new_tokens=budget)[0].tolist()
+        res, k = self._run_failover(tmp_path, lm, prompt, budget,
+                                    kv_pages=16, kv_page_len=8,
+                                    kv_shard=2)
+        assert res["value"] == want, (
+            f"sharded-pool adoption diverged after {k} pre-kill tokens")
+
     def test_sampled_failover_bit_identical(self, ctx, tmp_path):
         """The adopting server resumes the ORIGINAL key schedule: keys are
         split over the full budget and indexed by len(tokens), so token k

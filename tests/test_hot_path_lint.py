@@ -230,3 +230,69 @@ def test_fused_kernel_bodies_are_policed_clean():
     assert _lint._check_file(_lint.EMBED_KERNELS_PY, None,
                              _lint.EMBED_KERNEL_WRAPPERS, (), False,
                              "body") == []
+
+
+def test_lint_covers_model_parallel_bodies():
+    """The pipeline scan bodies, the ring-attention hop bodies, and the
+    MoE expert exchange must stay under the hot-path policy — they run
+    once per tick/hop/step inside shard_map'd device code where a host
+    sync stalls every device on the mesh."""
+    files = {os.path.basename(row[0]) for row in _lint._CHECKS}
+    assert {"pipeline.py", "ring_attention.py", "moe.py"} <= files
+    funcs = {fn for row in _lint._CHECKS for fn in row[2]}
+    assert {"pipeline_apply", "_pipe_fwd_body", "_pipe_1f1b_body",
+            "ring_attention", "ring_masked_context",
+            "_expert_exchange"} <= funcs
+
+
+def test_lint_catches_seeded_model_parallel_regressions(tmp_path):
+    """A per-tick host fetch, a per-microbatch Python loop, or a one-hot
+    densified dispatch seeded into the new traced bodies must trip the
+    model-parallel rules (guards the rows against rotting into a silent
+    always-pass)."""
+    bad_pipe = tmp_path / "pipeline.py"
+    bad_pipe.write_text(
+        "def _pipe_1f1b_body(stage_fn, head_loss_fn, n, axis_name):\n"
+        "    def body(carry, tick):\n"
+        "        outs = [stage_fn(p, x) for p, x in carry]\n"
+        "        n_done = float(tick)\n"
+        "        return carry, np.asarray(outs)\n"
+        "    return body\n")
+    found = _lint._check_file(str(bad_pipe), None, _lint.PIPELINE_BODIES,
+                              (), True, "body")
+    whats = {w for _, _, w in found}
+    assert {"per-record Python loop", "float()", "np.asarray()"} <= whats
+
+    bad_ring = tmp_path / "ring_attention.py"
+    bad_ring.write_text(
+        "def ring_masked_context(q, k_blk, v_blk, visible, scale,\n"
+        "                        axis_name='seq'):\n"
+        "    hops = [jax.device_get(k_blk) for _ in range(8)]\n"
+        "    return hops\n")
+    found = _lint._check_file(str(bad_ring), None, _lint.RING_BODIES,
+                              (), True, "body")
+    whats = {w for _, _, w in found}
+    assert {"per-record Python loop", "jax.device_get()"} <= whats
+
+    bad_moe = tmp_path / "moe.py"
+    bad_moe.write_text(
+        "def _expert_exchange(xin, w_in, b_in, w_out, b_out, act,\n"
+        "                     axis_name):\n"
+        "    hot = jax.nn.one_hot(xin, w_in.shape[0])\n"
+        "    hot.block_until_ready()\n"
+        "    return hot\n")
+    found = _lint._check_file(str(bad_moe), None, _lint.MOE_BODIES,
+                              (), True, "body")
+    whats = {w for _, _, w in found}
+    assert {"one_hot()", ".block_until_ready()"} <= whats
+
+
+def test_model_parallel_bodies_are_policed_clean():
+    """The real pipeline/ring/MoE traced bodies must currently satisfy
+    their own policy — direct check, independent of _CHECKS."""
+    assert _lint._check_file(_lint.PIPELINE_PY, None,
+                             _lint.PIPELINE_BODIES, (), True, "body") == []
+    assert _lint._check_file(_lint.RING_PY, None, _lint.RING_BODIES,
+                             (), True, "body") == []
+    assert _lint._check_file(_lint.MOE_PY, None, _lint.MOE_BODIES,
+                             (), True, "body") == []

@@ -62,6 +62,7 @@ class TestInt8TrainConv:
         assert dx.dtype == jnp.bfloat16
         assert dw.dtype == jnp.float32
 
+    @pytest.mark.slow  # re-tiered: heaviest e2e sweep (tier-1 870s budget)
     def test_int8_network_converges(self, ctx):
         """A small int8-conv classifier must train (loss decreasing into
         the same ballpark as the float version)."""

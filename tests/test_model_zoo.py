@@ -43,6 +43,7 @@ class TestWideAndDeep:
         assert preds.shape == (32, 2)
         np.testing.assert_allclose(np.asarray(preds).sum(1), 1, atol=1e-4)
 
+    @pytest.mark.slow  # re-tiered: heaviest e2e sweep (tier-1 870s budget)
     def test_criteo_scale_vocab(self, ctx):
         """The sparse wide/embed path must survive Criteo-scale vocabularies
         (SURVEY §7 hard part (b)): 2M-entry wide table + 1M-entry embedding.
@@ -262,7 +263,10 @@ class TestImageClassifierBackbones:
     """Construct + forward for the classifier config family (reference
     ImageClassifier per-model configs: inception-v1/vgg/squeezenet/densenet)."""
 
-    @pytest.mark.parametrize("name", ["inception-v1", "squeezenet"])
+    @pytest.mark.parametrize("name", [
+        # inception forward is a ~18s compile — slow tier (870s budget)
+        pytest.param("inception-v1", marks=pytest.mark.slow),
+        "squeezenet"])
     def test_forward(self, ctx, name):
         from analytics_zoo_tpu.models.image.imageclassification import (
             ImageClassifier)
@@ -321,6 +325,7 @@ class TestSequenceTaggers:
         assert p.shape == (8, 10, 5)
         np.testing.assert_allclose(p.sum(-1), 1, atol=1e-4)
 
+    @pytest.mark.slow  # re-tiered: heaviest e2e sweep (tier-1 870s budget)
     def test_intent_entity_joint(self, ctx):
         from analytics_zoo_tpu.models import IntentEntity
         words, chars, tags = self._data()
@@ -393,6 +398,7 @@ class TestSequenceTaggers:
         h = ie.fit([words, chars], (intents, tags), batch_size=8, nb_epoch=1)
         assert np.isfinite(h["loss_history"]).all()
 
+    @pytest.mark.slow  # re-tiered: heaviest e2e sweep (tier-1 870s budget)
     def test_crf_head_learns_transitions(self, ctx):
         """CRF tagger on a task where TRANSITIONS carry the signal: the tag
         alternates 1,2,1,2,... regardless of input. A per-token head can't

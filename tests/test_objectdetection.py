@@ -132,6 +132,7 @@ class TestMeanAveragePrecision:
 
 
 class TestSSDEndToEnd:
+    @pytest.mark.slow  # re-tiered: heaviest e2e sweep (tier-1 870s budget)
     def test_ssd_mobilenet_fit_and_detect(self, ctx):
         det = ObjectDetector(class_num=3, backbone="mobilenet", resolution=300)
         det._ensure_built()

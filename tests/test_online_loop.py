@@ -205,6 +205,7 @@ class TestOnlineNCFLoop:
             out[name] = ClusterServing(cfg)
         return out
 
+    @pytest.mark.slow  # re-tiered: heaviest e2e sweep (tier-1 870s budget)
     def test_train_serve_promote_rollback(self, ctx, tmp_path):
         import jax
         from jax.sharding import Mesh

@@ -332,3 +332,41 @@ class TestPagePoolChaos:
         snap = srv.health_snapshot()
         assert snap["kv_pages_free"] == 15
         assert snap["spec_accept_ratio"] is None   # not a spec server
+
+
+class TestShardedPool:
+    """``kv_shard``: the page pool's PAGE axis spread across devices —
+    decode gathers each stream's pages to the compute device, so the
+    sharded scheduler is TOKEN-identical to serial generate (and hence
+    to ``kv_shard=1``), while health reports per-shard capacity
+    (docs/parallelism.md#sharded-kv-serving)."""
+
+    def test_sharded_decode_token_identical(self, ctx, tmp_path):
+        lm = _lm()
+        rs = np.random.RandomState(11)
+        prompts = [rs.randint(0, 16, (n,)).tolist() for n in (4, 1, 6, 3, 5)]
+        serial = [lm.generate(np.asarray([p]), max_new_tokens=8)[0].tolist()
+                  for p in prompts]
+        src = _src(tmp_path)
+        srv = GenerativeServing(_paged_cfg(src, kv_shard=4), lm)
+        inq, outq = InputQueue(src), OutputQueue(src)
+        for i, p in enumerate(prompts):
+            inq.enqueue_prompt(f"r{i}", p)
+        _drive(srv)
+        for i, want in enumerate(serial):
+            res = outq.query(f"r{i}", timeout_s=5)
+            assert res is not None and res.get("done") is True
+            assert res["value"] == want, f"sharded stream r{i} diverged"
+        snap = srv.health_snapshot()
+        assert snap["kv_shards"] == 4
+        assert snap["slots_occupied"] == 0
+        # every page back in the free list (page 0 stays reserved as the
+        # null page) -> shard 0 reports 3 free, the other shards 4
+        assert snap["kv_pages_free"] == 15
+        assert snap["kv_pages_free_min_shard"] == 3
+
+    def test_shard_must_divide_pool(self, ctx, tmp_path):
+        lm = _lm()
+        with pytest.raises(ValueError, match="kv shard"):
+            GenerativeServing(
+                _paged_cfg(_src(tmp_path), kv_pages=15, kv_shard=4), lm)

@@ -58,6 +58,7 @@ class TestBundleRoundTrip:
                 assert label in {"cat", "dog", "bird"}
                 assert 0.0 <= prob <= 1.0
 
+    @pytest.mark.slow  # re-tiered: heaviest e2e sweep (tier-1 870s budget)
     def test_bundle_predictions_bitmatch_source(self, ctx, tmp_path):
         clf = _tiny_classifier()
         x = np.random.RandomState(2).rand(4, 32, 32, 3).astype(np.float32)
@@ -77,6 +78,7 @@ class TestBundleRoundTrip:
         assert bundle["preprocessing"][0]["height"] == 32
         assert bundle["labels"] == ["cat", "dog", "bird"]
 
+    @pytest.mark.slow  # re-tiered: heaviest e2e sweep (tier-1 870s budget)
     def test_load_pretrained_rejects_bare_checkpoint(self, ctx, tmp_path):
         clf = _tiny_classifier()
         clf.save_model(str(tmp_path / "plain"))
@@ -94,6 +96,7 @@ class TestDetectionConfigRegistry:
         with pytest.raises(ValueError):
             detection_config("ssd-made-up")
 
+    @pytest.mark.slow  # re-tiered: heaviest e2e sweep (tier-1 870s budget)
     def test_from_detection_config_builds_and_bundles(self, ctx, tmp_path):
         det = ObjectDetector.from_detection_config(
             "ssd-mobilenet-300x300", class_num=4,
@@ -111,6 +114,7 @@ class TestDetectionConfigRegistry:
         boxes, scores, classes = loaded.detect(x, batch_size=1)
         assert boxes.shape[0] == 1 and boxes.shape[2] == 4
 
+    @pytest.mark.slow  # re-tiered: heaviest e2e sweep (tier-1 870s budget)
     def test_predict_image_set_uses_variant_postprocess(self, ctx):
         det = ObjectDetector.from_detection_config("ssd-vgg16-300x300",
                                                    class_num=3)

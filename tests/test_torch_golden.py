@@ -103,6 +103,7 @@ class TestGoldenResnet18Import:
         assert len(out) == 3 and all(len(r) == 3 for r in out)
         assert all(lbl in labels for r in out for lbl, _ in r)
 
+    @pytest.mark.slow  # re-tiered: heaviest e2e sweep (tier-1 870s budget)
     def test_pretrained_save_load_keeps_geometry(self, ctx, imported,
                                                  tmp_path):
         # the padding geometry must survive save_model/load_model — a
@@ -121,6 +122,7 @@ class TestGoldenResnet18Import:
         np.testing.assert_allclose(np.asarray(clf2.predict(x)), want,
                                    atol=1e-5)
 
+    @pytest.mark.slow  # re-tiered: heaviest e2e sweep (tier-1 870s budget)
     def test_golden_import_bundles_to_remote(self, ctx, imported, tmp_path):
         # the golden torch import, shipped as ONE pretrained bundle over a
         # fake-remote scheme, reloads with labels + torch padding geometry

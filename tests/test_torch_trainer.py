@@ -12,6 +12,7 @@ from tests import torch_creators as tc  # noqa: E402
 
 
 class TestTorchTrainer:
+    @pytest.mark.slow  # re-tiered: heaviest e2e sweep (tier-1 870s budget)
     def test_two_worker_convergence(self, tmp_path):
         trainer = TorchTrainer(tc.make_model, tc.make_optimizer, tc.make_loss,
                                tc.make_data, num_workers=2,
@@ -30,6 +31,7 @@ class TestTorchTrainer:
         pred = model(torch.tensor([[1.0, 1.0]])).detach().numpy()
         np.testing.assert_allclose(pred, [[2.0 - 3.0 + 0.5]], atol=0.3)
 
+    @pytest.mark.slow  # re-tiered: heaviest e2e sweep (tier-1 870s budget)
     def test_allreduce_matches_single_worker_fullbatch(self, tmp_path):
         """2 workers averaging grads over disjoint half-shards must equal 1
         worker seeing the concatenated data — the sync-SGD contract."""
