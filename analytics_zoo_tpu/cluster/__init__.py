@@ -3,4 +3,7 @@
 re-designed for TPU pods on ``jax.distributed``)."""
 from .launcher import (  # noqa: F401
     PodLauncher, PodLaunchError, WorkerResult, run_pod)
+from .supervisor import (  # noqa: F401
+    ElasticSupervisor, FleetSupervisor, PodSupervisorError,
+    SupervisorResult)
 from .torch_trainer import TorchTrainer  # noqa: F401

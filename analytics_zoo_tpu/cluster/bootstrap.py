@@ -16,6 +16,7 @@ import os
 import signal
 import sys
 import threading
+import time
 
 
 def _install_parent_guard() -> None:
@@ -55,15 +56,39 @@ def resolve_target(spec: str):
     return fn
 
 
+def read_coordinator(coord_file: str, timeout_s: float = 60.0) -> str:
+    """Coordinator-address handoff: poll ``coord_file`` (written
+    atomically by the elastic supervisor before each generation's spawn)
+    until it yields an address. A file — not a baked env var — because
+    every restarted generation needs a FRESH coordinator port while the
+    workers' env stays the launch-time one."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            with open(coord_file) as f:
+                coord = json.load(f).get("coord", "")
+            if coord:
+                return coord
+        except (OSError, ValueError):
+            pass  # not written yet / torn mid-replace: retry
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"no coordinator address in {coord_file!r} after "
+                f"{timeout_s}s")
+        time.sleep(0.05)
+
+
 def main() -> int:
     _install_parent_guard()
     proc_id = int(os.environ["ZOO_TPU_PROC_ID"])
     nprocs = int(os.environ["ZOO_TPU_NPROCS"])
-    coord = os.environ["ZOO_TPU_COORD"]
     target = os.environ["ZOO_TPU_TARGET"]
     args = json.loads(os.environ.get("ZOO_TPU_ARGS", "[]"))
     platform = os.environ.get("ZOO_TPU_PLATFORM", "")
     dev_per_proc = os.environ.get("ZOO_TPU_DEVICES_PER_PROC", "")
+    coord_file = os.environ.get("ZOO_TPU_COORD_FILE", "")
+    coord = (read_coordinator(coord_file) if coord_file
+             else os.environ["ZOO_TPU_COORD"])
 
     if dev_per_proc:
         # replace (not append) any inherited device-count flag — e.g. the
@@ -73,15 +98,47 @@ def main() -> int:
                  if not f.startswith("--xla_force_host_platform_device_count")]
         flags.append(f"--xla_force_host_platform_device_count={dev_per_proc}")
         os.environ["XLA_FLAGS"] = " ".join(flags)
+    lease_spec = os.environ.get("ZOO_TPU_LEASE_STORE", "")
+    if lease_spec:
+        # membership lease: start heartbeating BEFORE the distributed
+        # join so even a hang inside initialize() shows up as a frozen
+        # lease. (Must run after the XLA_FLAGS mutation above — the
+        # supervisor module's import chain pulls in jax.)
+        from .supervisor import LeaseHeartbeat, make_lease_store
+        hb_s = os.environ.get("ZOO_TPU_HEARTBEAT_S", "")
+        LeaseHeartbeat(
+            make_lease_store(lease_spec), rank=proc_id,
+            generation=int(os.environ.get("ZOO_TPU_GENERATION", "0")),
+            heartbeat_s=float(hb_s) if hb_s else None).start()
     import jax
     if platform:
         # a sitecustomize may have pinned the hardware platform; re-assert
         # before any backend initializes (same recipe as tests/conftest.py)
         jax.config.update("jax_platforms", platform)
+    if platform == "cpu":
+        # XLA:CPU executes multi-process programs only through a cross-
+        # process collectives layer; jaxlib ships gloo but defaults it off,
+        # which surfaces as "Multiprocess computations aren't implemented
+        # on the CPU backend" at the first sharded device_put
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # older jax: the only built-in impl is already active
     jax.distributed.initialize(coordinator_address=coord,
                                num_processes=nprocs, process_id=proc_id)
     fn = resolve_target(target)
-    result = fn(*args)
+    try:
+        result = fn(*args)
+    except Exception:
+        # Die NOW, not after interpreter teardown: the jax.distributed
+        # atexit shutdown barrier cannot complete while peers sit in the
+        # collective this rank just abandoned, and the launcher's failure
+        # detection only fires once this process is actually dead.
+        import traceback
+        traceback.print_exc()
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(1)
     if isinstance(result, int):
         return result
     return 0

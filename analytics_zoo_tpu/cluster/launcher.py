@@ -38,6 +38,11 @@ class WorkerResult:
     process_id: int
     returncode: int
     log_path: str
+    #: how many times this rank was launched (1 = no retry was needed)
+    attempts: int = 1
+    #: log tail captured from each FAILED attempt, oldest first (the
+    #: final attempt's log is still on disk at ``log_path``)
+    attempt_tails: List[str] = field(default_factory=list)
 
     def log_tail(self, n: int = 40) -> str:
         try:
@@ -65,6 +70,15 @@ class PodLauncher:
       env: extra environment for workers.
       log_dir: where per-worker stdout/stderr logs go (tempdir default).
       fail_fast: on the first nonzero worker exit, terminate the rest.
+      restarts: per-worker retry budget — a rank exiting nonzero is
+        relaunched (same rank/env, fresh log) up to this many times
+        before its failure is final; each failed attempt's log tail is
+        kept on ``WorkerResult.attempt_tails``. Note this retries ONE
+        rank into the existing coordination service — right for
+        single-process pods and pre-collective crashes; a rank that died
+        mid-collective needs the whole-generation restart
+        :class:`~analytics_zoo_tpu.cluster.supervisor.ElasticSupervisor`
+        provides.
     """
 
     num_processes: int
@@ -73,6 +87,7 @@ class PodLauncher:
     env: Dict[str, str] = field(default_factory=dict)
     log_dir: Optional[str] = None
     fail_fast: bool = True
+    restarts: int = 0
 
     def run(self, target: str, args: Sequence[Any] = (),
             timeout: Optional[float] = None) -> List[WorkerResult]:
@@ -104,19 +119,25 @@ class PodLauncher:
             base_env["ZOO_TPU_PLATFORM"] = self.platform
         if self.devices_per_process:
             base_env["ZOO_TPU_DEVICES_PER_PROC"] = str(self.devices_per_process)
+        def spawn(pid: int, attempt: int):
+            env = dict(base_env)
+            env["ZOO_TPU_PROC_ID"] = str(pid)
+            suffix = "" if attempt == 1 else f".attempt{attempt}"
+            log_path = os.path.join(log_dir, f"worker_{pid}{suffix}.log")
+            with open(log_path, "w") as logf:  # child keeps its dup'd fd
+                proc = subprocess.Popen(
+                    [sys.executable, "-m",
+                     "analytics_zoo_tpu.cluster.bootstrap"],
+                    env=env, stdout=logf, stderr=subprocess.STDOUT,
+                    cwd=os.getcwd())
+            return proc, log_path
+
         try:
             for pid in range(self.num_processes):
-                env = dict(base_env)
-                env["ZOO_TPU_PROC_ID"] = str(pid)
-                log_path = os.path.join(log_dir, f"worker_{pid}.log")
+                proc, log_path = spawn(pid, 1)
+                procs.append(proc)
                 logs.append(log_path)
-                with open(log_path, "w") as logf:  # child keeps its dup'd fd
-                    procs.append(subprocess.Popen(
-                        [sys.executable, "-m",
-                         "analytics_zoo_tpu.cluster.bootstrap"],
-                        env=env, stdout=logf, stderr=subprocess.STDOUT,
-                        cwd=os.getcwd()))
-            return self._wait(procs, logs, timeout)
+            return self._wait(procs, logs, timeout, spawn)
         finally:
             for p in procs:
                 if p.poll() is None:
@@ -129,10 +150,24 @@ class PodLauncher:
                     except subprocess.TimeoutExpired:
                         p.kill()
 
-    def _wait(self, procs, logs, timeout) -> List[WorkerResult]:
+    def _wait(self, procs, logs, timeout, spawn=None) -> List[WorkerResult]:
         deadline = time.monotonic() + timeout if timeout else None
+        n = len(procs)
+        attempts = [1] * n
+        tails: List[List[str]] = [[] for _ in range(n)]
         while True:
             rcs = [p.poll() for p in procs]
+            if spawn is not None and self.restarts > 0:
+                # per-worker retry: a failed rank with budget left is
+                # relaunched in place (tail captured per attempt) before
+                # fail-fast gets to judge it
+                for i, rc in enumerate(rcs):
+                    if rc not in (None, 0) and attempts[i] <= self.restarts:
+                        tails[i].append(WorkerResult(i, rc,
+                                                     logs[i]).log_tail())
+                        attempts[i] += 1
+                        procs[i], logs[i] = spawn(i, attempts[i])
+                        rcs[i] = None
             if all(rc is not None for rc in rcs):
                 break
             if self.fail_fast and any(rc not in (None, 0) for rc in rcs):
@@ -154,11 +189,11 @@ class PodLauncher:
                 for p in procs:
                     if p.poll() is None:
                         p.terminate()
-                results = self._results(procs, logs)
+                results = self._results(procs, logs, attempts, tails)
                 raise PodLaunchError(
                     f"pod timed out after {timeout}s", results)
             time.sleep(0.2)
-        results = self._results(procs, logs)
+        results = self._results(procs, logs, attempts, tails)
         # -SIGTERM exits are workers WE killed in fail-fast — report them as
         # terminated, not as the failure's cause
         failed = [r for r in results
@@ -176,9 +211,12 @@ class PodLauncher:
                 f"{tails}", results)
         return results
 
-    def _results(self, procs, logs) -> List[WorkerResult]:
+    def _results(self, procs, logs, attempts=None,
+                 tails=None) -> List[WorkerResult]:
         return [WorkerResult(i, p.poll() if p.poll() is not None else -1,
-                             logs[i])
+                             logs[i],
+                             attempts=attempts[i] if attempts else 1,
+                             attempt_tails=list(tails[i]) if tails else [])
                 for i, p in enumerate(procs)]
 
 

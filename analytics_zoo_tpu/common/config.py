@@ -285,6 +285,31 @@ _global_config.register("fleet.scale_headroom", 1.25,
                         "Multiplier on observed demand when computing the "
                         "fleet.desired_instances scale signal (>1 keeps "
                         "spare capacity for failover).")
+_global_config.register("fleet.scale_interval_s", 0.25,
+                        "Fleet supervisor actuation cadence: how often "
+                        "the desired-instance signal is compared against "
+                        "the live fleet and a spawn/drain is issued "
+                        "(rate-limits scale thrash).")
+_global_config.register("cluster.heartbeat_s", 0.5,
+                        "Worker lease heartbeat cadence: every pod worker "
+                        "bumps its lease seq this often so the elastic "
+                        "supervisor can tell a live rank from a dead or "
+                        "hung one.")
+_global_config.register("cluster.lease_expiry_s", 0.0,
+                        "Monotonic lease age (seconds since the supervisor "
+                        "last SAW a worker's lease seq change) beyond "
+                        "which the rank is declared dead and the elastic "
+                        "restart path fires. 0 = 6 x cluster.heartbeat_s.")
+_global_config.register("cluster.respawns", 3,
+                        "Elastic restart budget: how many pod-generation "
+                        "respawns the supervisor performs before giving "
+                        "up and surfacing the failure (the reference's "
+                        "failure.retryTimes, at cluster scope).")
+_global_config.register("cluster.restart_backoff_s", 0.5,
+                        "Base backoff between a detected worker death and "
+                        "the respawned generation (grows linearly with "
+                        "consecutive restarts so a crash-looping pod "
+                        "does not spin).")
 _global_config.register("ingest.buffer_records", 4096,
                         "Bounded-buffer capacity of the streaming ingest "
                         "tier (journaled-but-unconsumed plus claimed-but-"

@@ -142,6 +142,19 @@ REGISTRY: Dict[str, Site] = {
         "fleet router placement, once per routed request — a failed "
         "placement pass must park the request in the router backlog and "
         "retry it next pass (never lost, never double-enqueued)"),
+    "cluster.heartbeat": Site(
+        "worker lease heartbeat thread, once per beat — a firing makes "
+        "the worker STOP heartbeating (a hung host: process alive, lease "
+        "frozen); the supervisor's monotonic lease-age detector must "
+        "declare it dead and restart the pod generation", kind="flag"),
+    "cluster.worker_restart": Site(
+        "elastic supervisor, before respawning a pod generation — models "
+        "a respawn that itself fails (scheduler refusal, image pull); "
+        "the supervisor must back off and retry within its budget"),
+    "fleet.scale_actuate": Site(
+        "fleet supervisor actuation step, once per spawn/drain decision "
+        "— a failed actuation must leave the fleet consistent and be "
+        "retried on the next cadence tick, never half-spawn"),
     "online.promote": Site(
         "trainer→server promotion, once per instance before its reload "
         "(canary is the 1st) — a rollout that dies at any instance must "

@@ -121,17 +121,29 @@ PREEMPT_MARKER = "PREEMPTED.json"
 #: per-snapshot checksum manifest filename (inside each snapshot dir)
 _MANIFEST_NAME = "zoo_manifest.json"
 
+#: per-rank seal stamp: ``zoo_rank-<i>.ok``, written by EVERY process of a
+#: multi-process pod after the collective orbax save returns. Excluded
+#: from the checksum manifest (ranks write them concurrently with rank
+#: 0's manifest), but verification requires all of them: a rank killed
+#: between save and seal leaves a snapshot no survivor may resume from.
+_RANK_STAMP_FMT = "zoo_rank-{}.ok"
+
+
+def _is_rank_stamp(name: str) -> bool:
+    return (name.startswith("zoo_rank-") and name.endswith(".ok")
+            and name[len("zoo_rank-"):-len(".ok")].isdigit())
+
 
 def _dir_checksums(local_dir: str) -> Dict[str, List[int]]:
     """``{relpath: [size, crc32]}`` for every file under ``local_dir``
-    except the manifest itself. crc32 (not a cryptographic hash) on
-    purpose: the threat model is torn writes and bit-rot, not an
-    adversary, and restore-time verification must stay cheap next to the
-    orbax read it guards."""
+    except the manifest itself and the per-rank seal stamps. crc32 (not a
+    cryptographic hash) on purpose: the threat model is torn writes and
+    bit-rot, not an adversary, and restore-time verification must stay
+    cheap next to the orbax read it guards."""
     entries: Dict[str, List[int]] = {}
     for root, _dirs, files in os.walk(local_dir):
         for name in sorted(files):
-            if name == _MANIFEST_NAME:
+            if name == _MANIFEST_NAME or _is_rank_stamp(name):
                 continue
             p = os.path.join(root, name)
             rel = os.path.relpath(p, local_dir).replace(os.sep, "/")
@@ -144,16 +156,23 @@ def _dir_checksums(local_dir: str) -> Dict[str, List[int]]:
     return entries
 
 
-def _write_manifest(local_dir: str) -> None:
+def _write_manifest(local_dir: str, ranks: Optional[int] = None) -> None:
+    manifest: Dict[str, Any] = {"version": 1,
+                                "files": _dir_checksums(local_dir)}
+    if ranks:
+        # seal which ranks must have stamped this snapshot: restore
+        # refuses it until every one of zoo_rank-0..N-1.ok exists
+        manifest["ranks"] = int(ranks)
     with open(os.path.join(local_dir, _MANIFEST_NAME), "w") as f:
-        json.dump({"version": 1, "files": _dir_checksums(local_dir)}, f)
+        json.dump(manifest, f)
 
 
 def _verify_manifest(local_dir: str, origin: str) -> bool:
     """Verify ``local_dir`` against its checksum manifest. Returns False
     for pre-manifest snapshots (nothing to verify — legacy tolerance);
     raises :class:`CheckpointCorruptError` on any size/checksum mismatch,
-    missing file, or unexpected extra file."""
+    missing file, unexpected extra file, or (for pod snapshots) a missing
+    per-rank seal stamp."""
     mpath = os.path.join(local_dir, _MANIFEST_NAME)
     if not os.path.exists(mpath):
         return False
@@ -172,6 +191,16 @@ def _verify_manifest(local_dir: str, origin: str) -> bool:
             f"checkpoint at {origin} failed checksum verification — torn "
             f"or corrupt snapshot (missing={missing[:4]}, "
             f"corrupt={corrupt[:4]}, unexpected={extra[:4]})")
+    ranks = int(manifest.get("ranks") or 0)
+    if ranks:
+        unsealed = [i for i in range(ranks) if not os.path.exists(
+            os.path.join(local_dir, _RANK_STAMP_FMT.format(i)))]
+        if unsealed:
+            raise CheckpointCorruptError(
+                f"checkpoint at {origin} was written by a {ranks}-process "
+                f"pod but ranks {unsealed[:8]} never sealed it (killed "
+                f"between the collective save and the stamp) — refusing "
+                f"the partial snapshot")
     return True
 
 
@@ -1696,8 +1725,18 @@ class Estimator:
                 # and orbax coordinates the write + its own commit
                 # atomicity; a per-process stage+rename would race ranks
                 ckptr.save(final, tree, force=True)
-                if jax.process_index() == 0:  # one writer for the manifest
-                    _write_manifest(final)
+                # the save is globally complete once it returns (orbax
+                # barriers) — each rank now seals its participation; a
+                # rank killed in this window leaves a snapshot that
+                # FAILS verification, so elastic resume falls back to
+                # the previous fully-sealed one instead of trusting it
+                rank = jax.process_index()
+                stamp = os.path.join(final, _RANK_STAMP_FMT.format(rank))
+                with open(stamp, "w") as f:
+                    json.dump({"rank": rank,
+                               "global_step": self.global_step}, f)
+                if rank == 0:  # one writer for the manifest
+                    _write_manifest(final, ranks=self.ctx.process_count)
                 _M_CKPT_WRITE.observe(time.perf_counter() - write_t0)
                 return
             staging = final + ".writing"

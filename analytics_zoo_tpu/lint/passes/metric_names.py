@@ -49,7 +49,8 @@ _GAUGE_UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio", "_depth")
 #: info-style constant-1 build gauge (labels carry the payload)
 _GAUGE_UNITLESS_OK = {"serving.in_flight", "serving.slots_occupied",
                       "serving.kv_pages_free", "build.info",
-                      "fleet.instances_alive", "fleet.desired_instances"}
+                      "fleet.instances_alive", "fleet.desired_instances",
+                      "cluster.leases_alive"}
 
 
 def _is_registration(node: ast.Call) -> bool:

@@ -19,6 +19,16 @@ is routine. The rules:
 The pass flags every ``time.time`` / ``time.time_ns`` call in the package
 (resolved through import aliases; tests and ``bench.py`` are out of
 scope — benches already use ``perf_counter``).
+
+It additionally flags **mixed-clock arithmetic**: any one expression
+(``-``/``+``/comparison) combining a monotonic-domain read
+(``time.monotonic`` / ``perf_counter``) with a wall-domain read
+(``wall_clock()`` or a bare ``time.time``). This is exactly the
+lease/heartbeat bug class the elastic supervisor must avoid: subtracting
+a worker's wall-clock lease stamp from the supervisor's monotonic clock
+produces a number that means nothing, yet "works" until the first NTP
+step — the supervisor instead stamps its OWN monotonic clock when it
+*observes* a lease seq change (``cluster/supervisor.py`` LeaseTracker).
 """
 from __future__ import annotations
 
@@ -31,6 +41,17 @@ from ..core import (Finding, LintPass, Project, REPO_ROOT, get_project,
                     register_pass)
 
 _WALL = {"time.time", "time.time_ns"}
+#: monotonic-domain reads for the mixed-arithmetic check
+_MONO = {"time.monotonic", "time.monotonic_ns", "time.perf_counter",
+         "time.perf_counter_ns"}
+
+
+def _wall_domain(dotted: str) -> bool:
+    """Wall-domain reads: bare time.time AND the audited wall_clock()
+    (legit on its own for cross-process stamps, but never in the same
+    arithmetic expression as a monotonic read)."""
+    return (dotted in _WALL or dotted == "wall_clock"
+            or dotted.endswith(".wall_clock"))
 
 
 def _import_map(tree: ast.Module) -> Dict[str, str]:
@@ -58,13 +79,42 @@ def _dotted(expr, imports: Dict[str, str]) -> str:
                     + list(reversed(parts)))
 
 
+def _clock_domains(node: ast.AST, imports: Dict[str, str]):
+    """Which clock domains the expression under ``node`` reads from."""
+    mono = wall = False
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        d = _dotted(sub.func, imports)
+        if d in _MONO:
+            mono = True
+        elif _wall_domain(d):
+            wall = True
+    return mono, wall
+
+
 def findings(project=None) -> List[Finding]:
     project = project or get_project()
     out: List[Finding] = []
     for path in project.package_files():
         tree = project.ast_for(path)
         imports = _import_map(tree)
+        mixed_lines = set()
         for node in ast.walk(tree):
+            if isinstance(node, (ast.BinOp, ast.Compare)):
+                mono, wall = _clock_domains(node, imports)
+                if mono and wall and node.lineno not in mixed_lines:
+                    mixed_lines.add(node.lineno)
+                    out.append(Finding(
+                        path, node.lineno, MonotonicClockPass.id,
+                        "expression mixes monotonic- and wall-clock "
+                        "reads — the difference of two different clocks "
+                        "is meaningless (lease/heartbeat math must stay "
+                        "in ONE domain)",
+                        "compare like with like: stamp your own "
+                        "monotonic clock when you OBSERVE a cross-"
+                        "process value change, as LeaseTracker does"))
+                continue
             if not isinstance(node, ast.Call):
                 continue
             d = _dotted(node.func, imports)

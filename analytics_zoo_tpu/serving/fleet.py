@@ -204,6 +204,7 @@ class FleetRouter:
         self._backlog: List[Tuple[str, Dict[str, Any]]] = []
         self._g: Optional[Dict[str, np.ndarray]] = None
         self._last_refresh = -1e18
+        self._desired = 0
         self._settle_cursor = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -423,8 +424,30 @@ class FleetRouter:
             demand += int(g["depth"].sum() + g["in_flight"].sum())
         per = max(1.0, float(np.mean([i.slots for i in self.instances]))
                   if self.instances else 1.0)
-        _M_DESIRED.set(int(math.ceil(self.scale_headroom * demand / per))
-                       if demand else 0)
+        self._desired = (int(math.ceil(self.scale_headroom * demand / per))
+                         if demand else 0)
+        _M_DESIRED.set(self._desired)
+
+    def desired_instances(self) -> int:
+        """Latest demand-derived target fleet size (the value behind the
+        ``fleet.desired_instances`` gauge) — what an actuator
+        (:class:`~analytics_zoo_tpu.cluster.supervisor.FleetSupervisor`)
+        reconciles the live fleet against."""
+        return self._desired
+
+    def register_instance(self, inst: FleetInstance) -> None:
+        """Add a freshly spawned instance to the routable set and force a
+        health re-read on the next pass (the actuator's scale-out hook)."""
+        self.instances.append(inst)
+        self._last_refresh = -1e18
+
+    def remove_instance(self, name: str) -> None:
+        """Forget a drained/dead instance after its spool was reclaimed.
+        The actuator calls this once the server subprocess has exited; any
+        work still assigned to the name fails over on the next refresh."""
+        self.instances = [i for i in self.instances if i.name != name]
+        self._g = None
+        self._last_refresh = -1e18
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -422,9 +422,16 @@ class RedisQueue(QueueBackend):
     CLAIM_LEASE_S = 60.0
 
     def __init__(self, host: str = "localhost", port: int = 6379,
-                 claim_lease_s: Optional[float] = None):
-        import redis  # gated dependency
-        self.db = redis.StrictRedis(host=host, port=port, db=0)
+                 claim_lease_s: Optional[float] = None, client=None,
+                 stream: Optional[str] = None, group: Optional[str] = None):
+        if client is None:
+            import redis  # gated dependency
+            client = redis.StrictRedis(host=host, port=port, db=0)
+        self.db = client
+        if stream:
+            self.STREAM = stream  # instance shadow of the class default —
+        if group:                 # lets benches/tests run isolated streams
+            self.GROUP = group    # on one shared server
         # unique consumer identity per server instance: XREADGROUP '>'
         # delivers each entry to exactly one consumer in the group, which
         # is what makes N serving servers on one stream exactly-once

@@ -1843,6 +1843,199 @@ def bench_serving_fleet(requests: int = 1200, batch_size: int = 4,
                         "token-identical prefix continuation"})
 
 
+class _FakeStreamRedis:
+    """Minimal in-process stand-in for the redis stream surface RedisQueue
+    drives (XADD / XREADGROUP '>' / XACK / result hashes): the outage-round
+    CPU probe runs the SAME consumer-group claim/ack machinery when no
+    server is reachable. XAUTOCLAIM/XINFO are deliberately absent —
+    RedisQueue degrades past them the same way it does on an old server."""
+
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self._streams = {}  # stream -> [(entry id, encoded fields)]
+        self._cursor = {}   # (stream, group) -> next undelivered index
+        self._hashes = {}
+        self._seq = 0
+
+    def xgroup_create(self, stream, group, mkstream=False):
+        with self._lock:
+            self._streams.setdefault(stream, [])
+            self._cursor.setdefault((stream, group), 0)
+
+    def xadd(self, stream, fields):
+        with self._lock:
+            self._seq += 1
+            eid = f"{self._seq}-0".encode()
+            enc = {(k if isinstance(k, bytes) else str(k).encode()):
+                   (v if isinstance(v, bytes) else str(v).encode())
+                   for k, v in fields.items()}
+            self._streams.setdefault(stream, []).append((eid, enc))
+            return eid
+
+    def xreadgroup(self, group, consumer, streams, count=None, block=None):
+        out = []
+        with self._lock:
+            for stream in streams:
+                entries = self._streams.get(stream, [])
+                cur = self._cursor.setdefault((stream, group), 0)
+                take = entries[cur:cur + (count or len(entries))]
+                if take:
+                    self._cursor[(stream, group)] = cur + len(take)
+                    out.append((stream.encode(), list(take)))
+        return out
+
+    def xack(self, stream, group, *ids):
+        return len(ids)
+
+    def xlen(self, stream):
+        with self._lock:
+            return len(self._streams.get(stream, []))
+
+    def hset(self, key, mapping):
+        with self._lock:
+            h = self._hashes.setdefault(key, {})
+            for k, v in mapping.items():
+                h[k if isinstance(k, bytes) else str(k).encode()] = (
+                    v if isinstance(v, bytes) else str(v).encode())
+
+    def hgetall(self, key):
+        with self._lock:
+            return dict(self._hashes.get(key, {}))
+
+    def pipeline(self):
+        outer = self
+
+        class _Pipe:
+            def __init__(self):
+                self.ops = []
+
+            def xadd(self, stream, fields):
+                self.ops.append((stream, fields))
+
+            def execute(self):
+                for stream, fields in self.ops:
+                    outer.xadd(stream, fields)
+                self.ops = []
+
+        return _Pipe()
+
+
+def _fleet_redis_client(require: bool):
+    """A reachable server (``ZOO_BENCH_REDIS=host:port``, default
+    localhost:6379) or — when ``require`` is off — the in-process stream
+    fake, so outage rounds still exercise the consumer-group machinery."""
+    spec = os.environ.get("ZOO_BENCH_REDIS") or "localhost:6379"
+    host, _, port = spec.partition(":")
+    try:
+        import redis
+        cli = redis.StrictRedis(host=host, port=int(port or 6379), db=0,
+                                socket_connect_timeout=1.0,
+                                socket_timeout=5.0)
+        cli.ping()
+        return cli, f"redis://{host}:{int(port or 6379)}"
+    except Exception as e:
+        if require:
+            raise RuntimeError(
+                f"serving_fleet_redis needs a reachable redis server "
+                f"(ZOO_BENCH_REDIS=host:port): {e}; outage rounds land "
+                f"via --ratio against the in-process stream fake") from e
+        return _FakeStreamRedis(), f"in-process fake ({e.__class__.__name__})"
+
+
+def _consumer_group_ab(client, n: int, stall_s: float, batch_size: int,
+                       k: int, die_after_claim: bool = False,
+                       claim_lease_s=None):
+    """Drive n requests through ONE shared stream with a consumer group of
+    k RedisQueue consumers (XREADGROUP '>' = exactly-one-consumer
+    delivery; XACK only after the result hash lands). ``die_after_claim``
+    kills consumer 0 right after its first claim, before it acks — the
+    abandoned batch must come back via XAUTOCLAIM redelivery onto a
+    survivor. Returns (wall seconds, per-consumer claim counts)."""
+    import threading
+    import uuid
+
+    from analytics_zoo_tpu.serving.queues import RedisQueue
+
+    stream = f"bench:fleet:{uuid.uuid4().hex[:8]}"
+    front = RedisQueue(client=client, stream=stream, group="bench",
+                       claim_lease_s=claim_lease_s)
+    front.enqueue_many([(f"u{i}", {"value": [0.0]}) for i in range(n)])
+    claims = [0] * k
+    stop = threading.Event()
+
+    def worker(idx: int):
+        q = RedisQueue(client=client, stream=stream, group="bench",
+                       claim_lease_s=claim_lease_s)
+        while not stop.is_set():
+            got = q.claim_batch(batch_size)
+            if not got:
+                time.sleep(0.001)
+                continue
+            claims[idx] += len(got)
+            if die_after_claim and idx == 0:
+                return  # claimed, never acked: the group's PEL holds it
+            time.sleep(stall_s)  # one model batch per claim
+            for uri, _rec in got:
+                q.put_result(uri, {"value": [1.0]})
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(k)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    done, deadline = 0, time.time() + 180
+    while done < n and time.time() < deadline:
+        done = sum(1 for i in range(n)
+                   if front.get_result(f"u{i}") is not None)
+        time.sleep(0.02)
+    wall = time.perf_counter() - t0
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    if done < n:
+        raise RuntimeError(
+            f"consumer group dropped requests: {done}/{n} answered "
+            f"(k={k}, die_after_claim={die_after_claim})")
+    return wall, claims
+
+
+def bench_serving_fleet_redis(requests: int = 900, batch_size: int = 4,
+                              stall_s: float = 0.08):
+    """serving_fleet's cross-host leg over the reference wire contract
+    (XADD to one shared stream, consumer-group reads, HSET results): 3
+    consumers vs 1 at the same offered load, with a mid-run consumer
+    death that abandons a claimed-but-unacked batch — the entries sit in
+    the group's PEL until XAUTOCLAIM redelivers them to a survivor, so
+    the run still ends exactly-one-terminal (result writes are
+    idempotent). Needs a reachable server (``ZOO_BENCH_REDIS``); outage
+    rounds land a record via the --ratio probe, which runs the same
+    machinery against the in-process stream fake."""
+    client, backend = _fleet_redis_client(require=True)
+    n = requests
+    t1, _ = _consumer_group_ab(client, n, stall_s, batch_size, 1)
+    single_rps = n / t1
+    _note_partial(metric="serving_fleet_redis_speedup",
+                  single_consumer_records_per_sec=round(single_rps, 1))
+    t3, claims = _consumer_group_ab(client, n, stall_s, batch_size, 3,
+                                    die_after_claim=True, claim_lease_s=1.0)
+    speedup = t1 / max(t3, 1e-9)
+    redelivered = sum(claims) - n  # the dead consumer's abandoned claims
+    return _BenchResult(
+        metric="serving_fleet_redis_speedup", value=round(speedup, 2),
+        unit="x", mfu=None,
+        detail={"backend": backend, "requests": n,
+                "batch_size": batch_size, "stall_s": stall_s,
+                "single_consumer_records_per_sec": round(single_rps, 1),
+                "group3_records_per_sec": round(n / t3, 1),
+                "per_consumer_claims": claims,
+                "redelivered_after_consumer_death": redelivered,
+                "note": "consumer 0 dies after its first claim without "
+                        "acking; XAUTOCLAIM hands the abandoned entries "
+                        "to a survivor past the 1s lease — every request "
+                        "still got exactly one terminal result"})
+
+
 def _kv_pool_hbm_gb(lm, num_pages: int, page_len: int,
                     int8: bool = False) -> float:
     """Paged KV pool HBM footprint across all blocks, in GB (int8 pools
@@ -2875,6 +3068,7 @@ _WORKLOADS = {
     "serving": bench_serving,
     "serving_slo": bench_serving_slo,
     "serving_fleet": bench_serving_fleet,
+    "serving_fleet_redis": bench_serving_fleet_redis,
     "generate": bench_generate,
     "obs_overhead": bench_obs_overhead,
     "quantized": bench_quantized,
@@ -3685,6 +3879,23 @@ def _ratio_fleet():
             "routed3_vs_single_ratio": round(t1 / max(t3, 1e-9), 2)}
 
 
+def _ratio_fleet_redis():
+    """Consumer-group fan-out vs a single consumer on ONE shared stream —
+    the serving_fleet_redis workload's A/B shrunk to CPU scale. Uses a
+    real server when one is reachable; otherwise the SAME RedisQueue
+    claim/ack machinery runs against the in-process stream fake, so an
+    outage round still lands a record."""
+    client, backend = _fleet_redis_client(require=False)
+    n, stall_s, batch = 96, 0.004, 8
+    t1, _ = _consumer_group_ab(client, n, stall_s, batch, 1)
+    t3, claims = _consumer_group_ab(client, n, stall_s, batch, 3)
+    return {"backend": backend,
+            "single_consumer_records_per_sec": round(n / t1, 1),
+            "group3_records_per_sec": round(n / t3, 1),
+            "per_consumer_claims": claims,
+            "group3_vs_single_ratio": round(t1 / max(t3, 1e-9), 2)}
+
+
 def _ratio_online():
     """Online row-subset continual training vs full-batch retrain at
     equal clicks — the online_learning workload's win shrunk to CPU
@@ -3972,6 +4183,7 @@ _RATIO_IMPLS = {
     "generate": _ratio_generate,
     "etl": _ratio_etl,
     "fleet": _ratio_fleet,
+    "fleet_redis": _ratio_fleet_redis,
     "online": _ratio_online,
     "tp": _ratio_tp,
     "moe": _ratio_moe,
@@ -3993,6 +4205,7 @@ _RATIO_PLAN = {
     "serving": ("serving", "batch16_vs_batch1_serving_ratio"),
     "serving_slo": ("serving", "batch16_vs_batch1_serving_ratio"),
     "serving_fleet": ("fleet", "routed3_vs_single_ratio"),
+    "serving_fleet_redis": ("fleet_redis", "group3_vs_single_ratio"),
     "obs_overhead": ("obs", "enabled_vs_disabled_record_ratio"),
     "recovery": ("recovery", "restore_vs_step_ratio"),
     "generate": ("generate", "batched_vs_serial_tokens_ratio"),

@@ -17,7 +17,57 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import inspect  # noqa: E402
+
 import pytest  # noqa: E402
+
+#: wall-seconds of the tier-1 870s budget (ROADMAP verify command) that
+#: non-slow multi-process tests may collectively declare — the rest
+#: belongs to the single-process suite. Breaching this fails COLLECTION,
+#: so a new pod test that would blow the CI budget is caught before it
+#: runs, not after CI times out.
+_POD_BUDGET_CAP_S = 420.0
+
+#: names whose presence in a test's source means it spawns worker
+#: subprocesses and must carry @pytest.mark.pod(budget_s=...)
+_POD_SPAWNERS = ("PodLauncher", "run_pod(", "ElasticSupervisor",
+                 "FleetSupervisor")
+
+
+def pytest_collection_modifyitems(config, items):
+    total, unbudgeted, unmarked = 0.0, [], []
+    for item in items:
+        mark = item.get_closest_marker("pod")
+        if mark is None:
+            fn = getattr(item, "function", None)
+            try:
+                src = inspect.getsource(fn) if fn else ""
+            except (OSError, TypeError):
+                src = ""
+            if any(s in src for s in _POD_SPAWNERS):
+                unmarked.append(item.nodeid)
+            continue
+        if item.get_closest_marker("slow") is not None:
+            continue  # tier-2: outside the 870s budget
+        budget = float(mark.kwargs.get("budget_s", 0.0))
+        if budget <= 0:
+            unbudgeted.append(item.nodeid)
+        total += budget
+    problems = []
+    if unmarked:
+        problems.append(
+            f"multi-process tests must declare a wall budget with "
+            f"@pytest.mark.pod(budget_s=...): {unmarked}")
+    if unbudgeted:
+        problems.append(
+            f"pod marker without a positive budget_s: {unbudgeted}")
+    if total > _POD_BUDGET_CAP_S:
+        problems.append(
+            f"non-slow pod tests declare {total:.0f}s of wall budget, "
+            f"over the {_POD_BUDGET_CAP_S:.0f}s cap — mark the heaviest "
+            f"soaks slow or shrink them")
+    if problems:
+        raise pytest.UsageError("; ".join(problems))
 
 
 @pytest.fixture()

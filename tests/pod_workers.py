@@ -57,6 +57,177 @@ def train_worker(workdir: str) -> int:
     return 0
 
 
+def sleep_worker(_workdir: str) -> int:
+    """Parks forever — the parent-death guard test's victim (it must be
+    reaped by the ppid watch, never by finishing)."""
+    import time
+    time.sleep(600)
+    return 0
+
+
+def flaky_worker(workdir: str) -> int:
+    """Dies on its first attempt, succeeds on the relaunch — the
+    ``PodLauncher(restarts=...)`` per-worker retry path."""
+    marker = os.path.join(workdir, "flaky_first_attempt")
+    if not os.path.exists(marker):
+        with open(marker, "w") as f:
+            f.write("1")
+        raise RuntimeError("flaky worker: first attempt dies")
+    return 0
+
+
+def always_failing_worker(_workdir: str) -> int:
+    """Fails on every attempt — exhausts the per-worker retry budget."""
+    raise RuntimeError("always failing worker")
+
+
+def elastic_train_worker(workdir: str, total_epochs: int = 4,
+                         chaos: str = "") -> int:
+    """The elastic-supervisor capstone target: a 4-process data-parallel
+    fit that resumes from the newest sealed snapshot on every generation.
+    ``chaos`` selects per-generation failures (conditioned on
+    ``ZOO_TPU_GENERATION``, which the supervisor bumps per respawn):
+
+    - ``kill``  (generation 0): train to mid-epoch-2, wait for the
+      epoch-1 snapshot to seal, then rank 2 SIGKILLs itself — the
+      survivors park so the supervisor's restart barrier must reap them.
+    - ``hang``  (generation 1): rank 1 freezes its lease via the
+      ``cluster.heartbeat`` chaos site while every rank sleeps — a hung
+      host with a live pid, detectable only by monotonic lease age.
+
+    The generation that runs fault-free trains to ``total_epochs`` and
+    dumps its final params; the test asserts them bit-identical to a
+    fault-free run's."""
+    import signal as _signal
+    import time as _time
+
+    from analytics_zoo_tpu.common import faults
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.common.triggers import MaxIteration, Never
+    from analytics_zoo_tpu.estimator import Estimator
+    from analytics_zoo_tpu.feature import FeatureSet
+    from analytics_zoo_tpu.keras import Sequential, objectives, optimizers
+    from analytics_zoo_tpu.keras.layers import Activation, Dense
+
+    ctx = init_tpu_context()
+    rank = ctx.process_index
+    generation = int(os.environ.get("ZOO_TPU_GENERATION", "0"))
+
+    if "hang" in chaos and generation == 1:
+        if rank == 1:
+            faults.arm("cluster.heartbeat", at=1)  # next beat freezes
+        _time.sleep(30.0)  # parked far past lease expiry; the supervisor
+        return 1           # kills the whole generation before this runs
+
+    n = 64
+    feats = np.arange(n, dtype=np.float32).reshape(n, 1).repeat(4, axis=1) / n
+    labels = (np.arange(n) % 2).astype(np.float32)
+    fs = FeatureSet.from_ndarrays(feats, labels, shuffle=False)
+
+    model = Sequential([Dense(8, name="d1"), Activation("relu"),
+                        Dense(2, name="d2")])
+    est = Estimator(model=model,
+                    loss_fn=objectives.get("sparse_categorical_crossentropy"),
+                    optimizer=optimizers.SGD(0.05))
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    # synchronous epoch-boundary snapshots (trigger=Never, save_checkpoint
+    # at the barrier): gloo runs ONE collective at a time, so the async
+    # writer's orbax barriers must not interleave with training all-reduces
+    est.set_checkpoint(ckpt_dir, trigger=Never())
+    if est._snapshot_candidates():
+        restored = est._restore_latest_valid()
+        assert restored is not None, "no snapshot survived seal checks"
+
+    def snap():
+        est.save_checkpoint(
+            os.path.join(ckpt_dir, f"snapshot-{est.global_step}"))
+
+    iters_per_epoch = 4  # n=64 / global batch 16
+
+    if "kill" in chaos and generation == 0:
+        # epoch 1 + its sealed snapshot, then die mid-epoch-2: the two
+        # post-snapshot iterations must be rolled back by the restart
+        est.train(fs, batch_size=16, end_trigger=MaxIteration(4))
+        snap()
+        est.train(fs, batch_size=16, end_trigger=MaxIteration(6))
+        if rank == 2:
+            os.kill(os.getpid(), _signal.SIGKILL)
+        _time.sleep(30.0)  # survivors park; the restart barrier reaps us
+        return 1
+
+    for target in range(iters_per_epoch, total_epochs * iters_per_epoch + 1,
+                        iters_per_epoch):
+        if est.global_step < target:
+            est.train(fs, batch_size=16, end_trigger=MaxIteration(target))
+            snap()
+    flat = {}
+    for lname, params in est.get_params().items():
+        for key, val in params.items():
+            flat[f"{lname}.{key}"] = np.asarray(val)
+    np.savez(os.path.join(workdir, f"params_rank{rank}.npz"), **flat)
+    return 0
+
+
+def fleet_predict_factory(root: str, name: str):
+    """Fleet-instance factory for FleetSupervisor tests: a one-shot
+    ClusterServing on its private spool whose host stall dominates the
+    batch, so router demand (and therefore scale-out) is measurable on
+    any machine — the bench's ``_fleet_server_proc`` trick."""
+    import time as _time
+
+    from analytics_zoo_tpu.inference import InferenceModel
+    from analytics_zoo_tpu.serving import ClusterServing, ServingConfig
+    from analytics_zoo_tpu.serving.fleet import instance_queue
+
+    def fwd(p, x):
+        return x.reshape(x.shape[0], -1).mean(1, keepdims=True)
+
+    im = InferenceModel().load_jax(fwd, {})
+
+    class StallModel:
+        def predict(self, x):
+            _time.sleep(0.25)
+            return im.predict(x)
+
+        def predict_async(self, x):
+            f = im.predict_async(x)
+
+            def fetch():
+                _time.sleep(0.25)
+                return f()
+            return fetch
+
+    cfg = ServingConfig(data_src=f"dir://{root}/inst/{name}",
+                        batch_size=4, batch_wait_ms=5,
+                        input_dtype="float32",
+                        health_path=os.path.join(root,
+                                                 f"{name}.health.json"),
+                        health_interval_s=0.1)
+    return ClusterServing(cfg, model=StallModel(),
+                          queue=instance_queue(root, name))
+
+
+def fleet_generative_factory(root: str, name: str):
+    """Generative fleet-instance factory: every instance constructs the
+    SAME deterministic toy LM (seeded init + seeded fit data), so a
+    stream handed off mid-decode must continue token-identically on any
+    adopter."""
+    from analytics_zoo_tpu.capture.lm import TransformerLM
+    from analytics_zoo_tpu.serving import GenerativeServing, ServingConfig
+    from analytics_zoo_tpu.serving.fleet import instance_queue
+
+    rs = np.random.RandomState(0)
+    lm = TransformerLM(vocab_size=16, hidden=16, n_block=2, n_head=2,
+                       max_len=32, seed=0)
+    lm.fit(rs.randint(0, 16, (32, 12)), batch_size=8, epochs=1)
+    cfg = ServingConfig(data_src=root, slots=2, max_new_tokens=10,
+                        stream_interval=2,
+                        health_path=os.path.join(root,
+                                                 f"{name}.health.json"),
+                        health_interval_s=0.05)
+    return GenerativeServing(cfg, lm, queue=instance_queue(root, name))
+
+
 def failing_worker(_workdir: str) -> int:
     """Rank 1 dies before the collective; rank 0 would hang in it forever —
     the launcher's failure detection must kill the pod."""

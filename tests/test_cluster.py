@@ -13,6 +13,7 @@ from analytics_zoo_tpu.cluster import PodLaunchError, PodLauncher
 
 
 class TestPodTraining:
+    @pytest.mark.pod(budget_s=60)
     def test_two_process_train(self, tmp_path):
         workdir = str(tmp_path)
         launcher = PodLauncher(num_processes=2, devices_per_process=2,
@@ -43,6 +44,7 @@ class TestPodTraining:
         ckpts = glob.glob(os.path.join(workdir, "ckpt", "*"))
         assert ckpts, "rank 0 wrote no checkpoint"
 
+    @pytest.mark.pod(budget_s=30)
     def test_failure_detection_kills_pod(self, tmp_path):
         """One dead worker must fail the job fast, not hang the collective."""
         launcher = PodLauncher(num_processes=2, devices_per_process=1,
@@ -58,6 +60,95 @@ class TestPodTraining:
         from analytics_zoo_tpu.cluster.bootstrap import resolve_target
         with pytest.raises(ValueError):
             resolve_target("no_colon_here")
+
+
+class TestBootstrapGuards:
+    @pytest.mark.pod(budget_s=10)
+    def test_parent_guard_reaps_orphaned_worker(self, tmp_path):
+        """The launcher dying must take its workers with it. Model the
+        documented race window — launcher dead before the worker's guard
+        even starts — by handing bootstrap a ZOO_TPU_PARENT pid that is
+        already gone: the ppid watch fires and the worker exits 113
+        instead of serving out its 600s target."""
+        import subprocess
+        import sys
+        launcher = subprocess.Popen([sys.executable, "-c", "pass"])
+        launcher.wait()  # "launcher" is dead before the worker starts
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
+        env.update({
+            "ZOO_TPU_PROC_ID": "0", "ZOO_TPU_NPROCS": "1",
+            "ZOO_TPU_COORD": "127.0.0.1:1",  # never reached
+            "ZOO_TPU_TARGET": "tests.pod_workers:sleep_worker",
+            "ZOO_TPU_ARGS": json.dumps([str(tmp_path)]),
+            "ZOO_TPU_PARENT": str(launcher.pid),
+        })
+        worker = subprocess.Popen(
+            [sys.executable, "-m", "analytics_zoo_tpu.cluster.bootstrap"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT)
+        try:
+            assert worker.wait(timeout=30) == 113
+        finally:
+            if worker.poll() is None:
+                worker.kill()
+
+    def test_coordinator_handoff_waits_for_atomic_write(self, tmp_path):
+        """read_coordinator polls through absent AND torn states until
+        the supervisor's atomic publish lands — the fresh-port-per-
+        generation handoff the elastic restart path rides on."""
+        import threading
+        from analytics_zoo_tpu.cluster.bootstrap import read_coordinator
+        coord_file = str(tmp_path / "coordinator.json")
+        with open(coord_file, "w") as f:
+            f.write('{"coord": ')  # torn: mid-replace snapshot
+
+        def publish():
+            import time
+            time.sleep(0.3)
+            tmp = coord_file + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"coord": "127.0.0.1:4242", "generation": 3}, f)
+            os.replace(tmp, coord_file)
+
+        t = threading.Thread(target=publish)
+        t.start()
+        try:
+            assert read_coordinator(coord_file,
+                                    timeout_s=10) == "127.0.0.1:4242"
+        finally:
+            t.join()
+
+    def test_coordinator_handoff_times_out(self, tmp_path):
+        from analytics_zoo_tpu.cluster.bootstrap import read_coordinator
+        with pytest.raises(RuntimeError, match="no coordinator address"):
+            read_coordinator(str(tmp_path / "never.json"), timeout_s=0.3)
+
+
+class TestLauncherRestarts:
+    @pytest.mark.pod(budget_s=45)
+    def test_per_worker_retry_and_budget_exhaustion(self, tmp_path):
+        """restarts= relaunches a failed rank in place: a first-attempt
+        crash succeeds on attempt 2 with its failure's log tail kept;
+        a rank that fails every attempt exhausts the budget and surfaces
+        every attempt's evidence."""
+        launcher = PodLauncher(num_processes=1, devices_per_process=1,
+                               platform="cpu", restarts=1,
+                               log_dir=os.path.join(str(tmp_path), "logs"))
+        results = launcher.run("tests.pod_workers:flaky_worker",
+                               args=[str(tmp_path)], timeout=240)
+        assert results[0].returncode == 0
+        assert results[0].attempts == 2
+        assert len(results[0].attempt_tails) == 1
+        assert "first attempt dies" in results[0].attempt_tails[0]
+
+        with pytest.raises(PodLaunchError) as ei:
+            launcher.run("tests.pod_workers:always_failing_worker",
+                         args=[str(tmp_path)], timeout=240)
+        (res,) = ei.value.results
+        assert res.attempts == 2  # initial + one retry, both failed
+        assert len(res.attempt_tails) == 1
+        assert "always failing worker" in res.attempt_tails[0]
 
 
 class TestSubmitCLI:
@@ -95,6 +186,7 @@ class TestSubmitCLI:
 
 
 class TestMultiHostDirectEval:
+    @pytest.mark.pod(budget_s=30)
     def test_direct_eval_counts_tails(self, tmp_path):
         launcher = PodLauncher(num_processes=2, devices_per_process=2,
                                platform="cpu",
@@ -109,6 +201,7 @@ class TestMultiHostDirectEval:
         # one logical eval: both hosts must agree on the weighted loss
         assert losses[0] == pytest.approx(losses[1])
 
+    @pytest.mark.pod(budget_s=30)
     def test_exact_eval_matches_single_process(self, tmp_path):
         """Per-example masked eval on ragged 2-host shards equals the
         single-process loss over the concatenated data (zero tail bias) —

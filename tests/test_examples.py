@@ -18,6 +18,11 @@ _SLOW = {
     # heaviest smokes re-tiered for the tier-1 870s budget
     "examples/textgeneration/lm_generate_example.py",
     "examples/textclassification/bert_classifier_example.py",
+    "examples/imageclassification/pretrained_import.py",
+    "examples/imageclassification/resnet_transfer.py",
+    "examples/parallel/moe_pipeline_example.py",
+    "examples/seq2seq/chatbot_example.py",
+    "examples/inference/quantized_inference_example.py",
 }
 
 EXAMPLES = [

@@ -157,6 +157,7 @@ class TestSSDEndToEnd:
         res = m.compute()
         assert 0.0 <= res["mAP"] <= 1.0
 
+    @pytest.mark.slow  # re-tiered: heaviest e2e sweep (tier-1 870s budget)
     def test_ssd_vgg16_builds(self, ctx):
         model, anchors = SSD(21, 300, "vgg16")
         assert anchors.shape == (8732, 4)

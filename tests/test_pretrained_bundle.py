@@ -34,6 +34,7 @@ def _tiny_classifier():
 
 
 class TestBundleRoundTrip:
+    @pytest.mark.slow  # re-tiered: heaviest e2e sweep (tier-1 870s budget)
     def test_remote_bundle_predicts_with_labels(self, ctx, remote_root):
         """Save to a fake-remote URI, load back, predict with label names
         through the bundled preprocessing — the full user journey."""
@@ -68,6 +69,7 @@ class TestBundleRoundTrip:
         got = np.asarray(loaded.predict(x, batch_size=4))
         np.testing.assert_array_equal(got, want)
 
+    @pytest.mark.slow  # re-tiered: heaviest e2e sweep (tier-1 870s budget)
     def test_bundle_json_carries_preprocessing_spec(self, ctx, tmp_path):
         clf = _tiny_classifier()
         clf.save_pretrained(str(tmp_path / "b"))

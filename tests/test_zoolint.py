@@ -183,6 +183,36 @@ def test_monotonic_clock_catches_seeded_wall_clock(tmp_path):
     assert all("wall-clock" in f.message for f in res.findings)
 
 
+def test_monotonic_clock_catches_mixed_domain_arithmetic(tmp_path):
+    """The lease/heartbeat bug class: one expression subtracting (or
+    comparing) a monotonic read against a wall_clock() stamp is flagged
+    even though each read is legitimate on its own; same-domain
+    arithmetic on either clock stays clean."""
+    proj = _seed(tmp_path, {"lease.py": (
+        "import time\n"
+        "from analytics_zoo_tpu.common.utils import wall_clock\n"
+        "\n"
+        "\n"
+        "def age_wrong():\n"
+        "    return time.monotonic() - wall_clock()\n"
+        "\n"
+        "\n"
+        "def expired_wrong(stamp_s):\n"
+        "    return wall_clock() + stamp_s < time.perf_counter()\n"
+        "\n"
+        "\n"
+        "def age_right(observed_mono):\n"
+        "    return time.monotonic() - observed_mono\n"
+        "\n"
+        "\n"
+        "def stamp_right():\n"
+        "    return wall_clock() + 30.0\n")})
+    res = run_passes(proj, ids=["monotonic-clock"])
+    assert [f.line for f in res.findings] == [6, 10]
+    assert all("mixes monotonic- and wall-clock" in f.message
+               for f in res.findings)
+
+
 # -- suppression machinery ----------------------------------------------------
 
 def test_suppression_same_line(tmp_path):
