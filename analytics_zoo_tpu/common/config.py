@@ -370,3 +370,54 @@ _global_config.register("parallel.moe_exchange", "auto",
                         "'auto' = alltoall when a mesh with an 'expert' "
                         "axis is active and shapes divide, dense "
                         "otherwise.")
+_global_config.register("serving.brownout_high", 0.75,
+                        "Pressure (max of queue-fill, slot-occupancy and "
+                        "KV-page-scarcity ratios) above which the brownout "
+                        "controller steps DOWN one degradation rung on the "
+                        "next health tick (docs/serving.md"
+                        "#overload-survival).")
+_global_config.register("serving.brownout_low", 0.35,
+                        "Pressure below which the brownout controller "
+                        "steps back UP one rung after "
+                        "serving.brownout_hold_ticks consecutive calm "
+                        "health ticks.")
+_global_config.register("serving.brownout_hold_ticks", 3,
+                        "Consecutive calm health ticks required before the "
+                        "brownout controller recovers one rung — "
+                        "hysteresis so the fleet does not flap between "
+                        "rungs at the threshold.")
+_global_config.register("serving.brownout_token_frac", 0.25,
+                        "Fraction of the configured max_new_tokens that "
+                        "the deepest brownout rung caps generative "
+                        "budgets to (rung 3; rung 2 caps at twice this).")
+_global_config.register("client.retry_budget_ratio", 0.1,
+                        "Retry-budget token-bucket earn rate: each first "
+                        "attempt deposits this many tokens, each "
+                        "retry/hedge spends one — retry amplification is "
+                        "bounded at 1 + ratio by construction.")
+_global_config.register("client.retry_attempts", 2,
+                        "Max budgeted retries per logical request in "
+                        "ResilientClient.call (only on terminal errors "
+                        "with retriable: true).")
+_global_config.register("client.retry_backoff_s", 0.05,
+                        "Full-jitter retry backoff base: attempt N sleeps "
+                        "uniform(0, base * 2^N) seconds before "
+                        "re-enqueueing.")
+_global_config.register("client.hedge_delay_ms", 200.0,
+                        "Hedge trigger floor for ResilientClient."
+                        "query_any: a second copy races the first after "
+                        "this long (or the client's observed p99 once "
+                        "enough history exists) without a terminal.")
+_global_config.register("fleet.breaker_failures", 3,
+                        "Consecutive settled error terminals from one "
+                        "instance that trip its circuit breaker open "
+                        "(docs/fleet.md#overload-survival).")
+_global_config.register("fleet.breaker_latency_ratio", 4.0,
+                        "An instance whose EWMA service time exceeds this "
+                        "multiple of the fleet median for "
+                        "fleet.breaker_failures consecutive health "
+                        "refreshes trips its breaker (sick-but-not-dead "
+                        "detection ahead of health-file staleness).")
+_global_config.register("fleet.breaker_cooldown_s", 1.0,
+                        "Seconds an open breaker holds before moving to "
+                        "half-open and admitting one probe placement.")

@@ -142,6 +142,12 @@ REGISTRY: Dict[str, Site] = {
         "fleet router placement, once per routed request — a failed "
         "placement pass must park the request in the router backlog and "
         "retry it next pass (never lost, never double-enqueued)"),
+    "fleet.breaker": Site(
+        "fleet router health refresh, once per instance — a firing "
+        "force-opens that instance's circuit breaker (arm with budget=N "
+        "to trip the first N instances refreshed); the router must stop "
+        "placing on it, half-open probe it after the cooldown, and close "
+        "the breaker on a clean probe", kind="flag"),
     "cluster.heartbeat": Site(
         "worker lease heartbeat thread, once per beat — a firing makes "
         "the worker STOP heartbeating (a hung host: process alive, lease "

@@ -4,7 +4,7 @@ repo-local passes can do the same — subclass ``LintPass``, decorate with
 ``@register_pass``, and import the module before calling ``run_passes``.
 """
 from . import (config_keys, fault_sites, hot_path, jit_boundary,  # noqa: F401
-               metric_names, monotonic_clock)
+               metric_names, monotonic_clock, retry_discipline)
 
 __all__ = ["config_keys", "fault_sites", "hot_path", "jit_boundary",
-           "metric_names", "monotonic_clock"]
+           "metric_names", "monotonic_clock", "retry_discipline"]

@@ -45,12 +45,14 @@ _UNIT_SUFFIX = {"counter": "_total", "histogram": "_seconds"}
 
 #: gauges must say what they measure; any of these suffixes qualifies
 _GAUGE_UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio", "_depth")
-#: gauges that are genuinely unitless: live request/slot counts and the
-#: info-style constant-1 build gauge (labels carry the payload)
+#: gauges that are genuinely unitless: live request/slot counts, the
+#: info-style constant-1 build gauge (labels carry the payload), and the
+#: enumerated state machines (brownout rung, breaker state)
 _GAUGE_UNITLESS_OK = {"serving.in_flight", "serving.slots_occupied",
                       "serving.kv_pages_free", "build.info",
                       "fleet.instances_alive", "fleet.desired_instances",
-                      "cluster.leases_alive"}
+                      "cluster.leases_alive", "serving.brownout_level",
+                      "fleet.breaker_state"}
 
 
 def _is_registration(node: ast.Call) -> bool:

@@ -1,0 +1,142 @@
+"""zoolint pass ``retry-discipline``: retries must be jittered and bounded.
+
+A fleet under overload is a synchronized system: every client that saw
+the same shed error retries on the same schedule, so a FIXED retry delay
+turns one overload spike into a standing wave of them (the classic retry
+storm), and an UNBOUNDED retry loop turns one dead backend into a caller
+that never returns. The package-wide rules (docs/serving.md "Overload
+survival" — ``ResilientClient`` and ``file_io._remote_op`` are the
+reference implementations):
+
+* **No fixed retry sleeps.** A ``time.sleep(<constant>)`` lexically
+  inside an ``except`` handler that sits in a loop is a fixed, unjittered
+  retry delay — compute the delay instead (exponential backoff, ideally
+  with full jitter: ``rng.uniform(0, base * 2 ** attempt)``).
+* **No unbounded retry loops.** A ``while True`` loop that catches
+  exceptions but contains NO escape at all (no ``raise``, ``return`` or
+  ``break`` anywhere in its body) retries forever with no budget or
+  deadline — bound it by an attempt counter, a deadline, or a retry
+  budget (:class:`~analytics_zoo_tpu.serving.client.RetryBudget`).
+
+Scope is the package only (``tests/`` and ``bench.py`` drive chaos loops
+on purpose). Waive a deliberate fixed delay with
+``# zoolint: disable=retry-discipline — <why>`` and a justification.
+"""
+from __future__ import annotations
+
+import ast
+import sys
+from typing import List
+
+from ..core import Finding, LintPass, Project, get_project, register_pass
+from .monotonic_clock import _dotted, _import_map
+
+_LOOPS = (ast.For, ast.AsyncFor, ast.While)
+
+
+def _is_constant_sleep(node: ast.AST, imports) -> bool:
+    """``time.sleep(<literal>)`` (or an aliased import of it) — the
+    argument must be a plain constant, not computed from an attempt
+    counter or drawn from an rng."""
+    if not (isinstance(node, ast.Call) and node.args):
+        return False
+    d = _dotted(node.func, imports)
+    if d not in ("time.sleep", "sleep") and not d.endswith(".sleep"):
+        return False
+    return isinstance(node.args[0], ast.Constant)
+
+
+def _walk_same_scope(body: List[ast.stmt]):
+    """Walk statements without descending into nested function/class
+    definitions (their control flow is not this loop's control flow)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        for child in ast.iter_child_nodes(node):
+            stack.append(child)
+
+
+def _has_escape(loop: ast.While) -> bool:
+    """Any ``raise``/``return``/``break`` in the loop's own scope (nested
+    loops' breaks still bound *some* iteration, so they count — the rule
+    targets loops with literally no exit path)."""
+    for node in _walk_same_scope(loop.body):
+        if isinstance(node, (ast.Raise, ast.Return, ast.Break)):
+            return True
+    return False
+
+
+def _handlers_in(loop: ast.stmt) -> List[ast.ExceptHandler]:
+    return [n for n in _walk_same_scope(loop.body)
+            if isinstance(n, ast.ExceptHandler)]
+
+
+def findings(project=None) -> List[Finding]:
+    project = project or get_project()
+    out: List[Finding] = []
+    for path in project.package_files():
+        tree = project.ast_for(path)
+        imports = _import_map(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, _LOOPS):
+                continue
+            handlers = _handlers_in(node)
+            if not handlers:
+                continue
+            for h in handlers:
+                for sub in _walk_same_scope(h.body):
+                    if _is_constant_sleep(sub, imports):
+                        out.append(Finding(
+                            path, sub.lineno, RetryDisciplinePass.id,
+                            "fixed (unjittered) retry delay — every "
+                            "caller that saw the same error retries in "
+                            "lockstep, re-spiking the backend it is "
+                            "retrying against",
+                            "compute the delay: full-jitter exponential "
+                            "backoff (rng.uniform(0, base * 2**attempt)) "
+                            "as in serving.client.ResilientClient"))
+            if (isinstance(node, ast.While)
+                    and isinstance(node.test, ast.Constant)
+                    and node.test.value is True
+                    and not _has_escape(node)):
+                out.append(Finding(
+                    path, node.lineno, RetryDisciplinePass.id,
+                    "unbounded `while True` retry loop — catches "
+                    "exceptions but has no raise/return/break escape, "
+                    "so a dead dependency is retried forever",
+                    "bound it with an attempt counter, a deadline, or "
+                    "a RetryBudget (serving.client)"))
+    return out
+
+
+def check() -> List[str]:
+    """Human-readable violations; empty = clean."""
+    return [f.message for f in findings()]
+
+
+@register_pass
+class RetryDisciplinePass(LintPass):
+    id = "retry-discipline"
+    title = "retries jittered and bounded (no storms, no forever-loops)"
+    rationale = (
+        "a fixed retry delay synchronizes every failed caller into a "
+        "retry storm, and an unbounded retry loop hangs on a dead "
+        "backend — jittered exponential backoff under an explicit "
+        "budget/deadline is the only retry shape the package allows")
+
+    def run(self, project: Project) -> List[Finding]:
+        return findings(project)
+
+
+def main() -> int:
+    problems = check()
+    if not problems:
+        print("retry-discipline lint: clean")
+        return 0
+    for p in problems:
+        print(p, file=sys.stderr)
+    return 1

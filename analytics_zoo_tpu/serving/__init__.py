@@ -1,10 +1,12 @@
 """Cluster Serving (reference ``serving/ClusterServing.scala:45`` +
 ``pyzoo/zoo/serving/client.py``): pub/sub queue → host preprocessing →
 batched TPU inference → result write-back with backpressure."""
-from .client import InputQueue, OutputQueue  # noqa: F401
+from .client import (InputQueue, OutputQueue,  # noqa: F401
+                     ResilientClient, RetryBudget)
 from .config import ServingConfig  # noqa: F401
 from .fleet import (FLEET_SHED_ERROR, FleetInstance,  # noqa: F401
                     FleetRouter, instance_queue, read_health)
-from .queues import FileQueue, QueueBackend, RedisQueue, make_queue  # noqa: F401
+from .queues import (CRITICALITY_LANES, FileQueue,  # noqa: F401
+                     QueueBackend, RedisQueue, criticality_of, make_queue)
 from .server import (ClusterServing, GenerativeServing,  # noqa: F401
                      ModelReloadError)

@@ -39,6 +39,22 @@ the front spool lives in the router backlog or an instance spool or the
 ``_assigned`` failover map until its ONE terminal result lands — the
 ``fleet.route`` fault site proves a failed placement pass parks work in
 the backlog rather than losing it.
+
+**Circuit breakers (docs/fleet.md "Overload survival").** Health files
+age out in ``fleet.stale_after_s`` seconds — far too slow for a
+sick-but-writing instance (GC thrash, a wedged accelerator) that keeps
+stamping fresh gauges while answering nothing. Each instance carries a
+:class:`_Breaker`: consecutive settled-error terminals or an EWMA service
+time persistently above ``fleet.breaker_latency_ratio`` x the fleet
+median trips it OPEN, removing the instance from placement immediately.
+After ``fleet.breaker_cooldown_s`` it goes HALF-OPEN: exactly one probe
+request is placed; a clean terminal closes the breaker, an error re-opens
+it for another cooldown. The ``fleet.breaker`` flag fault trips a named
+instance on demand, and ``fleet.breaker_state`` exports the state machine
+per instance (0=closed, 1=open, 2=half-open). When NO instance is
+placeable (breakers open, health missing) the router parks work in the
+backlog and counts ``fleet.no_capacity_total`` — it never raises, and the
+first half-open probe success re-places the parked work.
 """
 from __future__ import annotations
 
@@ -96,6 +112,100 @@ _M_BACKLOG = _metrics.gauge(
     "Requests parked in the router awaiting a routable instance.")
 _M_ROUTE_PASS = _metrics.histogram(
     "fleet.route_pass_seconds", "Wall seconds per route_once() pass.")
+_M_NO_CAPACITY = _metrics.counter(
+    "fleet.no_capacity_total",
+    "Requests parked in the backlog because no instance was placeable "
+    "(all breakers open / health files missing).")
+_M_BREAKER = _metrics.gauge(
+    "fleet.breaker_state",
+    "Per-instance circuit breaker state: 0=closed, 1=open, 2=half-open.",
+    labels=("instance",))
+
+#: breaker states (gauge values)
+BREAKER_CLOSED, BREAKER_OPEN, BREAKER_HALF_OPEN = 0, 1, 2
+
+
+class _Breaker:
+    """Per-instance circuit breaker (closed -> open -> half-open ->
+    closed). Trip inputs are *settled* terminals (recorded by the
+    router's ``_settle`` pass) and the latency ratio check in
+    ``_refresh``; while OPEN the instance receives no placements at all,
+    and HALF-OPEN admits exactly one probe request."""
+
+    def __init__(self, failures: int, latency_ratio: float,
+                 cooldown_s: float):
+        self.failures = int(failures)
+        self.latency_ratio = float(latency_ratio)
+        self.cooldown_s = float(cooldown_s)
+        self.state = BREAKER_CLOSED
+        self._error_streak = 0
+        self._slow_streak = 0
+        self._opened_at = 0.0
+        self._probe_uri: Optional[str] = None
+
+    def record_result(self, uri: str, is_error: bool, now: float) -> None:
+        """Feed one settled terminal. In HALF-OPEN only the probe's
+        terminal moves the state machine; a clean probe closes the
+        breaker, a failed probe re-opens it for another cooldown."""
+        if self.state == BREAKER_HALF_OPEN:
+            if uri != self._probe_uri:
+                return
+            self._probe_uri = None
+            if is_error:
+                self.trip(now)
+            else:
+                self.state = BREAKER_CLOSED
+                self._error_streak = self._slow_streak = 0
+            return
+        if is_error:
+            self._error_streak += 1
+            if self._error_streak >= self.failures:
+                self.trip(now)
+        else:
+            self._error_streak = 0
+
+    def record_latency(self, service_s: float, fleet_median_s: float,
+                       now: float) -> None:
+        """Feed one health refresh: an EWMA persistently above
+        ``latency_ratio`` x the fleet median trips the breaker even when
+        the instance is still answering (slow is the new down)."""
+        if self.state != BREAKER_CLOSED:
+            return
+        if (fleet_median_s > 0.0
+                and service_s > self.latency_ratio * fleet_median_s):
+            self._slow_streak += 1
+            if self._slow_streak >= self.failures:
+                self.trip(now)
+        else:
+            self._slow_streak = 0
+
+    def trip(self, now: float) -> None:
+        """Force-open the breaker (also the entry point for the
+        ``fleet.breaker`` flag fault)."""
+        self.state = BREAKER_OPEN
+        self._opened_at = now
+        self._error_streak = self._slow_streak = 0
+        self._probe_uri = None
+
+    def placeable(self, now: float) -> bool:
+        """May the router place a request here? OPEN breakers move to
+        HALF-OPEN once the cooldown elapses; HALF-OPEN admits only while
+        no probe is outstanding."""
+        if self.state == BREAKER_CLOSED:
+            return True
+        if self.state == BREAKER_OPEN:
+            if now - self._opened_at >= self.cooldown_s:
+                self.state = BREAKER_HALF_OPEN
+                self._probe_uri = None
+                return True
+            return False
+        return self._probe_uri is None  # half-open: one probe at a time
+
+    def note_placed(self, uri: str) -> None:
+        """A placement landed on this instance; in HALF-OPEN it becomes
+        the probe whose terminal decides the breaker's fate."""
+        if self.state == BREAKER_HALF_OPEN and self._probe_uri is None:
+            self._probe_uri = uri
 
 
 def read_health(path: str, now: Optional[float] = None) -> Optional[Dict]:
@@ -196,6 +306,13 @@ class FleetRouter:
         self.default_token_s = float(default_token_s)
         self.page_len = int(page_len)
         self.settle_batch = int(settle_batch)
+        self._breaker_failures = int(cfg.get("fleet.breaker_failures"))
+        self._breaker_latency_ratio = float(
+            cfg.get("fleet.breaker_latency_ratio"))
+        self._breaker_cooldown_s = float(
+            cfg.get("fleet.breaker_cooldown_s"))
+        #: name -> circuit breaker, created lazily on first refresh
+        self._breakers: Dict[str, _Breaker] = {}
         #: uri -> {"instance": name, "rec": original request} for every
         #: request placed and not yet seen terminal — the failover map
         self._assigned: Dict[str, Dict[str, Any]] = {}
@@ -210,6 +327,14 @@ class FleetRouter:
         self._thread: Optional[threading.Thread] = None
 
     # -- telemetry ---------------------------------------------------------
+
+    def _breaker(self, name: str) -> _Breaker:
+        br = self._breakers.get(name)
+        if br is None:
+            br = self._breakers[name] = _Breaker(
+                self._breaker_failures, self._breaker_latency_ratio,
+                self._breaker_cooldown_s)
+        return br
 
     def _refresh(self, now: float) -> None:
         """Re-read every instance's health file and rebuild the placement
@@ -262,6 +387,22 @@ class FleetRouter:
             tps = snap.get("tokens_per_sec_ewma")
             if tps:
                 token_s[i] = 1.0 / tps
+        # circuit breakers: latency-ratio trip against the fleet median,
+        # the fleet.breaker flag fault, then mask placement. A breaker
+        # opening on a *live* instance must NOT fail its streams over —
+        # it is still answering, just not receiving new work.
+        med = (float(np.median(service_s[alive]))
+               if bool(alive.any()) else 0.0)
+        for i, inst in enumerate(self.instances):
+            br = self._breaker(inst.name)
+            # chaos site (flag kind): force-open this instance's breaker
+            # (arm with budget=N to trip the first N instances refreshed)
+            if faults.inject("fleet.breaker"):
+                br.trip(now)
+            if alive[i]:
+                br.record_latency(float(service_s[i]), med, now)
+                alive[i] = br.placeable(now)
+            _M_BREAKER.labels(instance=inst.name).set(br.state)
         self._g = {"alive": alive, "dead": dead, "depth": depth,
                    "in_flight": in_flight, "slots_free": slots_free,
                    "pages_free": pages_free, "service_s": service_s,
@@ -317,6 +458,7 @@ class FleetRouter:
         uris = list(self._assigned)
         if not uris:
             return
+        now = wall_clock()
         start = self._settle_cursor % len(uris)
         for uri in (uris[start:start + self.settle_batch]
                     or uris[:self.settle_batch]):
@@ -325,7 +467,13 @@ class FleetRouter:
             except Exception:
                 continue
             if res is not None and ("error" in res or "value" in res):
-                self._assigned.pop(uri, None)
+                entry = self._assigned.pop(uri, None)
+                if entry is not None:
+                    # every settled terminal feeds the instance's
+                    # breaker: error streaks trip it, and a half-open
+                    # probe's terminal decides whether it closes
+                    self._breaker(entry["instance"]).record_result(
+                        uri, "error" in res, now)
         self._settle_cursor = start + self.settle_batch
 
     # -- placement ---------------------------------------------------------
@@ -344,11 +492,17 @@ class FleetRouter:
         remain = (enq + float(deadline_ms) / 1e3 - now
                   if deadline_ms else None)
         if remain is not None and remain <= 0:
-            self.front.put_result(uri, {"error": DEADLINE_ERROR})
+            self.front.put_result(
+                uri, {"error": DEADLINE_ERROR, "retriable": False})
             _M_EXPIRED.inc()
             return True
         g = self._g
         if g is None or not bool(g["alive"].any()):
+            # zero placeable instances (all breakers open, every health
+            # file missing/stale, or an empty fleet): park, never raise.
+            # The backlog is retried every pass, so the first half-open
+            # probe success re-places this work.
+            _M_NO_CAPACITY.inc()
             return False
         prompt = rec.get("prompt")
         if prompt:
@@ -364,22 +518,35 @@ class FleetRouter:
             g["alive"], g["depth"], g["in_flight"], g["slots_free"],
             g["pages_free"], g["service_s"], g["token_s"],
             np.float64(need_tokens), np.float64(need_pages))
-        best = int(np.argmin(est))
-        if not np.isfinite(est[best]):
-            return False
+        while True:
+            best = int(np.argmin(est))
+            if not np.isfinite(est[best]):
+                # every candidate got masked mid-pass (half-open probes
+                # already outstanding): same no-capacity park as above
+                _M_NO_CAPACITY.inc()
+                return False
+            inst = self.instances[best]
+            if self._breaker(inst.name).placeable(now):
+                break
+            # a half-open instance admits exactly ONE probe per cooldown;
+            # once this pass placed it, later requests must look elsewhere
+            est[best] = np.inf
+            g["alive"][best] = False
         if remain is not None and float(est[best]) > remain:
             # admission control: answer NOW instead of queueing work no
-            # instance can finish in time
-            self.front.put_result(uri, {"error": FLEET_SHED_ERROR})
+            # instance can finish in time — shed is retriable (capacity
+            # may free up), unlike a blown deadline
+            self.front.put_result(
+                uri, {"error": FLEET_SHED_ERROR, "retriable": True})
             _M_SHED.inc()
             return True
-        inst = self.instances[best]
         try:
             inst.queue.enqueue(uri, rec)
         except Exception:
             logger.exception("enqueue to %s failed", inst.name)
             return False
         self._assigned[uri] = {"instance": inst.name, "rec": rec}
+        self._breaker(inst.name).note_placed(uri)
         # optimistic gauge bump: later placements in this same pass see
         # the queued work without waiting for the next health refresh
         g["depth"][best] += 1.0
@@ -446,6 +613,7 @@ class FleetRouter:
         The actuator calls this once the server subprocess has exited; any
         work still assigned to the name fails over on the next refresh."""
         self.instances = [i for i in self.instances if i.name != name]
+        self._breakers.pop(name, None)
         self._g = None
         self._last_refresh = -1e18
 
@@ -478,6 +646,11 @@ class FleetRouter:
             except Exception:
                 logger.exception("returning %s to the front failed", uri)
         self._backlog = []
+
+    def breaker_states(self) -> Dict[str, int]:
+        """Per-instance breaker state (the values behind the
+        ``fleet.breaker_state`` gauge): 0=closed, 1=open, 2=half-open."""
+        return {name: br.state for name, br in self._breakers.items()}
 
     @property
     def stats(self) -> Dict[str, int]:

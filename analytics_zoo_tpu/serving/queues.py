@@ -27,6 +27,26 @@ from ..common.utils import wall_clock
 import uuid
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+#: admission classes, in CLAIM priority order — critical requests are
+#: claimed first; shed/trim consume the lanes in the REVERSE order, so
+#: sheddable traffic absorbs overload before default, and default before
+#: critical (docs/serving.md#overload-survival)
+CRITICALITY_LANES = ("critical", "default", "sheddable")
+_CLAIM_RANK = {lane: i for i, lane in enumerate(CRITICALITY_LANES)}
+_SHED_ORDER = tuple(reversed(CRITICALITY_LANES))
+_SHED_RANK = {lane: i for i, lane in enumerate(_SHED_ORDER)}
+#: FileQueue filename lane tag ("{ts}-{uuid}.{tag}.json")
+_LANE_TAG = {"critical": "c", "default": "d", "sheddable": "s"}
+_TAG_LANE = {v: k for k, v in _LANE_TAG.items()}
+
+
+def criticality_of(payload: Dict[str, Any]) -> str:
+    """The request's admission class; unknown/absent values degrade to
+    ``default`` (never an error — a foreign producer must not crash
+    admission control)."""
+    lane = payload.get("criticality")
+    return lane if lane in _CLAIM_RANK else "default"
+
 
 class QueueBackend:
     """enqueue/claim requests; put/get results."""
@@ -64,13 +84,23 @@ class QueueBackend:
 
     def shed(self, max_pending: int,
              reason: str = "shed: queue overloaded") -> List[str]:
-        """Erroring admission control: atomically remove the OLDEST
-        requests beyond ``max_pending`` and post a terminal
-        ``{"error": reason}`` result for each, so every dropped client
+        """Erroring admission control: atomically remove requests beyond
+        ``max_pending`` and post a terminal ``{"error": reason,
+        "retriable": True}`` result for each, so every dropped client
         gets an explicit answer instead of polling to its timeout.
+        Victims are consumed criticality-lane-first (sheddable, then
+        default, then critical; oldest first within a lane), so under
+        overload the critical class is the last to lose work.
         Returns the shed uris. Claims are exclusive — on a shared spool N
         servers shedding concurrently drop each request at most once."""
         raise NotImplementedError
+
+    def discard_result(self, uri: str) -> bool:
+        """Drop ``uri``'s terminal result from the result store, if any.
+        Used by the client's hedged query to reap the losing copy so it
+        is never surfaced and never leaks storage. Returns True when a
+        result record was removed."""
+        return False
 
 
 class FileQueue(QueueBackend):
@@ -98,8 +128,24 @@ class FileQueue(QueueBackend):
         for d in (self.req_dir, self.claim_dir, self.res_dir):
             file_io.makedirs(d, exist_ok=True)
 
+    @staticmethod
+    def _record_name(payload: Dict[str, Any]) -> str:
+        """Spool filename: wall-clock stamp (FIFO within a lane under
+        ``sorted()``) + uniquifier + criticality lane tag, so claim/shed
+        ordering never has to open the record to learn its class."""
+        tag = _LANE_TAG[criticality_of(payload)]
+        return (f"{int(wall_clock() * 1e9):020d}-"
+                f"{uuid.uuid4().hex[:8]}.{tag}.json")
+
+    @staticmethod
+    def _lane_of_name(name: str) -> str:
+        parts = name.split(".")
+        if len(parts) >= 3 and parts[-2] in _TAG_LANE:
+            return _TAG_LANE[parts[-2]]
+        return "default"  # pre-lane spool files keep working
+
     def enqueue(self, uri: str, payload: Dict[str, Any]) -> None:
-        name = f"{int(wall_clock() * 1e9):020d}-{uuid.uuid4().hex[:8]}.json"
+        name = self._record_name(payload)
         tmp = file_io.join(self.req_dir, "." + name)
         with file_io.fopen(tmp, "w") as f:
             f.write(json.dumps({"uri": uri, **payload}))
@@ -124,8 +170,7 @@ class FileQueue(QueueBackend):
         stage = file_io.join(self.req_dir, f".stage-{uuid.uuid4().hex[:8]}")
         file_io.makedirs(stage, exist_ok=True)
         for uri, payload in items:
-            name = (f"{int(wall_clock() * 1e9):020d}-"
-                    f"{uuid.uuid4().hex[:8]}.json")
+            name = self._record_name(payload)
             with file_io.fopen(file_io.join(stage, name), "w") as f:
                 f.write(json.dumps({"uri": uri, **payload}))
         batch = file_io.join(
@@ -292,9 +337,13 @@ class FileQueue(QueueBackend):
         out = []
         try:
             # refresh: another process's enqueues must be visible despite
-            # fsspec listing caches (remote spools)
+            # fsspec listing caches (remote spools). Claim order is
+            # priority-lane first (critical → default → sheddable), FIFO
+            # within a lane — under overload the deadline enforcement at
+            # claim time therefore expires sheddable work last-admitted.
             names = sorted(self._flatten_batches(
-                file_io.listdir(self.req_dir, refresh=True)))
+                file_io.listdir(self.req_dir, refresh=True)),
+                key=lambda n: (_CLAIM_RANK[self._lane_of_name(n)], n))
         except FileNotFoundError:
             return out
         for name in names:
@@ -320,9 +369,14 @@ class FileQueue(QueueBackend):
     def shed(self, max_pending: int,
              reason: str = "shed: queue overloaded") -> List[str]:
         try:
-            names = sorted(n for n in self._flatten_batches(
+            # victim order is the REVERSE of claim priority: sheddable
+            # lanes absorb the overload first, critical requests are the
+            # last to be dropped (oldest first within a lane)
+            names = sorted((n for n in self._flatten_batches(
                 file_io.listdir(self.req_dir, refresh=True))
-                           if not n.startswith("."))
+                            if not n.startswith(".")),
+                           key=lambda n: (_SHED_RANK[self._lane_of_name(n)],
+                                          n))
         except FileNotFoundError:
             return []
         dropped: List[str] = []
@@ -333,7 +387,8 @@ class FileQueue(QueueBackend):
             try:
                 with file_io.fopen(path) as f:
                     rec = json.loads(f.read())
-                self.put_result(rec["uri"], {"error": reason})
+                self.put_result(rec["uri"],
+                                {"error": reason, "retriable": True})
                 dropped.append(rec["uri"])
             except (ValueError, KeyError, OSError):
                 # malformed request: no uri to answer — drop it outright
@@ -358,6 +413,15 @@ class FileQueue(QueueBackend):
             return None
         with file_io.fopen(path) as f:
             return json.loads(f.read())
+
+    def discard_result(self, uri: str) -> bool:
+        key = hashlib.md5(uri.encode()).hexdigest()
+        path = file_io.join(self.res_dir, key + ".json")
+        try:
+            file_io.remove(path)
+            return True
+        except (OSError, FileNotFoundError):
+            return False
 
     def all_results(self) -> Dict[str, Dict[str, Any]]:
         out = {}
@@ -392,9 +456,10 @@ class FileQueue(QueueBackend):
             return 0
 
     def trim(self, max_pending: int) -> int:
-        names = sorted(n for n in self._flatten_batches(
+        names = sorted((n for n in self._flatten_batches(
             file_io.listdir(self.req_dir, refresh=True))
-                       if not n.startswith("."))
+                        if not n.startswith(".")),
+                       key=lambda n: (_SHED_RANK[self._lane_of_name(n)], n))
         dropped = 0
         for name in names[:max(0, len(names) - max_pending)]:
             try:
@@ -439,18 +504,30 @@ class RedisQueue(QueueBackend):
         self.consumer = f"consumer-{uuid.uuid4().hex[:12]}"
         self.claim_lease_s = (claim_lease_s if claim_lease_s is not None
                               else self.CLAIM_LEASE_S)
-        # uri -> stream entry id, claimed but not yet answered; the ack in
-        # put_result closes the loop (plain dict ops are GIL-atomic, and
+        # criticality lanes are sibling streams sharing one group name:
+        # default traffic rides the base stream (the reference wire
+        # contract is unchanged), critical/sheddable get their own streams
+        # so claim order and shed order can differ per class without
+        # opening any payload
+        self._lane_streams = {
+            "critical": f"{self.STREAM}:crit",
+            "default": self.STREAM,
+            "sheddable": f"{self.STREAM}:shed",
+        }
+        # uri -> (stream, entry id), claimed but not yet answered; the ack
+        # in put_result closes the loop (plain dict ops are GIL-atomic, and
         # claim/result run on different serve-loop threads)
-        self._unacked: Dict[str, Any] = {}
-        try:
-            self.db.xgroup_create(self.STREAM, self.GROUP, mkstream=True)
-        except Exception:
-            pass  # group exists
+        self._unacked: Dict[str, Tuple[str, Any]] = {}
+        for lane in CRITICALITY_LANES:
+            try:
+                self.db.xgroup_create(self._lane_streams[lane], self.GROUP,
+                                      mkstream=True)
+            except Exception:
+                pass  # group exists
 
     def enqueue(self, uri: str, payload: Dict[str, Any]) -> None:
-        self.db.xadd(self.STREAM, {"uri": uri,
-                                   "data": json.dumps(payload)})
+        self.db.xadd(self._lane_streams[criticality_of(payload)],
+                     {"uri": uri, "data": json.dumps(payload)})
 
     def enqueue_many(self, items: Sequence[Tuple[str, Dict[str, Any]]]
                      ) -> None:
@@ -462,15 +539,16 @@ class RedisQueue(QueueBackend):
             return
         pipe = self.db.pipeline()
         for uri, payload in items:
-            pipe.xadd(self.STREAM, {"uri": uri, "data": json.dumps(payload)})
+            pipe.xadd(self._lane_streams[criticality_of(payload)],
+                      {"uri": uri, "data": json.dumps(payload)})
         pipe.execute()
 
-    def _reclaim_stale(self, max_items: int) -> List:
+    def _reclaim_stale(self, stream: str, max_items: int) -> List:
         """XAUTOCLAIM entries whose claiming consumer died before acking
         (idle past the lease). Absent on old servers/fakes: no reclaim."""
         try:
             resp = self.db.xautoclaim(
-                self.STREAM, self.GROUP, self.consumer,
+                stream, self.GROUP, self.consumer,
                 min_idle_time=int(self.claim_lease_s * 1000.0),
                 count=max_items)
         except Exception:
@@ -482,34 +560,42 @@ class RedisQueue(QueueBackend):
         return []
 
     def claim_batch(self, max_items: int) -> List[Tuple[str, Dict[str, Any]]]:
-        entries = self._reclaim_stale(max_items)
-        if len(entries) < max_items:
-            resp = self.db.xreadgroup(self.GROUP, self.consumer,
-                                      {self.STREAM: ">"},
-                                      count=max_items - len(entries),
-                                      block=10)
-            for _, fresh in resp or []:
-                entries.extend(fresh)
-        out = []
-        for eid, fields in entries:
-            uri = fields[b"uri"].decode()
-            payload = json.loads(fields[b"data"].decode())
-            out.append((uri, {"uri": uri, **payload}))
-            # at-most-once fix: NO xack here — the ack waits for the
-            # result (put_result), so a crash mid-batch redelivers via
-            # _reclaim_stale instead of dropping the request forever
-            self._unacked[uri] = eid
+        out: List[Tuple[str, Dict[str, Any]]] = []
+        # priority lanes: drain the critical stream before default before
+        # sheddable, FIFO within each
+        for lane in CRITICALITY_LANES:
+            room = max_items - len(out)
+            if room <= 0:
+                break
+            stream = self._lane_streams[lane]
+            entries = self._reclaim_stale(stream, room)
+            if len(entries) < room:
+                resp = self.db.xreadgroup(self.GROUP, self.consumer,
+                                          {stream: ">"},
+                                          count=room - len(entries),
+                                          block=10)
+                for _, fresh in resp or []:
+                    entries.extend(fresh)
+            for eid, fields in entries:
+                uri = fields[b"uri"].decode()
+                payload = json.loads(fields[b"data"].decode())
+                out.append((uri, {"uri": uri, **payload}))
+                # at-most-once fix: NO xack here — the ack waits for the
+                # result (put_result), so a crash mid-batch redelivers via
+                # _reclaim_stale instead of dropping the request forever
+                self._unacked[uri] = (stream, eid)
         return out
 
     def put_result(self, uri: str, value: Dict[str, Any]) -> None:
         self.db.hset(f"result:{uri}", mapping={
             k: json.dumps(v) for k, v in value.items()})
-        eid = self._unacked.pop(uri, None)
-        if eid is not None:
+        claim = self._unacked.pop(uri, None)
+        if claim is not None:
             # result durable → the claim is settled; ack AFTER the hset so
             # a crash between the two redelivers (result overwrite is
             # idempotent) rather than losing the request
-            self.db.xack(self.STREAM, self.GROUP, eid)
+            stream, eid = claim
+            self.db.xack(stream, self.GROUP, eid)
 
     def get_result(self, uri: str) -> Optional[Dict[str, Any]]:
         raw = self.db.hgetall(f"result:{uri}")
@@ -517,12 +603,18 @@ class RedisQueue(QueueBackend):
             return None
         return {k.decode(): json.loads(v.decode()) for k, v in raw.items()}
 
-    def pending_count(self) -> int:
+    def discard_result(self, uri: str) -> bool:
+        try:
+            return bool(self.db.delete(f"result:{uri}"))
+        except Exception:
+            return False
+
+    def _stream_pending(self, stream: str) -> int:
         # undelivered backlog (group lag) when the server exposes it —
         # XLEN counts already-served entries that linger until an XTRIM
         # and would make admission control shed phantom load
         try:
-            for g in self.db.xinfo_groups(self.STREAM):
+            for g in self.db.xinfo_groups(stream):
                 name = g.get("name")
                 if name in (self.GROUP, self.GROUP.encode()):
                     lag = g.get("lag")
@@ -530,50 +622,76 @@ class RedisQueue(QueueBackend):
                         return int(lag)
         except Exception:
             pass
-        return self.db.xlen(self.STREAM)
+        try:
+            return int(self.db.xlen(stream))
+        except Exception:
+            return 0
+
+    def pending_count(self) -> int:
+        return sum(self._stream_pending(self._lane_streams[lane])
+                   for lane in CRITICALITY_LANES)
 
     def consumer_pending(self) -> Dict[str, int]:
         """Per-consumer pending (claimed-not-yet-acked) counts, via XINFO
-        CONSUMERS. Group lag (:meth:`pending_count`) is the UNDELIVERED
-        backlog; this is the in-flight side — what each server instance
-        has claimed and not yet answered. The fleet router reads it as the
-        true per-instance queue depth a placement decision adds to.
-        Returns ``{}`` when the server/fake doesn't support the call."""
+        CONSUMERS, summed across the lane streams. Group lag
+        (:meth:`pending_count`) is the UNDELIVERED backlog; this is the
+        in-flight side — what each server instance has claimed and not yet
+        answered. The fleet router reads it as the true per-instance queue
+        depth a placement decision adds to. Returns ``{}`` when the
+        server/fake doesn't support the call."""
         out: Dict[str, int] = {}
-        try:
-            for c in self.db.xinfo_consumers(self.STREAM, self.GROUP):
+        ok = False
+        for lane in CRITICALITY_LANES:
+            try:
+                consumers = self.db.xinfo_consumers(
+                    self._lane_streams[lane], self.GROUP)
+            except Exception:
+                continue
+            ok = True
+            for c in consumers:
                 name = c.get("name")
                 if isinstance(name, bytes):
                     name = name.decode()
                 if name is None:
                     continue
-                out[str(name)] = int(c.get("pending") or 0)
-        except Exception:
-            return {}
-        return out
+                out[str(name)] = (out.get(str(name), 0)
+                                  + int(c.get("pending") or 0))
+        return out if ok else {}
 
     def trim(self, max_pending: int) -> int:
         before = self.pending_count()
-        self.db.xtrim(self.STREAM, maxlen=max_pending)
+        excess = before - max_pending
+        for lane in _SHED_ORDER:  # sheddable lanes absorb the cut first
+            if excess <= 0:
+                break
+            stream = self._lane_streams[lane]
+            depth = self._stream_pending(stream)
+            cut = min(excess, depth)
+            if cut > 0:
+                self.db.xtrim(stream, maxlen=depth - cut)
+                excess -= cut
         return max(0, before - self.pending_count())
 
     def shed(self, max_pending: int,
              reason: str = "shed: queue overloaded") -> List[str]:
         dropped: List[str] = []
         excess = self.pending_count() - max_pending
-        while excess > 0:
-            resp = self.db.xreadgroup(self.GROUP, self.consumer,
-                                      {self.STREAM: ">"}, count=excess,
-                                      block=10)
-            entries = [e for _, es in resp or [] for e in es]
-            if not entries:
-                break
-            for eid, fields in entries:
-                uri = fields[b"uri"].decode()
-                self.put_result(uri, {"error": reason})
-                self.db.xack(self.STREAM, self.GROUP, eid)
-                dropped.append(uri)
-            excess -= len(entries)
+        for lane in _SHED_ORDER:  # sheddable victims first, critical last
+            while excess > 0:
+                stream = self._lane_streams[lane]
+                resp = self.db.xreadgroup(self.GROUP, self.consumer,
+                                          {stream: ">"}, count=excess,
+                                          block=10)
+                entries = [e for _, es in resp or [] for e in es]
+                if not entries:
+                    break
+                for eid, fields in entries:
+                    uri = fields[b"uri"].decode()
+                    self.put_result(uri,
+                                    {"error": reason, "retriable": True})
+                    self.db.xack(stream, self.GROUP, eid)
+                    dropped.append(uri)
+                excess -= len(entries)
         return dropped
 
 

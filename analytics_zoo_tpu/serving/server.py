@@ -32,6 +32,7 @@ import numpy as np
 from ..common import faults, file_io
 from ..common import metrics as _metrics
 from ..common import profiler as _profiler
+from ..common.config import global_config
 from ..common.utils import time_it, wall_clock
 from ..inference.inference_model import InferenceModel
 from ..utils import trace as _trace
@@ -111,8 +112,82 @@ _M_SPEC_ACCEPT = _metrics.gauge(
     "serving.spec_accept_ratio",
     "Mean fraction of draft tokens accepted in the last verify round.",
     labels=("server",))
+_M_BROWNOUT = _metrics.gauge(
+    "serving.brownout_level",
+    "Current brownout degradation rung: 0=normal, 1=coarse streaming/wide "
+    "batch window, 2=half token budget, 3=quarter token budget "
+    "(docs/serving.md 'Overload survival').", labels=("server",))
 
 _instance_ids = itertools.count()
+
+
+class _Brownout:
+    """Hysteretic brownout ladder (docs/serving.md "Overload survival").
+
+    A feedback loop over the server's own pressure signal — queue fill
+    against the shed-allowed depth, and KV-page scarcity for paged
+    generative servers. ``tick(pressure)`` steps DOWN one rung whenever
+    pressure exceeds ``serving.brownout_high`` and back UP one rung only
+    after ``serving.brownout_hold_ticks`` consecutive ticks below
+    ``serving.brownout_low`` — asymmetric on purpose: degrade fast,
+    recover cautiously, never oscillate across a noisy boundary.
+
+    The rungs trade answer *quality* for answer *existence*:
+
+    - **L1** coarsens stream partials (4x ``stream_interval``) and widens
+      the one-shot micro-batch window (2x ``batch_wait_ms``) — fewer
+      queue writes and fuller batches at a small latency cost.
+    - **L2** additionally caps new streams' ``max_new_tokens`` at
+      2 x ``serving.brownout_token_frac`` of the configured budget and
+      widens the batch window to 4x.
+    - **L3** tightens the cap to ``serving.brownout_token_frac``.
+
+    Speculative depth and int8 paged KV are BUILD-TIME levers (the step
+    program and pool dtype are compiled/allocated at ``__init__``): an
+    operator browning out a fleet applies them via config + rolling
+    ``reload_model``, not live (see the docs table)."""
+
+    MAX_LEVEL = 3
+    #: batch-window multiplier per rung (one-shot micro-batching)
+    _WINDOW = (1, 2, 4, 4)
+    #: stream-partial stride multiplier per rung (generative)
+    _STRIDE = (1, 4, 4, 4)
+
+    def __init__(self):
+        cfg = global_config()
+        self.high = float(cfg.get("serving.brownout_high"))
+        self.low = float(cfg.get("serving.brownout_low"))
+        self.hold_ticks = int(cfg.get("serving.brownout_hold_ticks"))
+        self.token_frac = float(cfg.get("serving.brownout_token_frac"))
+        self.level = 0
+        self._calm = 0
+
+    def tick(self, pressure: float) -> int:
+        if pressure > self.high:
+            self._calm = 0
+            if self.level < self.MAX_LEVEL:
+                self.level += 1
+        elif pressure < self.low:
+            self._calm += 1
+            if self._calm >= self.hold_ticks and self.level > 0:
+                self.level -= 1
+                self._calm = 0
+        else:
+            self._calm = 0
+        return self.level
+
+    def token_cap(self, budget: int) -> int:
+        """Effective per-stream token budget at the current rung."""
+        if self.level < 2:
+            return budget
+        frac = self.token_frac * (2.0 if self.level == 2 else 1.0)
+        return max(1, min(budget, int(round(budget * frac))))
+
+    def batch_window_ms(self, base_ms: float) -> float:
+        return base_ms * self._WINDOW[self.level]
+
+    def stream_stride(self, base: int) -> int:
+        return base * self._STRIDE[self.level] if base > 0 else base
 
 
 def _model_version_of(path: Optional[str]) -> str:
@@ -175,6 +250,8 @@ class ClusterServing:
         self._m_depth = _M_QUEUE_DEPTH.labels(server=self.metrics_label)
         self._m_in_flight = _M_IN_FLIGHT.labels(server=self.metrics_label)
         self._m_claim_age = _M_CLAIM_AGE.labels(server=self.metrics_label)
+        self._m_brownout = _M_BROWNOUT.labels(server=self.metrics_label)
+        self._brownout = _Brownout()
         self._counter_lock = threading.Lock()
         self._in_flight = 0  # claimed, no terminal result yet
         #: uri -> (enqueue_t, trace_id) — latency base + flow-chain id
@@ -314,7 +391,14 @@ class ClusterServing:
 
     def _post_terminal(self, uri: str, value: Dict[str, Any]) -> None:
         """Every claimed request funnels its ONE terminal result (value or
-        error) through here — latency and in-flight accounting included."""
+        error) through here — latency and in-flight accounting included.
+        Error terminals are stamped ``retriable``: shed errors are (the
+        overload may clear), deadline/validation/shutdown are not — a
+        retry would burn the fleet's retry budget on a certain failure."""
+        if "error" in value and "retriable" not in value:
+            value = dict(value)
+            value["retriable"] = value["error"] in (SHED_ERROR,
+                                                    PAGE_SHED_ERROR)
         try:
             self.queue.put_result(uri, value)
         except Exception:
@@ -363,6 +447,15 @@ class ClusterServing:
         except OSError as e:
             logger.warning("shed pass failed (transient): %r", e)
             return
+        # brownout feedback rides the shed cadence: queue fill against the
+        # shed-allowed depth is the pressure signal (docs/serving.md)
+        try:
+            pending = self.queue.pending_count()
+        except Exception:
+            pending = None
+        fill = (pending / float(max(allowed, 1))
+                if pending is not None else 0.0)
+        self._m_brownout.set(self._brownout.tick(fill))
         if dropped:
             self._count("shed", len(dropped))
             logger.warning(
@@ -378,7 +471,10 @@ class ClusterServing:
         surface the backend as dead."""
         cfg = self.config
         self._shed()
-        deadline = time.monotonic() + cfg.batch_wait_ms / 1000.0
+        # brownout L1+: widen the micro-batch window — fuller batches
+        # amortize dispatch overhead exactly when the queue is deepest
+        wait_ms = self._brownout.batch_window_ms(cfg.batch_wait_ms)
+        deadline = time.monotonic() + wait_ms / 1000.0
         batch: List[Tuple[str, Dict[str, Any]]] = []
         while len(batch) < cfg.batch_size and time.monotonic() < deadline:
             try:
@@ -394,7 +490,11 @@ class ClusterServing:
                     raise  # dead backend, not a flaky one: surface it
                 logger.warning("transient claim failure (%d/%d): %r",
                                self._claim_fail_streak, cfg.claim_retries, e)
-                time.sleep(0.002)
+                # full-jitter backoff on the fail streak: N servers that
+                # all saw the same queue hiccup must not re-claim in
+                # lockstep (the retry-discipline lint polices this shape)
+                time.sleep(np.random.uniform(
+                    0.0, 0.002 * (2 ** min(self._claim_fail_streak, 6))))
                 continue
             if got:
                 self._last_claim_m = time.monotonic()
@@ -610,6 +710,7 @@ class ClusterServing:
             "records_served": self.records_served,
             "device_seconds": round(self.device_seconds, 4),
             "service_time_s_ewma": (round(ewma, 6) if ewma > 0 else None),
+            "brownout_level": self._brownout.level,
             "last_claim_age_s": claim_age,
             "latency_ms": {"p50": _pct(0.50), "p99": _pct(0.99),
                            "window": self._m_latency.count()},
@@ -1266,6 +1367,8 @@ class GenerativeServing:
         self._m_page_evict = _M_PAGE_EVICT.labels(server=self.metrics_label)
         self._m_spec_accept = _M_SPEC_ACCEPT.labels(
             server=self.metrics_label)
+        self._m_brownout = _M_BROWNOUT.labels(server=self.metrics_label)
+        self._brownout = _Brownout()
         if self._paged:
             self._m_pages_free.set(len(self._free_pages))
         self._counter_lock = threading.Lock()
@@ -1305,7 +1408,13 @@ class GenerativeServing:
 
     def _post_terminal(self, uri: str, value: Dict[str, Any]) -> None:
         """Every claimed request funnels its ONE terminal result (value or
-        error) through here — partial ``stream`` records do NOT."""
+        error) through here — partial ``stream`` records do NOT. Error
+        terminals carry ``retriable`` (shed yes; deadline/validation/
+        shutdown no) for the client's retry-budget discipline."""
+        if "error" in value and "retriable" not in value:
+            value = dict(value)
+            value["retriable"] = value["error"] in (SHED_ERROR,
+                                                    PAGE_SHED_ERROR)
         try:
             self.queue.put_result(uri, value)
         except Exception:
@@ -1473,8 +1582,11 @@ class GenerativeServing:
         self._last_shed_m = now
         cfg = self.config
         allowed = cfg.max_pending
+        # the brownout token cap shortens the estimated stream time, so a
+        # browned-out server ADMITS deeper queues instead of shedding them
+        eff_budget = self._brownout.token_cap(cfg.max_new_tokens)
         if cfg.shed_wait_ms and self._ewma_token_s > 0:
-            stream_s = cfg.max_new_tokens * self._ewma_token_s
+            stream_s = eff_budget * self._ewma_token_s
             allowed = min(allowed, max(
                 self.slots,
                 int(cfg.shed_wait_ms / 1000.0 / stream_s * self.slots)))
@@ -1483,6 +1595,19 @@ class GenerativeServing:
         except OSError as e:
             logger.warning("shed pass failed (transient): %r", e)
             return
+        # brownout feedback: pressure is the max of queue fill (against
+        # the shed-allowed depth) and KV-page scarcity (docs/serving.md)
+        try:
+            pending = self.queue.pending_count()
+        except Exception:
+            pending = None
+        fill = (pending / float(max(allowed, 1))
+                if pending is not None else 0.0)
+        scarcity = 0.0
+        if self._paged:
+            scarcity = 1.0 - (len(self._free_pages)
+                              / float(max(self.num_pages - 1, 1)))
+        self._m_brownout.set(self._brownout.tick(max(fill, scarcity)))
         if dropped:
             self._count("shed", len(dropped))
             logger.warning(
@@ -1639,6 +1764,11 @@ class GenerativeServing:
             self._count("errors")
             return False
         budget = int(rec.get("max_new_tokens") or cfg.max_new_tokens)
+        # brownout L2/L3: new streams join with a capped budget — shorter
+        # answers for everyone beat no answers for the queue tail. An
+        # adopted prefix that already exceeds the cap settles immediately
+        # (the prefix >= budget branch below).
+        budget = self._brownout.token_cap(budget)
         prompt = [int(x) for x in prompt]
         prefix = [int(x) for x in (rec.get("prefix") or [])]
         t = len(prompt)
@@ -1778,6 +1908,9 @@ class GenerativeServing:
         terminal value + evict on eos / budget exhaustion."""
         now = wall_clock()
         cfg = self.config
+        # brownout L1+: coarser partials — every queue write the streamers
+        # skip is backend bandwidth returned to terminals
+        stream_stride = self._brownout.stream_stride(cfg.stream_interval)
         finished = np.zeros(self.slots, bool)
         n_tok = 0
         for i in range(self.slots):
@@ -1795,9 +1928,9 @@ class GenerativeServing:
                 finished[i] = True
                 self._retire(i, {"value": list(self._tokens[i]),
                                  "done": True})
-            elif (cfg.stream_interval > 0
+            elif (stream_stride > 0
                   and (len(self._tokens[i]) - self._streamed[i]
-                       >= cfg.stream_interval)):
+                       >= stream_stride)):
                 try:
                     self.queue.put_result(self._uri[i], self._partial(i))
                     self._streamed[i] = len(self._tokens[i])
@@ -1830,6 +1963,9 @@ class GenerativeServing:
         never feeds another step."""
         now = wall_clock()
         cfg = self.config
+        # brownout L1+: coarser partials — every queue write the streamers
+        # skip is backend bandwidth returned to terminals
+        stream_stride = self._brownout.stream_stride(cfg.stream_interval)
         finished = np.zeros(self.slots, bool)
         n_tok = 0
         for i in range(self.slots):
@@ -1853,9 +1989,9 @@ class GenerativeServing:
                 finished[i] = True
                 self._retire(i, {"value": list(self._tokens[i]),
                                  "done": True})
-            elif (cfg.stream_interval > 0
+            elif (stream_stride > 0
                   and (len(self._tokens[i]) - self._streamed[i]
-                       >= cfg.stream_interval)):
+                       >= stream_stride)):
                 try:
                     self.queue.put_result(self._uri[i], self._partial(i))
                     self._streamed[i] = len(self._tokens[i])
@@ -2120,6 +2256,7 @@ class GenerativeServing:
             "spec_accept_ratio": (
                 round(float(self._m_spec_accept.value()), 4)
                 if self._spec else None),
+            "brownout_level": self._brownout.level,
             "last_claim_age_s": claim_age,
             "ttft_ms": {"p50": _pct(self._m_ttft, 0.50),
                         "p99": _pct(self._m_ttft, 0.99),

@@ -1532,6 +1532,161 @@ def bench_serving_slo(requests: int = 360, batch_size: int = 16):
                         "max_pending=4 batches"})
 
 
+def bench_serving_brownout(requests: int = 480, batch_size: int = 16):
+    """Overload survival tier end to end: one ClusterServing instance
+    driven at ~3x its measured capacity with a criticality-stamped mix
+    (30% critical / 30% default / 40% sheddable). The critical class
+    rides ResilientClient (retry budget + full-jitter backoff on
+    retriable terminals); the other lanes are enqueued open-loop and
+    absorb the sheds lane-priority-first. Reports critical-class goodput
+    (the headline), per-lane goodput, the peak brownout rung the
+    pressure controller reached, and the client's measured retry
+    amplification — gated on the exactly-one-terminal invariant before
+    any number is published (docs/serving.md "Overload survival")."""
+    import tempfile
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from analytics_zoo_tpu.common.context import init_tpu_context
+    from analytics_zoo_tpu.inference import InferenceModel
+    from analytics_zoo_tpu.serving import ClusterServing, ServingConfig
+    from analytics_zoo_tpu.serving.client import (InputQueue, OutputQueue,
+                                                  ResilientClient)
+
+    init_tpu_context()
+    im = InferenceModel(concurrent_num=2).load_jax(
+        lambda p, x: x.reshape(x.shape[0], -1).mean(1, keepdims=True), {})
+
+    class StallModel:
+        """Host stall dominates each batch so the overload phase outlives
+        the shed cadence on any machine (the fleet-bench trick) — without
+        it a fast CPU drains the whole ramp between two shed ticks and
+        the brownout/shed machinery never engages."""
+
+        STALL_S = 0.05
+
+        def predict(self, x):
+            time.sleep(self.STALL_S)
+            return im.predict(x)
+
+        def predict_async(self, x):
+            f = im.predict_async(x)
+
+            def fetch():
+                time.sleep(self.STALL_S)
+                return f()
+            return fetch
+
+    root = tempfile.mkdtemp(prefix="zoo_bench_brownout_")
+    src = f"dir://{root}"
+    cfg = ServingConfig(data_src=src, image_shape=(64,),
+                        batch_size=batch_size, batch_wait_ms=5,
+                        input_dtype="float32",
+                        max_pending=2 * batch_size,
+                        default_deadline_ms=2000,
+                        health_interval_s=0.1)
+    serving = ClusterServing(cfg, model=StallModel())
+    inq, outq = InputQueue(src), OutputQueue(src)
+    rs = np.random.RandomState(0)
+    vec = rs.rand(64).astype(np.float32)
+
+    # capacity probe: warm + measure the synchronous serve rate, one
+    # batch-sized wave at a time so the probe stays under max_pending
+    # (a shed probe record would never be "served" and the count-served
+    # loop below would spin forever)
+    def probe_wave(tag):
+        for i in range(batch_size):
+            inq.enqueue_tensor(f"probe{tag}-{i}", vec)
+        got = 0
+        while got < batch_size:
+            got += serving.serve_once()
+
+    probe_wave("warm")
+    t0 = time.perf_counter()
+    for w in range(3):
+        probe_wave(w)
+    cap_rps = 3 * batch_size / max(time.perf_counter() - t0, 1e-9)
+
+    def lane_of(i):
+        r = i % 10
+        return ("critical" if r < 3 else
+                "default" if r < 6 else "sheddable")
+
+    serving.start()
+    client = ResilientClient(src)
+    lanes = {"critical": [], "default": [], "sheddable": []}
+    answered, alock = {}, threading.Lock()
+
+    def call_critical(uri):
+        def enq(attempt_uri):
+            inq.enqueue_tensor(attempt_uri, vec, deadline_ms=2000,
+                               criticality="critical")
+        res = client.call(uri, enq, timeout_s=60.0)
+        with alock:
+            answered[uri] = res
+
+    peak_rung, sent = 0, 0
+    gap = batch_size / max(cap_rps * 3.0, 1.0)   # ~3x offered rate
+    t_ramp = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        while sent < requests:
+            for _ in range(min(batch_size, requests - sent)):
+                uri, lane = f"r{sent}", lane_of(sent)
+                lanes[lane].append(uri)
+                if lane == "critical":
+                    pool.submit(call_critical, uri)
+                else:
+                    inq.enqueue_tensor(uri, vec, deadline_ms=2000,
+                                       criticality=lane)
+                sent += 1
+            peak_rung = max(peak_rung,
+                            serving.health_snapshot()["brownout_level"])
+            time.sleep(gap)
+        # sequential long-polls: get_result is non-destructive, so the
+        # client threads' own polling is never robbed of a terminal
+        for lane in ("default", "sheddable"):
+            for uri in lanes[lane]:
+                answered[uri] = outq.query(uri, timeout_s=120)
+            peak_rung = max(peak_rung,
+                            serving.health_snapshot()["brownout_level"])
+    wall = time.perf_counter() - t_ramp
+    serving.drain(timeout_s=30)
+    snap = serving.health_snapshot()
+    missing = [u for us in lanes.values() for u in us
+               if answered.get(u) is None]
+    if missing:
+        raise RuntimeError(
+            f"overload invariant violated: {len(missing)} of {requests} "
+            f"requests never received a terminal result")
+    good = {lane: sum(1 for u in us if "value" in answered[u])
+            for lane, us in lanes.items()}
+    n_crit = len(lanes["critical"])
+    amp = client.attempts_sent / max(client.requests_sent, 1)
+    return _BenchResult(
+        metric="serving_brownout_critical_goodput",
+        value=round(good["critical"] / max(n_crit, 1), 4),
+        unit="ratio", mfu=None,
+        detail={"requests": requests, "batch_size": batch_size,
+                "capacity_records_per_sec": round(cap_rps, 1),
+                "offered": "~3x measured capacity, "
+                           "30/30/40 critical/default/sheddable",
+                "wall_records_per_sec": round(requests / wall, 1),
+                "goodput_critical": good["critical"],
+                "goodput_default": good["default"],
+                "goodput_sheddable": good["sheddable"],
+                "offered_critical": n_crit,
+                "peak_brownout_level": peak_rung,
+                "retry_amplification": round(amp, 3),
+                "shed_total": snap["counters"]["shed"],
+                "deadline_miss_total": snap["counters"]["expired"],
+                "terminal_state": snap["state"],
+                "note": "every request got exactly one terminal result "
+                        "(gated before publishing); sheds land on the "
+                        "sheddable lane first and the retry budget "
+                        "bounds amplification at 1 + "
+                        "client.retry_budget_ratio"})
+
+
 def _fleet_server_proc(root: str, name: str, stall_s: float,
                        batch_size: int, done_q):
     """Subprocess: one fleet instance — ClusterServing on its private
@@ -3067,6 +3222,7 @@ _WORKLOADS = {
     "eval": bench_eval,
     "serving": bench_serving,
     "serving_slo": bench_serving_slo,
+    "serving_brownout": bench_serving_brownout,
     "serving_fleet": bench_serving_fleet,
     "serving_fleet_redis": bench_serving_fleet_redis,
     "generate": bench_generate,
@@ -3343,6 +3499,28 @@ def _ratio_serving():
             "batch16_us_per_record": round(p16 * 1e6, 1),
             "batch16_vs_batch1_serving_ratio": round(p1 / max(p16, 1e-12),
                                                      2)}
+
+
+def _ratio_brownout():
+    """Retry-budget containment against a backend shedding 100% of
+    traffic: attempts per request under the token-bucket budget vs the
+    naive retry-N-times client — the overload tier's core bet that
+    retries can never become the overload they respond to."""
+    from analytics_zoo_tpu.serving.client import RetryBudget
+    n, retries = 400, 3
+    budget = RetryBudget(0.1)
+    budgeted = 0
+    for _ in range(n):
+        budgeted += 1            # the first attempt is always sent...
+        budget.deposit()         # ...and earns ratio tokens
+        for _ in range(retries):
+            if not budget.try_spend():
+                break
+            budgeted += 1
+    naive = n * (1 + retries)
+    return {"budgeted_attempts_per_request": round(budgeted / n, 3),
+            "naive_attempts_per_request": 1 + retries,
+            "naive_vs_budgeted_retry_ratio": round(naive / budgeted, 2)}
 
 
 def _ratio_obs():
@@ -4176,6 +4354,7 @@ _RATIO_IMPLS = {
     "dispatch": _ratio_dispatch,
     "eval": _ratio_eval,
     "serving": _ratio_serving,
+    "brownout": _ratio_brownout,
     "obs": _ratio_obs,
     "recovery": _ratio_recovery,
     "embed": _ratio_embed,
@@ -4204,6 +4383,7 @@ _RATIO_PLAN = {
     "eval": ("eval", "async_vs_sync_eval_ratio"),
     "serving": ("serving", "batch16_vs_batch1_serving_ratio"),
     "serving_slo": ("serving", "batch16_vs_batch1_serving_ratio"),
+    "serving_brownout": ("brownout", "naive_vs_budgeted_retry_ratio"),
     "serving_fleet": ("fleet", "routed3_vs_single_ratio"),
     "serving_fleet_redis": ("fleet_redis", "group3_vs_single_ratio"),
     "obs_overhead": ("obs", "enabled_vs_disabled_record_ratio"),

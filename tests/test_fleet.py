@@ -14,16 +14,20 @@ import numpy as np
 import pytest
 
 from analytics_zoo_tpu.common import faults
+from analytics_zoo_tpu.common.config import global_config
 from analytics_zoo_tpu.common.utils import wall_clock
 from analytics_zoo_tpu.serving import (FleetInstance, FleetRouter,
                                        GenerativeServing, ServingConfig)
-from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
-from analytics_zoo_tpu.serving.fleet import (FLEET_SHED_ERROR,
+from analytics_zoo_tpu.serving.client import (InputQueue, OutputQueue,
+                                              ResilientClient)
+from analytics_zoo_tpu.serving.fleet import (BREAKER_CLOSED,
+                                             BREAKER_HALF_OPEN, BREAKER_OPEN,
+                                             FLEET_SHED_ERROR, _Breaker,
                                              _score_instances,
                                              instance_queue, read_health)
 from analytics_zoo_tpu.serving import fleet as _fleet
-from analytics_zoo_tpu.serving.queues import FileQueue
-from analytics_zoo_tpu.serving.server import DEADLINE_ERROR
+from analytics_zoo_tpu.serving.queues import FileQueue, RedisQueue
+from analytics_zoo_tpu.serving.server import DEADLINE_ERROR, SHED_ERROR
 
 from tests.test_generative_serving import _drive, _lm, _src
 
@@ -406,3 +410,362 @@ class TestContinuationOnFailover:
             res = front.get_result(f"m{i}")
             assert res is not None and res.get("done") is True, f"m{i}"
             assert res["value"] == w, f"stream m{i} diverged"
+
+
+class TestCircuitBreaker:
+    """Per-instance breakers (docs/fleet.md "Overload survival"): error
+    streaks and persistent latency outliers trip an instance OPEN, a
+    cooldown later exactly ONE half-open probe decides whether it rejoins
+    the fleet — all while the router parks (never loses) unplaceable
+    work."""
+
+    def test_unit_trip_halfopen_probe_close(self):
+        br = _Breaker(failures=3, latency_ratio=4.0, cooldown_s=10.0)
+        now = 100.0
+        br.record_result("u0", True, now)
+        br.record_result("u1", False, now)  # a success resets the streak
+        br.record_result("u2", True, now)
+        br.record_result("u3", True, now)
+        assert br.state == BREAKER_CLOSED
+        br.record_result("u4", True, now)   # third consecutive error
+        assert br.state == BREAKER_OPEN
+        assert not br.placeable(now + 9.9)      # still cooling down
+        assert br.placeable(now + 10.0)         # cooldown over -> half-open
+        assert br.state == BREAKER_HALF_OPEN
+        br.note_placed("probe")
+        assert not br.placeable(now + 11.0)     # one probe at a time
+        # a stale non-probe terminal arriving now must not move the machine
+        br.record_result("bystander", True, now + 11.0)
+        assert br.state == BREAKER_HALF_OPEN
+        br.record_result("probe", False, now + 12.0)
+        assert br.state == BREAKER_CLOSED
+
+    def test_unit_failed_probe_reopens(self):
+        br = _Breaker(failures=1, latency_ratio=4.0, cooldown_s=5.0)
+        br.record_result("u0", True, 0.0)
+        assert br.state == BREAKER_OPEN
+        assert br.placeable(5.0)
+        br.note_placed("probe")
+        br.record_result("probe", True, 6.0)
+        assert br.state == BREAKER_OPEN         # re-opened: fresh cooldown
+        assert not br.placeable(10.9)
+        assert br.placeable(11.0)               # measured from the re-open
+
+    def test_unit_latency_trip_needs_persistence(self):
+        br = _Breaker(failures=3, latency_ratio=4.0, cooldown_s=1.0)
+        br.record_latency(0.5, 0.1, 0.0)
+        br.record_latency(0.5, 0.1, 0.0)
+        br.record_latency(0.01, 0.1, 0.0)  # one healthy refresh resets
+        br.record_latency(0.5, 0.1, 0.0)
+        br.record_latency(0.5, 0.1, 0.0)
+        assert br.state == BREAKER_CLOSED
+        br.record_latency(0.5, 0.1, 0.0)   # third consecutive slow refresh
+        assert br.state == BREAKER_OPEN
+        # a zero fleet median (empty/cold fleet) never trips anyone
+        br2 = _Breaker(failures=1, latency_ratio=4.0, cooldown_s=1.0)
+        br2.record_latency(99.0, 0.0, 0.0)
+        assert br2.state == BREAKER_CLOSED
+
+    def _one_instance_router(self, tmp_path):
+        root = str(tmp_path / "fleet")
+        front = FileQueue(root)
+        hp = str(tmp_path / "a.json")
+        _write_health(hp)
+        inst = FleetInstance("a", instance_queue(root, "a"), hp)
+        return front, inst, _router(front, [inst])
+
+    def _req(self, front, uri):
+        front.enqueue(uri, {"uri": uri, "tensor": [1],
+                            "enqueue_t": wall_clock()})
+
+    def test_error_streak_trips_and_clean_probe_closes(self, tmp_path):
+        cfg = global_config()
+        cfg.set("fleet.breaker_cooldown_s", 0.05)
+        try:
+            front, inst, router = self._one_instance_router(tmp_path)
+            for i in range(3):
+                self._req(front, f"r{i}")
+            assert router.route_once() == 3
+            # the "server" claims the spool and answers every one with an
+            # error: three settled failures in a row trip the breaker
+            assert len(inst.queue.claim_batch(10)) == 3
+            for i in range(3):
+                inst.queue.put_result(f"r{i}",
+                                      {"error": "predict failed: boom"})
+            router.route_once()
+            assert router.breaker_states()["a"] == BREAKER_OPEN
+            # while OPEN: nothing places, work parks, the counter ticks
+            nc0 = int(_fleet._M_NO_CAPACITY.value())
+            self._req(front, "r3")
+            assert router.route_once() == 0
+            assert router.stats["backlog"] == 1
+            assert int(_fleet._M_NO_CAPACITY.value()) > nc0
+            assert inst.queue.pending_count() == 0
+            time.sleep(0.08)                     # past the cooldown
+            assert router.route_once() == 1      # half-open: ONE probe
+            assert router.breaker_states()["a"] == BREAKER_HALF_OPEN
+            assert inst.queue.pending_count() == 1
+            # a second request must NOT ride the outstanding probe
+            self._req(front, "r4")
+            assert router.route_once() == 0
+            assert router.stats["backlog"] == 1
+            # the probe comes back clean -> the breaker closes and the
+            # parked request is re-placed on the next passes
+            assert len(inst.queue.claim_batch(10)) == 1
+            inst.queue.put_result("r3", {"value": [1]})
+            placed = 0
+            for _ in range(3):
+                placed += router.route_once()
+            assert router.breaker_states()["a"] == BREAKER_CLOSED
+            assert placed == 1 and router.stats["backlog"] == 0
+            assert inst.queue.pending_count() == 1
+        finally:
+            cfg.unset("fleet.breaker_cooldown_s")
+
+    def test_failed_probe_reopens_router_breaker(self, tmp_path):
+        cfg = global_config()
+        cfg.set("fleet.breaker_cooldown_s", 0.05)
+        cfg.set("fleet.breaker_failures", 1)
+        try:
+            front, inst, router = self._one_instance_router(tmp_path)
+            self._req(front, "r0")
+            assert router.route_once() == 1
+            assert len(inst.queue.claim_batch(10)) == 1
+            inst.queue.put_result("r0", {"error": "predict failed: boom"})
+            router.route_once()
+            assert router.breaker_states()["a"] == BREAKER_OPEN
+            time.sleep(0.08)
+            self._req(front, "r1")
+            for _ in range(3):
+                if router.breaker_states()["a"] == BREAKER_HALF_OPEN:
+                    break
+                router.route_once()
+            assert router.breaker_states()["a"] == BREAKER_HALF_OPEN
+            assert len(inst.queue.claim_batch(10)) == 1
+            inst.queue.put_result("r1", {"error": "predict failed: again"})
+            router.route_once()
+            assert router.breaker_states()["a"] == BREAKER_OPEN
+        finally:
+            cfg.unset("fleet.breaker_cooldown_s")
+            cfg.unset("fleet.breaker_failures")
+
+    def test_flag_fault_trips_instance_and_traffic_avoids_it(self,
+                                                            tmp_path):
+        root = str(tmp_path / "fleet")
+        front = FileQueue(root)
+        insts = []
+        for name in ("a", "b"):
+            hp = str(tmp_path / f"{name}.json")
+            _write_health(hp)
+            insts.append(FleetInstance(name, instance_queue(root, name),
+                                       hp))
+        router = _router(front, insts)
+        # the chaos site force-opens the FIRST instance refreshed; traffic
+        # must flow around it without a single lost or parked request
+        faults.arm("fleet.breaker", p=1.0, budget=1)
+        front.enqueue("r0", {"uri": "r0", "tensor": [1],
+                             "enqueue_t": wall_clock()})
+        assert router.route_once() == 1
+        states = router.breaker_states()
+        assert states["a"] == BREAKER_OPEN
+        assert states["b"] == BREAKER_CLOSED
+        assert insts[0].queue.pending_count() == 0
+        assert insts[1].queue.pending_count() == 1
+        assert faults.fire_count("fleet.breaker") == 1
+
+    def test_all_breakers_open_parks_never_raises(self, tmp_path):
+        cfg = global_config()
+        cfg.set("fleet.breaker_cooldown_s", 30.0)
+        try:
+            front, inst, router = self._one_instance_router(tmp_path)
+            faults.arm("fleet.breaker", p=1.0, budget=1)
+            nc0 = int(_fleet._M_NO_CAPACITY.value())
+            self._req(front, "r0")
+            assert router.route_once() == 0
+            assert router.stats["backlog"] == 1
+            assert int(_fleet._M_NO_CAPACITY.value()) == nc0 + 1
+            # stop() returns the parked request to the front queue
+            router.stop()
+            assert front.pending_count() == 1
+        finally:
+            cfg.unset("fleet.breaker_cooldown_s")
+
+
+class TestCriticalityLanes:
+    """Admission classes ride priority lanes end to end: claims drain
+    critical -> default -> sheddable (FIFO within a lane), and shed
+    consumes the lanes in REVERSE — on both queue backends."""
+
+    LOAD = (("s0", "sheddable"), ("d1", "default"), ("c2", "critical"),
+            ("s3", "sheddable"), ("d4", "default"), ("c5", "critical"))
+
+    def _load(self, q):
+        for uri, lane in self.LOAD:
+            q.enqueue(uri, {"tensor": [1], "criticality": lane})
+
+    def _redis_queue(self):
+        from tests.test_redis_serving import FakeRedis
+        FakeRedis.instances.clear()
+        return RedisQueue(client=FakeRedis("lanes-test", 1, 0))
+
+    def test_file_queue_claim_priority_order(self, tmp_path):
+        q = FileQueue(str(tmp_path / "q"))
+        self._load(q)
+        assert [u for u, _ in q.claim_batch(10)] == [
+            "c2", "c5", "d1", "d4", "s0", "s3"]
+
+    def test_file_queue_sheds_sheddable_first(self, tmp_path):
+        q = FileQueue(str(tmp_path / "q"))
+        self._load(q)
+        assert sorted(q.shed(4)) == ["s0", "s3"]
+        res = q.get_result("s0")
+        assert res["error"] == SHED_ERROR and res["retriable"] is True
+        assert sorted(q.shed(2)) == ["d1", "d4"]
+        # the critical class is the last to lose work
+        assert [u for u, _ in q.claim_batch(10)] == ["c2", "c5"]
+
+    def test_redis_queue_claim_priority_order(self):
+        q = self._redis_queue()
+        self._load(q)
+        assert q.pending_count() == 6
+        assert [u for u, _ in q.claim_batch(10)] == [
+            "c2", "c5", "d1", "d4", "s0", "s3"]
+
+    def test_redis_queue_sheds_sheddable_first(self):
+        q = self._redis_queue()
+        self._load(q)
+        assert sorted(q.shed(4)) == ["s0", "s3"]
+        res = q.get_result("s0")
+        assert res["error"] == SHED_ERROR and res["retriable"] is True
+        assert sorted(q.shed(2)) == ["d1", "d4"]
+        assert [u for u, _ in q.claim_batch(10)] == ["c2", "c5"]
+
+    def test_unknown_criticality_degrades_to_default(self, tmp_path):
+        q = FileQueue(str(tmp_path / "q"))
+        q.enqueue("x0", {"tensor": [1], "criticality": "page-me-at-3am"})
+        q.enqueue("c1", {"tensor": [1], "criticality": "critical"})
+        assert [u for u, _ in q.claim_batch(10)] == ["c1", "x0"]
+
+
+class TestClientResilience:
+    """ResilientClient: budgeted, jittered retries keyed on the terminal's
+    ``retriable`` flag; hedged queries that surface exactly one terminal;
+    and the bounded-retry stance on transient result-store errors."""
+
+    def _client(self, tmp_path, **kw):
+        kw.setdefault("backoff_s", 0.001)
+        return ResilientClient(str(tmp_path / "q"), **kw)
+
+    def test_retriable_shed_is_retried_to_success(self, tmp_path):
+        client = self._client(tmp_path)
+        q = client.outputs.queue
+        sent = []
+
+        def enqueue(uri):
+            sent.append(uri)
+            if len(sent) == 1:
+                q.put_result(uri, {"error": SHED_ERROR, "retriable": True})
+            else:
+                q.put_result(uri, {"value": [7]})
+
+        res = client.call("u0", enqueue, timeout_s=5.0)
+        assert res["value"] == [7]
+        assert sent == ["u0", "u0~r1"]  # fresh uri per attempt
+        assert client.requests_sent == 1 and client.attempts_sent == 2
+
+    def test_non_retriable_error_returns_immediately(self, tmp_path):
+        client = self._client(tmp_path)
+        q = client.outputs.queue
+        sent = []
+
+        def enqueue(uri):
+            sent.append(uri)
+            q.put_result(uri, {"error": DEADLINE_ERROR, "retriable": False})
+
+        res = client.call("u1", enqueue, timeout_s=5.0)
+        assert res["error"] == DEADLINE_ERROR
+        assert sent == ["u1"] and client.attempts_sent == 1
+
+    def test_retry_budget_bounds_amplification(self, tmp_path):
+        client = self._client(tmp_path, budget_ratio=0.1, attempts=3,
+                              backoff_s=0.0)
+        q = client.outputs.queue
+
+        def always_shed(uri):
+            q.put_result(uri, {"error": SHED_ERROR, "retriable": True})
+
+        for i in range(30):
+            res = client.call(f"u{i}", always_shed, timeout_s=2.0)
+            assert res["error"] == SHED_ERROR
+        # 100% shed is the worst case: the token bucket caps retries at
+        # ratio x offered load (+ the single bootstrap token)
+        assert client.requests_sent == 30
+        assert client.attempts_sent <= 30 + int(30 * 0.1) + 1
+
+    def test_hedged_query_exactly_one_terminal(self, tmp_path):
+        client = self._client(tmp_path)
+        q = client.outputs.queue
+        sent = []
+
+        def enqueue(uri):
+            sent.append(uri)
+            if uri.endswith("~h"):
+                q.put_result(uri, {"value": [42]})  # the hedge answers
+
+        res = client.query_any("h0", enqueue, timeout_s=5.0,
+                               hedge_delay_s=0.01)
+        assert res["value"] == [42]
+        assert sent == ["h0", "h0~h"]
+        assert client.requests_sent == 1 and client.attempts_sent == 2
+        # the losing copy lands late: reaped, never surfaced, no leak
+        q.put_result("h0", {"value": [41]})
+        assert client.reap_pending() == 1
+        assert q.get_result("h0") is None
+
+    def test_hedge_not_sent_when_primary_is_fast(self, tmp_path):
+        client = self._client(tmp_path)
+        q = client.outputs.queue
+        sent = []
+
+        def enqueue(uri):
+            sent.append(uri)
+            q.put_result(uri, {"value": [1]})
+
+        res = client.query_any("p0", enqueue, timeout_s=5.0,
+                               hedge_delay_s=0.25)
+        assert res["value"] == [1]
+        assert sent == ["p0"] and client.attempts_sent == 1
+
+    def test_output_query_absorbs_transient_errors(self, tmp_path,
+                                                   monkeypatch):
+        cfg = global_config()
+        cfg.set("failure.io_retries", 3)
+        cfg.set("failure.io_backoff_s", 0.001)
+        try:
+            out = OutputQueue(str(tmp_path / "q"))
+            out.queue.put_result("u0", {"value": [1]})
+            real = out.queue.get_result
+            calls = {"n": 0}
+
+            def flaky(uri):
+                calls["n"] += 1
+                if calls["n"] <= 2:
+                    raise OSError("transient backend hiccup")
+                return real(uri)
+
+            monkeypatch.setattr(out.queue, "get_result", flaky)
+            assert out.query("u0", timeout_s=2.0)["value"] == [1]
+            assert calls["n"] == 3
+        finally:
+            cfg.unset("failure.io_retries")
+            cfg.unset("failure.io_backoff_s")
+
+    def test_output_query_fatal_error_raises(self, tmp_path, monkeypatch):
+        out = OutputQueue(str(tmp_path / "q"))
+
+        def denied(uri):
+            raise PermissionError("result store acl")
+
+        monkeypatch.setattr(out.queue, "get_result", denied)
+        with pytest.raises(PermissionError):
+            out.query("u0", timeout_s=0.5)

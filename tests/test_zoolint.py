@@ -23,7 +23,8 @@ from analytics_zoo_tpu.lint.passes import hot_path, jit_boundary
 REPO_ROOT = core.REPO_ROOT
 
 ALL_PASS_IDS = {"config-keys", "fault-sites", "hot-path-sync",
-                "jit-host-sync", "metric-names", "monotonic-clock"}
+                "jit-host-sync", "metric-names", "monotonic-clock",
+                "retry-discipline"}
 
 
 def _seed(tmp_path, files):
@@ -211,6 +212,63 @@ def test_monotonic_clock_catches_mixed_domain_arithmetic(tmp_path):
     assert [f.line for f in res.findings] == [6, 10]
     assert all("mixes monotonic- and wall-clock" in f.message
                for f in res.findings)
+
+
+# -- seeded violations: retry-discipline -------------------------------------
+
+def test_retry_discipline_catches_seeded_storms(tmp_path):
+    proj = _seed(tmp_path, {"rpc.py": (
+        "import time\n"
+        "\n"
+        "\n"
+        "def poll(fetch):\n"
+        "    for _ in range(5):\n"
+        "        try:\n"
+        "            return fetch()\n"
+        "        except OSError:\n"
+        "            time.sleep(0.05)\n"
+        "    raise TimeoutError\n"
+        "\n"
+        "\n"
+        "def forever(fetch):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            fetch()\n"
+        "        except OSError:\n"
+        "            pass\n")})
+    res = run_passes(proj, ids=["retry-discipline"])
+    by_line = {f.line: f.message for f in res.findings}
+    assert "fixed (unjittered) retry delay" in by_line[9]
+    assert "unbounded `while True` retry loop" in by_line[14]
+    assert len(res.findings) == 2
+
+
+def test_retry_discipline_accepts_jittered_bounded_retries(tmp_path):
+    """The reference shape — computed full-jitter backoff inside a
+    bounded loop, and a ``while True`` that escapes via return/raise —
+    stays clean; so does a sleep whose delay is computed, not constant."""
+    proj = _seed(tmp_path, {"rpc.py": (
+        "import random\n"
+        "import time\n"
+        "\n"
+        "\n"
+        "def call(fetch, attempts=3, base=0.05):\n"
+        "    for attempt in range(attempts):\n"
+        "        try:\n"
+        "            return fetch()\n"
+        "        except OSError:\n"
+        "            time.sleep(random.uniform(0.0, base * 2 ** attempt))\n"
+        "    raise TimeoutError\n"
+        "\n"
+        "\n"
+        "def drain(fetch):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return fetch()\n"
+        "        except KeyboardInterrupt:\n"
+        "            raise\n")})
+    res = run_passes(proj, ids=["retry-discipline"])
+    assert res.clean, "\n".join(f.text() for f in res.findings)
 
 
 # -- suppression machinery ----------------------------------------------------
