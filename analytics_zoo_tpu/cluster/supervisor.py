@@ -59,6 +59,9 @@ from ..common import faults
 from ..common import metrics as _metrics
 from ..common.config import global_config
 from ..common.utils import wall_clock
+from ..ops import alerts as ops_alerts
+from ..ops import events as ops_events
+from ..ops import incident as ops_incident
 from .launcher import WorkerResult, _free_port
 
 logger = logging.getLogger("analytics_zoo_tpu.cluster")
@@ -77,6 +80,23 @@ _M_SCALE_EVENTS = _metrics.counter(
     "Fleet supervisor actuations: server subprocesses spawned (out) or "
     "drained (in) to track fleet.desired_instances.",
     labels=("direction",))
+
+#: ops-plane event types (docs/observability.md "Ops plane")
+_E_RESTART = ops_events.event_type(
+    "cluster.restart",
+    "Elastic pod-generation restart (reason=exit|lease|respawn, "
+    "generation).")
+_E_LEASE = ops_events.event_type(
+    "cluster.lease_expired",
+    "A worker's membership lease expired with the process still alive "
+    "(hung host); the rank was SIGKILLed.")
+_E_HANDOFF = ops_events.event_type(
+    "cluster.handoff",
+    "A fresh coordinator address was published through the coord-file "
+    "handoff for the next pod generation.")
+_E_SCALE = ops_events.event_type(
+    "fleet.scale",
+    "Fleet supervisor actuation (direction=out|in, label=instance).")
 
 
 # -- membership store ---------------------------------------------------------
@@ -367,6 +387,7 @@ class ElasticSupervisor:
                         results)
                 restarts += 1
                 _M_RESTARTS.labels(reason="respawn").inc()
+                _E_RESTART.emit(reason="respawn", generation=generation)
                 logger.warning(
                     "generation %d spawn failed (injected); retrying "
                     "after %.2fs (%d/%d restarts)", generation,
@@ -403,6 +424,7 @@ class ElasticSupervisor:
                     f"({reason})\n{tails}", results)
             restarts += 1
             _M_RESTARTS.labels(reason=reason).inc()
+            _E_RESTART.emit(reason=reason, generation=generation)
             logger.warning(
                 "generation %d lost a worker (%s); respawning generation "
                 "%d after %.2fs (%d/%d restarts)", generation, reason,
@@ -422,6 +444,7 @@ class ElasticSupervisor:
         with open(tmp, "w") as f:
             json.dump({"coord": coord, "generation": generation}, f)
         os.replace(tmp, coord_file)
+        _E_HANDOFF.emit(coordinator=coord, generation=generation)
 
         log_dir = os.path.join(workdir, "logs")
         os.makedirs(log_dir, exist_ok=True)
@@ -479,6 +502,7 @@ class ElasticSupervisor:
             _M_LEASES.set(tracker.alive())
             hung = [r for r in expired if rcs[r] is None]
             for rank in hung:
+                _E_LEASE.emit(rank=rank, generation=generation)
                 logger.warning(
                     "rank %d lease expired with the process still alive "
                     "(hung host) — SIGKILL pid %d", rank,
@@ -608,6 +632,19 @@ class FleetSupervisor:
     def alive_count(self) -> int:
         return sum(1 for p in self._procs.values() if p.is_alive())
 
+    def status(self) -> Dict[str, Any]:
+        """Supervisor-side operational status: fleet shape plus the ops
+        plane's active alert/incident state, the same stamp servers put
+        in ``health.json`` so every ``read_health()``-style consumer
+        sees it."""
+        return {
+            "instances": self.instance_names(),
+            "alive": self.alive_count(),
+            "draining": sorted(self._draining),
+            "alerts": sorted(ops_alerts.active_alerts()),
+            "incident": ops_incident.last_incident(),
+        }
+
     # -- actuation --------------------------------------------------------
 
     def step(self) -> Optional[str]:
@@ -638,6 +675,7 @@ class FleetSupervisor:
             if name is None:
                 return None
             _M_SCALE_EVENTS.labels(direction="out").inc()
+            _E_SCALE.emit(label=name, direction="out")
             logger.info("fleet scale-out: %s (%d -> %d)", name, live,
                         live + 1)
             return f"out:{name}"
@@ -647,6 +685,7 @@ class FleetSupervisor:
         with open(os.path.join(self.root, f"DRAIN_{name}"), "w") as f:
             f.write("1")
         _M_SCALE_EVENTS.labels(direction="in").inc()
+        _E_SCALE.emit(label=name, direction="in")
         logger.info("fleet scale-in: draining %s (%d -> %d)", name, live,
                     live - 1)
         return f"in:{name}"

@@ -421,3 +421,41 @@ _global_config.register("fleet.breaker_latency_ratio", 4.0,
 _global_config.register("fleet.breaker_cooldown_s", 1.0,
                         "Seconds an open breaker holds before moving to "
                         "half-open and admitting one probe placement.")
+_global_config.register("ops.enabled", False,
+                        "Master switch for the ops plane (structured "
+                        "event log, metric history sampler, SLO alert "
+                        "engine). Off by default: a disabled plane costs "
+                        "one boolean check per would-be event and "
+                        "nothing per step (docs/observability.md"
+                        "#ops-plane).")
+_global_config.register("ops.dir", "",
+                        "Shared event-spool directory for the structured "
+                        "event log. Point every process of a fleet "
+                        "(supervisor, servers, forked workers) at the "
+                        "same path so the incident CLI reads one story; "
+                        "empty = a private temp spool per creating "
+                        "process.")
+_global_config.register("ops.ring_events", 2048,
+                        "Capacity of the per-process in-memory event ring "
+                        "(EventLog.tail) — bounds memory regardless of "
+                        "run length; the JSONL spool on disk is the "
+                        "unbounded record.")
+_global_config.register("ops.sample_interval_s", 0.25,
+                        "Cadence of the metric history sampler thread "
+                        "snapshotting the shm registry into per-series "
+                        "rings.")
+_global_config.register("ops.history_depth", 512,
+                        "Samples retained per (metric, label) series in "
+                        "the history rings — memory is bounded by "
+                        "series x depth (at the default cadence, ~2 "
+                        "minutes of history).")
+_global_config.register("ops.eval_interval_s", 0.5,
+                        "Cadence of the SLO alert engine's evaluation "
+                        "pass over the metric history.")
+_global_config.register("ops.incident_dir", "",
+                        "Directory incident bundles are sealed into; "
+                        "empty = an 'incidents/' subdirectory of the "
+                        "event spool.")
+_global_config.register("ops.incident_window_s", 60.0,
+                        "Trailing window of events and metric history "
+                        "frozen into each incident bundle.")

@@ -179,6 +179,20 @@ _M_FIRED = _metrics.counter(
     labels=("site",))
 
 
+def _emit_fired_event(site: str) -> None:
+    """Mirror a fault firing into the ops-plane event log so chaos
+    injections interleave with the transitions they caused on incident
+    timelines. Lazy import: ``..ops`` pulls the jax-heavy kernel package,
+    and faults must stay importable everywhere."""
+    try:
+        from ..ops import events as ops_events
+        ops_events.event_type(
+            "fault.fired",
+            "A fault-injection site fired (site).").emit(site=site)
+    except Exception:
+        pass  # chaos telemetry must never break the injected path
+
+
 class _Rule:
     """One armed schedule for one site. Budget and fire counters live in
     shared memory so fork-inherited copies (worker children) coordinate
@@ -216,6 +230,7 @@ class _Rule:
         with self.fired.get_lock():
             self.fired.value += 1
         _M_FIRED.labels(site=self.site).inc()
+        _emit_fired_event(self.site)
         return True
 
 

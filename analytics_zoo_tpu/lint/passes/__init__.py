@@ -3,8 +3,10 @@ with :func:`analytics_zoo_tpu.lint.core.register_pass`; third-party or
 repo-local passes can do the same — subclass ``LintPass``, decorate with
 ``@register_pass``, and import the module before calling ``run_passes``.
 """
-from . import (config_keys, fault_sites, hot_path, jit_boundary,  # noqa: F401
-               metric_names, monotonic_clock, retry_discipline)
+from . import (config_keys, event_names, fault_sites,  # noqa: F401
+               hot_path, jit_boundary, metric_names, monotonic_clock,
+               retry_discipline)
 
-__all__ = ["config_keys", "fault_sites", "hot_path", "jit_boundary",
-           "metric_names", "monotonic_clock", "retry_discipline"]
+__all__ = ["config_keys", "event_names", "fault_sites", "hot_path",
+           "jit_boundary", "metric_names", "monotonic_clock",
+           "retry_discipline"]

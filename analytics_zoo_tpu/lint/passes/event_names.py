@@ -1,0 +1,153 @@
+"""zoolint pass ``event-names``: ops-plane event types stay canonical.
+
+Mirror of ``metric-names`` for the structured event log
+(``analytics_zoo_tpu/ops/events.py``). Incident timelines are only
+readable if event types don't rot: a type registered twice makes two
+modules claim the same transition, an off-convention name breaks every
+``subsystem.*`` timeline filter, and an undocumented type is invisible
+to whoever reads the bundle. Rules:
+
+1. every registration call (``events.event_type(...)`` on an events-
+   module alias) passes a string LITERAL name — a computed name defeats
+   both this lint and grep;
+2. every event type is registered exactly ONCE across the codebase — one
+   transition, one owning module;
+3. names follow the ``subsystem.noun`` convention (lower_snake, one
+   dot), the same shape the metric plane uses;
+4. every registered type is documented in the event table of
+   ``docs/observability.md`` (the operator's timeline vocabulary).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+from ..core import (Finding, LintPass, Project, REPO_ROOT, get_project,
+                    register_pass)
+
+_DOCS = os.path.join(REPO_ROOT, "docs", "observability.md")
+
+#: ops/events.py itself is excluded (it defines the registry and calls
+#: ``event_type`` in its own doctests/plumbing)
+_EXCLUDE = (os.path.join("ops", "events.py"),)
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9]*\.[a-z][a-z0-9_]*$")
+
+
+def _is_registration(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "event_type"
+            and isinstance(f.value, ast.Name)
+            and (f.value.id == "events" or f.value.id.endswith("_events")))
+
+
+def registrations(project=None) -> Tuple[Dict[str, List[str]],
+                                         List[Tuple[str, int, str]]]:
+    """``{name: [file:line, ...]}`` over all scanned files, plus
+    violations for non-literal name arguments."""
+    project = project if project is not None else get_project()
+    regs: Dict[str, List[str]] = {}
+    bad: List[Tuple[str, int, str]] = []
+    files = project.package_files()
+    if os.path.exists(project.bench_file()):
+        files = files + [project.bench_file()]
+    for path in sorted(files):
+        rel = os.path.relpath(path, project.root)
+        if any(rel.endswith(e) for e in _EXCLUDE):
+            continue
+        tree = project.ast_for(path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and _is_registration(node)):
+                continue
+            if (not node.args
+                    or not isinstance(node.args[0], ast.Constant)
+                    or not isinstance(node.args[0].value, str)):
+                bad.append((path, node.lineno,
+                            "event type name must be one string literal"))
+                continue
+            regs.setdefault(node.args[0].value, []).append(
+                f"{rel}:{node.lineno}")
+    return regs, bad
+
+
+def undocumented(names, docs_path: str = _DOCS) -> List[str]:
+    """Registered types with no `` `name` `` mention in the docs."""
+    try:
+        with open(docs_path) as fh:
+            text = fh.read()
+    except OSError:
+        return sorted(names)
+    return sorted(n for n in names if f"`{n}`" not in text)
+
+
+def _locate(regs: Dict[str, List[str]], name: str,
+            root: str) -> Tuple[str, int]:
+    rel, _, line = regs[name][0].rpartition(":")
+    return os.path.join(root, rel), int(line)
+
+
+def check() -> List[str]:
+    """Human-readable violations; empty = clean."""
+    return [f.message for f in findings()]
+
+
+def findings(project=None) -> List[Finding]:
+    project = project if project is not None else get_project()
+    root = project.root
+    regs, bad = registrations(project)
+    out: List[Finding] = []
+    for p, line, what in bad:
+        out.append(Finding(p, line, EventNamesPass.id,
+                           f"{os.path.relpath(p, root)}:{line}: {what}",
+                           "pass the event type name as one string literal"))
+    for name, places in sorted(regs.items()):
+        path, line = _locate(regs, name, root)
+        if len(places) > 1:
+            out.append(Finding(
+                path, line, EventNamesPass.id,
+                f"event type {name!r} registered at {len(places)} sites "
+                f"({', '.join(places)}); each type must be registered "
+                f"exactly once",
+                "keep one owning module per event type"))
+        if not _NAME_RE.match(name):
+            out.append(Finding(
+                path, line, EventNamesPass.id,
+                f"event type {name!r} ({places[0]}) breaks the "
+                f"'subsystem.noun' convention (lower_snake, one dot)",
+                "rename to subsystem.noun"))
+    docs = os.path.join(root, "docs", "observability.md")
+    for name in undocumented(regs, docs):
+        path, line = _locate(regs, name, root)
+        out.append(Finding(
+            path, line, EventNamesPass.id,
+            f"event type {name!r} is registered but undocumented — add a "
+            f"row to the event table in docs/observability.md",
+            "document every event type a timeline can contain"))
+    return out
+
+
+@register_pass
+class EventNamesPass(LintPass):
+    id = "event-names"
+    title = "event-log type naming/uniqueness/documentation contract"
+    rationale = (
+        "incident timelines only stay readable if event types stay "
+        "literal, unique, canonical and documented — drift is invisible "
+        "to behavioral tests")
+
+    def run(self, project: Project) -> List[Finding]:
+        return findings(project)
+
+
+def main() -> int:
+    problems = check()
+    if not problems:
+        print(f"event-name lint: clean ({len(registrations()[0])} event "
+              f"types, all literal, unique, canonical and documented)")
+        return 0
+    for p in problems:
+        print(p, file=sys.stderr)
+    return 1

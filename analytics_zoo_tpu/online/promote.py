@@ -36,8 +36,13 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..common import faults
 from ..common import metrics as zoo_metrics
 from ..common.config import global_config
+from ..ops import events as ops_events
 
 logger = logging.getLogger(__name__)
+
+_E_PROMOTION = ops_events.event_type(
+    "online.promotion",
+    "Rolling promotion terminal (outcome=landed|rolled_back, version).")
 
 _M_PROMOTIONS = zoo_metrics.counter(
     "online.promotions_total",
@@ -227,6 +232,7 @@ class Promoter:
                 self._rollback(done, prior)
             finally:
                 _M_PROMOTIONS.labels(outcome="rolled_back").inc()
+                _E_PROMOTION.emit(outcome="rolled_back", version=version)
                 _M_PROMOTE_S.observe(time.monotonic() - t0)
             if isinstance(e, PromotionError):
                 raise
@@ -235,5 +241,6 @@ class Promoter:
                 f"{self._rollout_order()[len(done)]!r} ({e!r}); fleet "
                 f"rolled back to prior versions") from e
         _M_PROMOTIONS.labels(outcome="landed").inc()
+        _E_PROMOTION.emit(outcome="landed", version=version)
         _M_PROMOTE_S.observe(time.monotonic() - t0)
         return version

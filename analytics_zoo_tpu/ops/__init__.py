@@ -1,9 +1,15 @@
-"""TPU hot-op kernels (pallas) with XLA fallbacks.
+"""TPU hot-op kernels (pallas) with XLA fallbacks, plus the ops plane.
 
 The reference's hot loops are MKL kernels inside BigDL layers and TF JNI
 ``Session.run`` (SURVEY §3.2/§3.3). Here the hot ops are implemented directly
 for the TPU: pallas kernels where hand-tiling beats XLA fusion (attention),
 plain jnp everywhere XLA already does the right thing.
+
+The package also hosts the **operational plane** (stdlib-only, imported
+explicitly rather than re-exported here): :mod:`.events` (structured
+event log), :mod:`.history` (metric history sampler), :mod:`.alerts`
+(multi-window burn-rate SLO rules), :mod:`.incident` (incident bundles +
+timelines), and the ``python -m analytics_zoo_tpu.ops`` incident CLI.
 """
 from .attention import (  # noqa: F401
     dot_product_attention,

@@ -72,6 +72,7 @@ from ..common import faults, file_io
 from ..common import metrics as _metrics
 from ..common.config import global_config
 from ..common.utils import wall_clock
+from ..ops import events as ops_events
 from .queues import FileQueue, QueueBackend
 from .server import DEADLINE_ERROR
 
@@ -124,6 +125,14 @@ _M_BREAKER = _metrics.gauge(
 #: breaker states (gauge values)
 BREAKER_CLOSED, BREAKER_OPEN, BREAKER_HALF_OPEN = 0, 1, 2
 
+_BREAKER_STATE_NAMES = {BREAKER_CLOSED: "closed", BREAKER_OPEN: "open",
+                        BREAKER_HALF_OPEN: "half_open"}
+
+_E_BREAKER = ops_events.event_type(
+    "fleet.breaker",
+    "Per-instance circuit breaker transition (state_from/state, "
+    "reason=errors|latency|probe_ok|probe_fail|forced|cooldown).")
+
 
 class _Breaker:
     """Per-instance circuit breaker (closed -> open -> half-open ->
@@ -133,15 +142,29 @@ class _Breaker:
     and HALF-OPEN admits exactly one probe request."""
 
     def __init__(self, failures: int, latency_ratio: float,
-                 cooldown_s: float):
+                 cooldown_s: float, name: str = ""):
         self.failures = int(failures)
         self.latency_ratio = float(latency_ratio)
         self.cooldown_s = float(cooldown_s)
+        self.name = name
         self.state = BREAKER_CLOSED
         self._error_streak = 0
         self._slow_streak = 0
         self._opened_at = 0.0
         self._probe_uri: Optional[str] = None
+
+    def _transition(self, state: int, reason: str) -> None:
+        """Move the state machine, emitting one ``fleet.breaker`` event
+        per actual change (re-tripping an already-open breaker is not a
+        transition)."""
+        if state == self.state:
+            return
+        prev = self.state
+        self.state = state
+        _E_BREAKER.emit(label=self.name,
+                        state=_BREAKER_STATE_NAMES[state],
+                        state_from=_BREAKER_STATE_NAMES[prev],
+                        reason=reason)
 
     def record_result(self, uri: str, is_error: bool, now: float) -> None:
         """Feed one settled terminal. In HALF-OPEN only the probe's
@@ -152,15 +175,15 @@ class _Breaker:
                 return
             self._probe_uri = None
             if is_error:
-                self.trip(now)
+                self.trip(now, reason="probe_fail")
             else:
-                self.state = BREAKER_CLOSED
                 self._error_streak = self._slow_streak = 0
+                self._transition(BREAKER_CLOSED, "probe_ok")
             return
         if is_error:
             self._error_streak += 1
             if self._error_streak >= self.failures:
-                self.trip(now)
+                self.trip(now, reason="errors")
         else:
             self._error_streak = 0
 
@@ -175,17 +198,17 @@ class _Breaker:
                 and service_s > self.latency_ratio * fleet_median_s):
             self._slow_streak += 1
             if self._slow_streak >= self.failures:
-                self.trip(now)
+                self.trip(now, reason="latency")
         else:
             self._slow_streak = 0
 
-    def trip(self, now: float) -> None:
+    def trip(self, now: float, reason: str = "forced") -> None:
         """Force-open the breaker (also the entry point for the
         ``fleet.breaker`` flag fault)."""
-        self.state = BREAKER_OPEN
         self._opened_at = now
         self._error_streak = self._slow_streak = 0
         self._probe_uri = None
+        self._transition(BREAKER_OPEN, reason)
 
     def placeable(self, now: float) -> bool:
         """May the router place a request here? OPEN breakers move to
@@ -195,8 +218,8 @@ class _Breaker:
             return True
         if self.state == BREAKER_OPEN:
             if now - self._opened_at >= self.cooldown_s:
-                self.state = BREAKER_HALF_OPEN
                 self._probe_uri = None
+                self._transition(BREAKER_HALF_OPEN, "cooldown")
                 return True
             return False
         return self._probe_uri is None  # half-open: one probe at a time
@@ -333,7 +356,7 @@ class FleetRouter:
         if br is None:
             br = self._breakers[name] = _Breaker(
                 self._breaker_failures, self._breaker_latency_ratio,
-                self._breaker_cooldown_s)
+                self._breaker_cooldown_s, name=name)
         return br
 
     def _refresh(self, now: float) -> None:

@@ -35,6 +35,9 @@ from ..common import profiler as _profiler
 from ..common.config import global_config
 from ..common.utils import time_it, wall_clock
 from ..inference.inference_model import InferenceModel
+from ..ops import alerts as ops_alerts
+from ..ops import events as ops_events
+from ..ops import incident as ops_incident
 from ..utils import trace as _trace
 from .config import ServingConfig
 from .queues import QueueBackend, decode_image, make_queue
@@ -120,6 +123,23 @@ _M_BROWNOUT = _metrics.gauge(
 
 _instance_ids = itertools.count()
 
+#: ops-plane event types (docs/observability.md "Ops plane") — one event
+#: per state transition, replayed by the incident correlator
+_E_BROWNOUT = ops_events.event_type(
+    "serving.brownout_rung",
+    "Brownout ladder rung change (level_from/level_to, pressure).")
+_E_SHED = ops_events.event_type(
+    "serving.shed",
+    "Admission control shed the oldest requests (count, allowed depth).")
+_E_RELOAD = ops_events.event_type(
+    "serving.reload",
+    "Hot model reload landed (ok=true, version) or rolled back "
+    "(ok=false).")
+_E_LIFECYCLE = ops_events.event_type(
+    "serving.lifecycle",
+    "Server reached a terminal lifecycle state "
+    "(state=drained|stopped|crashed).")
+
 
 class _Brownout:
     """Hysteretic brownout ladder (docs/serving.md "Overload survival").
@@ -153,16 +173,18 @@ class _Brownout:
     #: stream-partial stride multiplier per rung (generative)
     _STRIDE = (1, 4, 4, 4)
 
-    def __init__(self):
+    def __init__(self, label: str = ""):
         cfg = global_config()
         self.high = float(cfg.get("serving.brownout_high"))
         self.low = float(cfg.get("serving.brownout_low"))
         self.hold_ticks = int(cfg.get("serving.brownout_hold_ticks"))
         self.token_frac = float(cfg.get("serving.brownout_token_frac"))
+        self.label = label
         self.level = 0
         self._calm = 0
 
     def tick(self, pressure: float) -> int:
+        prev = self.level
         if pressure > self.high:
             self._calm = 0
             if self.level < self.MAX_LEVEL:
@@ -174,6 +196,10 @@ class _Brownout:
                 self._calm = 0
         else:
             self._calm = 0
+        if self.level != prev:
+            _E_BROWNOUT.emit(label=self.label, level_from=prev,
+                             level_to=self.level,
+                             pressure=round(float(pressure), 4))
         return self.level
 
     def token_cap(self, budget: int) -> int:
@@ -251,7 +277,7 @@ class ClusterServing:
         self._m_in_flight = _M_IN_FLIGHT.labels(server=self.metrics_label)
         self._m_claim_age = _M_CLAIM_AGE.labels(server=self.metrics_label)
         self._m_brownout = _M_BROWNOUT.labels(server=self.metrics_label)
-        self._brownout = _Brownout()
+        self._brownout = _Brownout(self.metrics_label)
         self._counter_lock = threading.Lock()
         self._in_flight = 0  # claimed, no terminal result yet
         #: uri -> (enqueue_t, trace_id) — latency base + flow-chain id
@@ -458,6 +484,8 @@ class ClusterServing:
         self._m_brownout.set(self._brownout.tick(fill))
         if dropped:
             self._count("shed", len(dropped))
+            _E_SHED.emit(label=self.metrics_label, count=len(dropped),
+                         allowed=allowed)
             logger.warning(
                 "overload: shed %d oldest requests with error results "
                 "(allowed depth %d)", len(dropped), allowed)
@@ -717,6 +745,8 @@ class ClusterServing:
             "counters": counters,
             "prewarmed": self.prewarmed,
             "model_version": self.model_version,
+            "alerts": sorted(ops_alerts.active_alerts()),
+            "incident": ops_incident.last_incident(),
             "error": repr(err) if err is not None else None,
         }
 
@@ -823,12 +853,16 @@ class ClusterServing:
                     self.model_version = \
                         f"inline-{next(self._inline_versions)}"
                 self._count("reloads")
+                _E_RELOAD.emit(label=self.metrics_label, ok=True,
+                               version=self.model_version)
                 logger.info("model reloaded%s",
                             f" from {model_path}" if model_path else "")
                 return model
             except Exception as e:
                 self.model = old  # rollback (no-op unless a partial swap)
                 self._count("reload_failures")
+                _E_RELOAD.emit(label=self.metrics_label, ok=False,
+                               version=self.model_version)
                 logger.exception(
                     "model reload failed; previous model still serving")
                 raise ModelReloadError(
@@ -878,6 +912,7 @@ class ClusterServing:
 
         logger.info("serving started (src=%s batch=%d)",
                     self.config.data_src, self.config.batch_size)
+        ops_alerts.ensure_default()  # no-op unless ops.enabled
         self._terminal_state = None
         self._loop_running = True
         # a fresh loop gets an immediate admission pass: a backlog that
@@ -992,6 +1027,8 @@ class ClusterServing:
             self._loop_running = False
             self._terminal_state = ("crashed" if errors
                                     else "drained" if drained else "stopped")
+            _E_LIFECYCLE.emit(label=self.metrics_label,
+                              state=self._terminal_state)
             self._write_health()
         if errors:
             raise errors[0]
@@ -1003,6 +1040,7 @@ class ClusterServing:
         job role). A crash in the loop is captured and re-raised from
         :meth:`stop` / :meth:`check_health` — a dead queue backend must not
         kill the server silently."""
+        ops_alerts.ensure_default()  # no-op unless ops.enabled
         self._stop.clear()
         self._draining.clear()
         self._terminal_state = None
@@ -1049,6 +1087,7 @@ class ClusterServing:
         self._shutdown_pool()
         if self._terminal_state is None:
             self._terminal_state = "drained"
+            _E_LIFECYCLE.emit(label=self.metrics_label, state="drained")
         self._write_health()
         self.check_health()
 
@@ -1070,6 +1109,7 @@ class ClusterServing:
         self._shutdown_pool()
         if self._terminal_state is None:
             self._terminal_state = "stopped"
+            _E_LIFECYCLE.emit(label=self.metrics_label, state="stopped")
         self._write_health()
         self.check_health()
 
@@ -1368,7 +1408,7 @@ class GenerativeServing:
         self._m_spec_accept = _M_SPEC_ACCEPT.labels(
             server=self.metrics_label)
         self._m_brownout = _M_BROWNOUT.labels(server=self.metrics_label)
-        self._brownout = _Brownout()
+        self._brownout = _Brownout(self.metrics_label)
         if self._paged:
             self._m_pages_free.set(len(self._free_pages))
         self._counter_lock = threading.Lock()
@@ -1610,6 +1650,8 @@ class GenerativeServing:
         self._m_brownout.set(self._brownout.tick(max(fill, scarcity)))
         if dropped:
             self._count("shed", len(dropped))
+            _E_SHED.emit(label=self.metrics_label, count=len(dropped),
+                         allowed=allowed)
             logger.warning(
                 "overload: shed %d oldest streams with error results "
                 "(allowed depth %d)", len(dropped), allowed)
@@ -2059,6 +2101,7 @@ class GenerativeServing:
     def run(self, poll_interval_s: float = 0.005) -> None:
         logger.info("generative serving started (src=%s slots=%d)",
                     self.config.data_src, self.slots)
+        ops_alerts.ensure_default()  # no-op unless ops.enabled
         self._terminal_state = None
         self._loop_running = True
         self._last_shed_m = -1e18
@@ -2077,6 +2120,7 @@ class GenerativeServing:
             self._maybe_write_health()
 
     def start(self) -> "GenerativeServing":
+        ops_alerts.ensure_default()  # no-op unless ops.enabled
         self._stop.clear()
         self._draining.clear()
         self._handoff_evt.clear()
@@ -2116,6 +2160,7 @@ class GenerativeServing:
             self._thread = None
         if self._terminal_state is None:
             self._terminal_state = "drained"
+            _E_LIFECYCLE.emit(label=self.metrics_label, state="drained")
         self._write_health()
         self.check_health()
 
@@ -2176,6 +2221,7 @@ class GenerativeServing:
             self._evict_slots(mask)
         if self._terminal_state is None:
             self._terminal_state = "drained"
+            _E_LIFECYCLE.emit(label=self.metrics_label, state="drained")
         self._write_health()
         self.check_health()
         return moved
@@ -2196,6 +2242,7 @@ class GenerativeServing:
             self._fail_active(SHUTDOWN_ERROR)
         if self._terminal_state is None:
             self._terminal_state = "stopped"
+            _E_LIFECYCLE.emit(label=self.metrics_label, state="stopped")
         self._write_health()
         self.check_health()
 
@@ -2266,6 +2313,8 @@ class GenerativeServing:
                            "window": self._m_latency.count()},
             "counters": self.counters,
             "model_version": self.model_version,
+            "alerts": sorted(ops_alerts.active_alerts()),
+            "incident": ops_incident.last_incident(),
             "error": repr(err) if err is not None else None,
         }
 

@@ -2531,24 +2531,39 @@ def bench_moe_train(batch_size: int = 4096, d: int = 256,
                 "flops_per_step": flops})
 
 
+def _ops_burst_type():
+    """The one registration site for the bench burst event type (the
+    event-names lint holds every type to a single owning call site)."""
+    from analytics_zoo_tpu.ops import events as zoo_events
+    return zoo_events.event_type(
+        "bench.ops_burst",
+        "Synthetic burst event from bench.py's obs legs (serving soak "
+        "and ratio-mode emit probe).")
+
+
 def bench_obs_overhead(batch_size: int = 256, steps_per_epoch: int = 16,
                        d: int = 64, rounds: int = 3):
     """Telemetry-plane cost, measured end to end.
 
     Part 1 — train-loop A/B: identical epochs with (a) the metrics
     registry disabled and no trace session vs (b) the full registry
-    enabled AND a live chrome-trace session recording every span. The
-    headline is the throughput delta (%); the target is < 2% — telemetry
-    that taxes the hot path more than that would get turned off in
-    production and rot. Rounds interleave a/b and take medians so the
-    number is a property of the code, not of which half of the run the
-    host's background noise landed in.
+    enabled AND a live chrome-trace session recording every span, plus
+    (c) the full OPS PLANE live — structured event log, metric-history
+    sampler thread and the SLO alert engine over the default rules. The
+    headline is the throughput delta (%); the target is < 2% for both
+    (b) and (c) — telemetry that taxes the hot path more than that would
+    get turned off in production and rot. Rounds interleave a/b/c and
+    take medians so the number is a property of the code, not of which
+    half of the run the host's background noise landed in.
 
     Part 2 — a traced serving soak (threaded pipeline loop + a concurrent
     forked transform-worker pool, the unified-platform shape): the dumped
     trace must be Perfetto-loadable, contain at least one COMPLETE
     enqueue→claim→decode→dispatch→result flow chain, and carry spans from
-    >= 2 pids (the forked workers). Gated before any number is published.
+    >= 2 pids (the forked workers). The soak also runs with the event
+    log enabled under a concurrent event burst: every burst event must
+    read back from the spool and the serving lifecycle transition must
+    land next to them. Gated before any number is published.
     """
     import json as json_mod
     import tempfile
@@ -2607,17 +2622,43 @@ def bench_obs_overhead(batch_size: int = 256, steps_per_epoch: int = 16,
         with trace(path):
             return one_epoch()
 
-    offs, ons = [], []
+    from analytics_zoo_tpu.ops import alerts as zoo_alerts
+    from analytics_zoo_tpu.ops import events as zoo_events
+    from analytics_zoo_tpu.ops.history import MetricHistory
+
+    def epoch_ops():
+        # the full ops plane live around a registry-enabled epoch: event
+        # spool + history sampler thread + alert engine on default rules
+        zoo_events.reset_default(root=os.path.join(tdir, "ops_spool"),
+                                 enabled=True)
+        hist = MetricHistory()
+        eng = zoo_alerts.AlertEngine(hist, zoo_alerts.default_rules())
+        hist.start()
+        eng.start()
+        try:
+            return one_epoch()
+        finally:
+            eng.stop()
+            hist.stop()
+            zoo_events.reset_default(enabled=False)
+
+    offs, ons, opss = [], [], []
     for _ in range(rounds):
         offs.append(epoch_off())
         ons.append(epoch_on())
+        opss.append(epoch_ops())
     off_s = sorted(offs)[len(offs) // 2]
     on_s = sorted(ons)[len(ons) // 2]
+    ops_s = sorted(opss)[len(opss) // 2]
     overhead_pct = (on_s - off_s) / off_s * 100.0
+    ops_overhead_pct = (ops_s - off_s) / off_s * 100.0
     off_rate = n / off_s
     on_rate = n / on_s
+    ops_rate = n / ops_s
     _note_partial(metric="obs_overhead_pct", value=round(overhead_pct, 3),
-                  unit="%", overhead_under_2pct=bool(overhead_pct < 2.0))
+                  unit="%", overhead_under_2pct=bool(overhead_pct < 2.0),
+                  ops_overhead_pct=round(ops_overhead_pct, 3),
+                  ops_under_2pct=bool(ops_overhead_pct < 2.0))
 
     # -- part 1b: step-phase profiler exposition gate -------------------------
     # one epoch with the attribution profiler ON: the phase histograms must
@@ -2666,8 +2707,23 @@ def bench_obs_overhead(batch_size: int = 256, steps_per_epoch: int = 16,
         def apply(self, rec):
             return rec * 2.0
 
+    # the soak doubles as an event-burst torture: the spool must keep
+    # every event appended concurrently with the serving hot loop, and
+    # the server's own lifecycle transition must land beside them
+    import threading
+    zoo_events.reset_default(root=os.path.join(tdir, "ops_soak_spool"),
+                             enabled=True)
+    burst_type = _ops_burst_type()
+    burst_n = 1500
+
+    def _burst():
+        for i in range(burst_n):
+            burst_type.emit(label="soak", n=i)
+
+    burst_thread = threading.Thread(target=_burst, daemon=True)
     with trace(trace_path):
         serving.start()
+        burst_thread.start()
         try:
             for i in range(soak_n):
                 inq.enqueue_tensor(f"s{i}", vec)
@@ -2690,9 +2746,19 @@ def bench_obs_overhead(batch_size: int = 256, steps_per_epoch: int = 16,
                 time.sleep(0.02)
         finally:
             serving.drain(timeout_s=30)
+    burst_thread.join(timeout=30)
+    burst_seen = len(zoo_events.read_events(types=["bench.ops_burst"]))
+    lifecycle_seen = len(zoo_events.read_events(
+        types=["serving.lifecycle"]))
+    event_burst_ok = bool(burst_seen == burst_n and lifecycle_seen >= 1)
+    zoo_events.reset_default(enabled=False)
     if len(answered) != soak_n:
         raise RuntimeError(
             f"soak lost requests: {len(answered)}/{soak_n} answered")
+    if not event_burst_ok:
+        raise RuntimeError(
+            f"event-burst soak lost events: {burst_seen}/{burst_n} burst "
+            f"events, {lifecycle_seen} lifecycle events read back")
 
     events = json_mod.load(open(trace_path))  # Perfetto-loadable JSON
     spans = [e for e in events if e.get("ph") == "X"]
@@ -2721,8 +2787,13 @@ def bench_obs_overhead(batch_size: int = 256, steps_per_epoch: int = 16,
                 "rounds": rounds,
                 "disabled_examples_per_sec": round(off_rate, 1),
                 "enabled_traced_examples_per_sec": round(on_rate, 1),
+                "ops_plane_examples_per_sec": round(ops_rate, 1),
                 "overhead_pct": round(overhead_pct, 3),
                 "overhead_under_2pct": bool(overhead_pct < 2.0),
+                "ops_overhead_pct": round(ops_overhead_pct, 3),
+                "ops_under_2pct": bool(ops_overhead_pct < 2.0),
+                "event_burst_events": burst_seen,
+                "event_burst_ok": event_burst_ok,
                 "profiler_exposition_ok": profiler_ok,
                 "profiled_examples_per_sec": round(n / profiled_s, 1),
                 "soak_requests": soak_n,
@@ -2731,11 +2802,13 @@ def bench_obs_overhead(batch_size: int = 256, steps_per_epoch: int = 16,
                 "flow_chain_ok": bool(complete >= 1),
                 "trace_pids": len(pids),
                 "trace_spans": len(spans),
-                "note": "A/B medians over interleaved epochs: metrics "
+                "note": "A/B/C medians over interleaved epochs: metrics "
                         "registry disabled vs registry + live trace "
-                        "session; soak gate = Perfetto-loadable trace "
-                        "with a complete enqueue→claim→decode→dispatch→"
-                        "result chain and spans from >= 2 pids"})
+                        "session vs full ops plane (event log + history "
+                        "sampler + alert engine); soak gate = Perfetto-"
+                        "loadable trace with a complete enqueue→claim→"
+                        "decode→dispatch→result chain, spans from >= 2 "
+                        "pids, and a lossless concurrent event burst"})
 
 
 def _longseq_once(batch_size, heads, seq, head_dim, steps):
@@ -3526,8 +3599,14 @@ def _ratio_brownout():
 def _ratio_obs():
     """Telemetry record cost, enabled vs disabled — the <1µs no-op
     contract, measured on a fresh registry so bench probes never pollute
-    the process-global one."""
+    the process-global one. The ops-plane twin rides along: one private
+    event log's emit cost enabled vs disabled, holding the structured
+    event log to the same disabled-is-free discipline."""
+    import shutil
+    import tempfile
+
     from analytics_zoo_tpu.common import metrics as zoo_metrics
+    from analytics_zoo_tpu.ops import events as zoo_events
     reg = zoo_metrics.Registry(1 << 10)
     try:
         h = reg.histogram("bench.ratio_probe_seconds", "ratio-mode probe")
@@ -3544,11 +3623,34 @@ def _ratio_obs():
         reg.set_enabled(False)
         off = per_call()
         reg.set_enabled(True)
+
+        burst_type = _ops_burst_type()
+        root = tempfile.mkdtemp(prefix="zoo_bench_ratio_ops_")
+        log = zoo_events.EventLog(root=root, ring=256, enabled=True)
+        ev_iters = 2000
+
+        def per_emit():
+            t0 = time.perf_counter()
+            for i in range(ev_iters):
+                log.emit(burst_type.name, label="ratio", n=i)
+            return (time.perf_counter() - t0) / ev_iters
+
+        per_emit()  # warm (opens the part file)
+        emit_on = per_emit()
+        log.set_enabled(False)
+        emit_off = per_emit()
+        log.close()
+        shutil.rmtree(root, ignore_errors=True)
         return {"enabled_ns_per_record": round(on * 1e9, 1),
                 "disabled_ns_per_record": round(off * 1e9, 1),
                 "disabled_under_1us": bool(off < 1e-6),
                 "enabled_vs_disabled_record_ratio":
-                    round(on / max(off, 1e-12), 2)}
+                    round(on / max(off, 1e-12), 2),
+                "enabled_event_emit_us": round(emit_on * 1e6, 2),
+                "disabled_event_emit_ns": round(emit_off * 1e9, 1),
+                "disabled_event_under_1us": bool(emit_off < 1e-6),
+                "enabled_vs_disabled_event_ratio":
+                    round(emit_on / max(emit_off, 1e-12), 2)}
     finally:
         reg.close()
 
@@ -4692,7 +4794,8 @@ _COMPACT_KEYS = {
     "generate": ("tokens_per_sec_c8", "tokens_per_sec_c128",
                  "tokens_per_sec_c512", "ttft_p99_ms_c32",
                  "tokens_per_s_per_hbm_gb"),
-    "obs_overhead": ("overhead_under_2pct", "flow_chain_ok", "trace_pids"),
+    "obs_overhead": ("overhead_under_2pct", "ops_under_2pct",
+                     "event_burst_ok", "flow_chain_ok", "trace_pids"),
     "pipeline": (),
     "recovery": ("restore_ms", "recovery_vs_step", "parity_ok"),
     "etl_to_train": ("zero_copy_vs_gather_ratio", "handoff_parity_ok",

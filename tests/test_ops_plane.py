@@ -1,0 +1,566 @@
+"""Ops-plane unit tests: the structured event log (fork-safe, typed,
+crash-tolerant), the metric history sampler (counter-delta semantics on a
+fake clock), multi-window burn-rate and sustained-threshold SLO rules,
+alert-engine hysteresis, incident-bundle causal ordering, the timeline
+CLI, and the histogram re-registration pinning test."""
+import json
+import os
+
+import pytest
+
+from analytics_zoo_tpu.common import faults, metrics
+from analytics_zoo_tpu.common.config import global_config
+from analytics_zoo_tpu.ops import alerts, events, incident
+from analytics_zoo_tpu.ops.__main__ import main as ops_cli
+from analytics_zoo_tpu.ops.history import MetricHistory
+
+T0 = 1_000_000.0  # fake wall-clock epoch for the burn-rate math
+
+
+@pytest.fixture
+def reg():
+    r = metrics.Registry(capacity=8192)
+    yield r
+    r.close()
+
+
+@pytest.fixture
+def elog(tmp_path):
+    log = events.EventLog(root=str(tmp_path / "spool"), enabled=True)
+    yield log
+    log.close()
+
+
+# -- event log ----------------------------------------------------------------
+
+_E_ALPHA = events.event_type("test.alpha", "ops-plane test event")
+_E_BETA = events.event_type("test.beta", "ops-plane test event")
+
+
+class TestEventLog:
+    def test_disabled_emit_is_noop_and_creates_nothing(self, tmp_path):
+        root = tmp_path / "never"
+        log = events.EventLog(root=str(root), enabled=False)
+        assert log.emit("test.alpha", n=1) is None
+        assert not root.exists()  # disabled plane must not touch disk
+
+    def test_unregistered_type_raises(self, elog):
+        with pytest.raises(ValueError, match="never registered"):
+            elog.emit("test.totally_unknown")
+
+    def test_reserved_field_collision_raises(self, elog):
+        with pytest.raises(ValueError, match="reserved"):
+            elog.emit("test.alpha", wall=123.0)
+        with pytest.raises(ValueError, match="reserved"):
+            elog.emit("test.alpha", pid=1)
+
+    def test_emit_stamps_and_ring_bound(self, tmp_path):
+        log = events.EventLog(root=str(tmp_path / "s"), ring=4,
+                              enabled=True)
+        for i in range(10):
+            ev = log.emit("test.alpha", label="lab", n=i)
+            assert ev["type"] == "test.alpha"
+            assert ev["pid"] == os.getpid()
+            assert ev["label"] == "lab"
+            assert ev["wall"] > 0 and ev["mono"] > 0
+        tail = log.tail()
+        assert [e["n"] for e in tail] == [6, 7, 8, 9]  # ring kept newest 4
+        assert [e["seq"] for e in tail] == [7, 8, 9, 10]
+        # the part file kept everything the ring dropped
+        assert len(log.read()) == 10
+        log.close()
+
+    def test_read_filters(self, elog):
+        elog.emit("test.alpha", label="a")
+        elog.emit("test.beta", label="b")
+        mid = elog.read()[-1]["wall"]
+        elog.emit("test.alpha", label="b")
+        assert [e["type"] for e in elog.read(types=["test.beta"])] \
+            == ["test.beta"]
+        assert len(elog.read(label="b")) == 2
+        assert all(e["wall"] >= mid for e in elog.read(since_wall=mid))
+
+    def test_torn_and_garbage_lines_skipped(self, elog):
+        elog.emit("test.alpha", n=1)
+        part = os.path.join(elog.root, f"{os.getpid()}.jsonl")
+        with open(part, "a") as f:
+            f.write("42\n")                       # non-dict JSON
+            f.write('{"no_type": true}\n')        # dict without type
+            f.write('{"type": "test.alpha", "wall": ')  # torn final line
+        evs = elog.read()
+        assert len(evs) == 1 and evs[0]["n"] == 1
+
+    def test_clear_drops_ring_and_parts(self, elog):
+        elog.emit("test.alpha")
+        elog.clear()
+        assert elog.tail() == [] and elog.read() == []
+
+
+def test_fork_child_events_visible_to_parent(tmp_path):
+    """A forked child's transitions land in the parent's merged view —
+    the per-pid part-file handle is re-resolved after the fork."""
+    log = events.EventLog(root=str(tmp_path / "spool"), enabled=True)
+    log.emit("test.alpha", label="parent")  # opens the parent's part file
+    pid = os.fork()
+    if pid == 0:
+        code = 1
+        try:
+            log.emit("test.alpha", label="child")
+            code = 0
+        finally:
+            os._exit(code)
+    _, status = os.waitpid(pid, 0)
+    assert os.waitstatus_to_exitcode(status) == 0
+    evs = log.read(types=["test.alpha"])
+    assert {e["label"] for e in evs} == {"parent", "child"}
+    assert len({e["pid"] for e in evs}) == 2
+    # the child must not have deleted the shared spool on exit
+    assert os.path.isdir(log.root)
+    log.close()
+
+
+# -- metric history -----------------------------------------------------------
+
+class TestMetricHistory:
+    def test_samples_all_registry_shapes(self, reg):
+        reg.counter("t.reqs_total").inc(3)
+        reg.gauge("t.depth").set(7.0)
+        reg.counter("t.labeled_total", labels=("k",)).labels(k="a").inc(2)
+        h = reg.histogram("t.lat_seconds")
+        h.observe(0.1)
+        hist = MetricHistory(reg, depth=16)
+        hist.sample_once(now=T0)
+        assert hist.latest("t.reqs_total") == (T0, 3.0)
+        assert hist.latest("t.depth") == (T0, 7.0)
+        assert hist.latest("t.labeled_total", "k=a") == (T0, 2.0)
+        summ = hist.latest("t.lat_seconds")[1]
+        assert summ["count"] == 1
+        assert hist.kind("t.reqs_total") == "counter"
+        assert hist.labels_for("t.labeled_total") == ["k=a"]
+
+    def test_counter_delta_is_reset_tolerant(self, reg):
+        """PromQL-increase semantics: positive increments summed, a
+        decrease (restart / zero_all) contributes the post-reset value —
+        this is the sampler half of the satellite-f regression pair."""
+        c = reg.counter("t.work_total")
+        hist = MetricHistory(reg, depth=64)
+        hist.sample_once(now=T0)          # 0
+        c.inc(10)
+        hist.sample_once(now=T0 + 1)      # 10
+        c.inc(15)
+        hist.sample_once(now=T0 + 2)      # 25
+        hist.sample_once(now=T0 + 3)      # 25 (flat)
+        reg.zero()                        # the restart / bench-leg reset
+        c.inc(5)
+        hist.sample_once(now=T0 + 4)      # 5  (decrease vs 25)
+        c.inc(3)
+        hist.sample_once(now=T0 + 5)      # 8
+        # +10 +15 +0, reset contributes post-reset 5, then +3  == 33
+        assert hist.delta("t.work_total", now=T0 + 5) == pytest.approx(33.0)
+
+    def test_delta_prewindow_baseline_and_empty_window(self, reg):
+        c = reg.counter("t.base_total")
+        hist = MetricHistory(reg, depth=64)
+        c.inc(100)
+        hist.sample_once(now=T0)          # pre-window baseline sample
+        c.inc(7)
+        hist.sample_once(now=T0 + 20)     # only in-window sample
+        # window [T0+10, T0+30]: baseline 100 seeds, first increment kept
+        assert hist.delta("t.base_total", seconds=20, now=T0 + 30) \
+            == pytest.approx(7.0)
+        # a window with no samples at all is None, not 0.0
+        assert hist.delta("t.base_total", seconds=5, now=T0 + 100) is None
+        assert hist.delta("t.missing_total") is None
+
+    def test_rate_window_and_dump(self, reg):
+        c = reg.counter("t.rate_total")
+        hist = MetricHistory(reg, depth=64)
+        for s in range(11):
+            c.inc(2)
+            hist.sample_once(now=T0 + s)
+        assert hist.rate("t.rate_total", seconds=10.0, now=T0 + 10) \
+            == pytest.approx(2.0)
+        win = hist.window("t.rate_total", seconds=3.0, now=T0 + 10)
+        assert [t for t, _ in win] == [T0 + 7, T0 + 8, T0 + 9, T0 + 10]
+        dump = hist.dump(seconds=3.0, now=T0 + 10)
+        assert dump["t.rate_total"][""] == [[t, v] for t, v in win]
+
+    def test_histogram_key_extraction(self, reg):
+        h = reg.histogram("t.wait_seconds")
+        hist = MetricHistory(reg, depth=16)
+        for v in (0.1, 0.1, 0.1):
+            h.observe(v)
+        hist.sample_once(now=T0)
+        for v in (0.1, 0.1):
+            h.observe(v)
+        hist.sample_once(now=T0 + 1)
+        # delta on key="count" gives windowed event counts for ratio rules
+        assert hist.delta("t.wait_seconds", seconds=10, now=T0 + 1,
+                          key="count") == pytest.approx(2.0)
+        assert hist.latest("t.wait_seconds")[1]["p50"] > 0
+
+
+# -- burn-rate rules on a fake clock ------------------------------------------
+
+class TestBurnRateRule:
+    def _drive(self, reg, hist, seconds, bad_per_s, tot_per_s, start=0):
+        bad = reg.counter("slo.bad_total")
+        tot = reg.counter("slo.req_total")
+        for s in range(start, start + seconds):
+            if bad_per_s:
+                bad.inc(bad_per_s)
+            if tot_per_s:
+                tot.inc(tot_per_s)
+            hist.sample_once(now=T0 + s)
+        return T0 + start + seconds - 1
+
+    def test_fast_burn_fires_and_short_window_clears_it(self, reg):
+        hist = MetricHistory(reg, depth=256)
+        rule = alerts.BurnRateRule(
+            "burn", bad="slo.bad_total", total="slo.req_total",
+            objective=0.99, windows=((30.0, 5.0, 14.4),))
+        # 50% failure ratio -> burn 50x against a 1% budget: fires
+        now = self._drive(reg, hist, 20, bad_per_s=5, tot_per_s=10)
+        firing, info = rule.evaluate(hist, now)
+        assert firing
+        assert info["factor"] == 14.4
+        assert info["burn_long"] > 14.4 and info["burn_short"] > 14.4
+        # bleeding stops; the 5 s short window drains long before the
+        # 30 s long window forgets -> the AND clears fast
+        now = self._drive(reg, hist, 26, bad_per_s=0, tot_per_s=10,
+                          start=20)
+        firing, _ = rule.evaluate(hist, now)
+        assert not firing
+
+    def test_slow_burn_pair_catches_moderate_burn(self, reg):
+        hist = MetricHistory(reg, depth=256)
+        rule = alerts.BurnRateRule(
+            "burn", bad="slo.bad_total", total="slo.req_total",
+            objective=0.99,
+            windows=((30.0, 5.0, 14.4), (60.0, 10.0, 6.0)))
+        # 10% ratio -> burn ~10x: below the fast factor, above the slow
+        now = self._drive(reg, hist, 20, bad_per_s=1, tot_per_s=10)
+        firing, info = rule.evaluate(hist, now)
+        assert firing
+        assert info["factor"] == 6.0
+
+    def test_exact_boundary_never_flaps(self, reg):
+        """Strict >: a burn sitting exactly ON the factor holds steady.
+        objective 0.75 makes the budget (0.25) and the burn (2.0) exact
+        in binary, so this really exercises the boundary."""
+        hist = MetricHistory(reg, depth=256)
+        rule = alerts.BurnRateRule(
+            "burn", bad="slo.bad_total", total="slo.req_total",
+            objective=0.75, windows=((30.0, 5.0, 2.0),))
+        now = self._drive(reg, hist, 20, bad_per_s=1, tot_per_s=2)
+        assert rule.burn_rate(hist, 30.0, now) == 2.0  # exactly the factor
+        assert not rule.evaluate(hist, now)[0]
+        # one extra bad event pushes it strictly past -> fires
+        reg.counter("slo.bad_total").inc(3)
+        reg.counter("slo.req_total").inc(2)
+        hist.sample_once(now=now + 1)
+        assert rule.evaluate(hist, now + 1)[0]
+
+    def test_silence_is_not_a_violation(self, reg):
+        hist = MetricHistory(reg, depth=16)
+        rule = alerts.BurnRateRule(
+            "burn", bad="slo.bad_total", total="slo.req_total",
+            objective=0.99, windows=((30.0, 5.0, 1.0),), min_total=5.0)
+        assert rule.burn_rate(hist, 30.0, T0) is None  # no samples
+        assert not rule.evaluate(hist, T0)[0]
+        # traffic below min_total still refuses to judge
+        reg.counter("slo.bad_total").inc(1)
+        reg.counter("slo.req_total").inc(1)
+        hist.sample_once(now=T0)
+        hist.sample_once(now=T0 + 1)
+        assert rule.burn_rate(hist, 30.0, T0 + 1) is None
+
+
+class TestThresholdRule:
+    def test_sustained_for_s(self, reg):
+        lag = reg.gauge("t.lag_depth")
+        hist = MetricHistory(reg, depth=64)
+        rule = alerts.ThresholdRule("lag_high", "t.lag_depth",
+                                    above=2.0, for_s=10.0)
+        lag.set(5.0)
+        for s in range(5):
+            hist.sample_once(now=T0 + s)
+        # breaching, but history does not reach back for_s yet
+        assert not rule.evaluate(hist, T0 + 4)[0]
+        for s in range(5, 21):
+            hist.sample_once(now=T0 + s)
+        firing, info = rule.evaluate(hist, T0 + 20)
+        assert firing and info["value"] == 5.0
+        # one calm sample inside the window breaks "sustained"
+        lag.set(1.0)
+        hist.sample_once(now=T0 + 21)
+        lag.set(5.0)
+        hist.sample_once(now=T0 + 22)
+        assert not rule.evaluate(hist, T0 + 22)[0]
+
+
+# -- alert engine -------------------------------------------------------------
+
+class TestAlertEngine:
+    def test_hysteresis_and_alert_events(self, reg, elog):
+        lag = reg.gauge("t.engine_depth")
+        hist = MetricHistory(reg, depth=64)
+        rule = alerts.ThresholdRule("depth_high", "t.engine_depth",
+                                    above=2.0, clear_holds=2)
+        fired = []
+        eng = alerts.AlertEngine(
+            hist, [rule], log=elog, interval_s=999.0,
+            on_fire=lambda name, info, t: fired.append((name, t)))
+        lag.set(9.0)
+        hist.sample_once(now=T0)
+        trans = eng.evaluate(now=T0)
+        assert [(t["name"], t["state"]) for t in trans] \
+            == [("depth_high", "fire")]
+        assert fired == [("depth_high", T0)]
+        assert "depth_high" in eng.active_alerts()
+        # still firing: no new transition, info refreshed in place
+        hist.sample_once(now=T0 + 1)
+        assert eng.evaluate(now=T0 + 1) == []
+        # calm pass #1: held active (clear_holds=2)
+        lag.set(0.0)
+        hist.sample_once(now=T0 + 2)
+        assert eng.evaluate(now=T0 + 2) == []
+        assert "depth_high" in eng.active_alerts()
+        # calm pass #2: clears
+        hist.sample_once(now=T0 + 3)
+        trans = eng.evaluate(now=T0 + 3)
+        assert [(t["name"], t["state"]) for t in trans] \
+            == [("depth_high", "clear")]
+        assert eng.active_alerts() == {}
+        # both transitions are themselves events on the timeline
+        states = [e["state"] for e in elog.read(types=["ops.alert"])]
+        assert states == ["fire", "clear"]
+
+    def test_on_fire_seals_incident_with_alert_attached(self, reg, elog,
+                                                        tmp_path):
+        lag = reg.gauge("t.seal_depth")
+        hist = MetricHistory(reg, depth=64)
+        rule = alerts.ThresholdRule("seal_high", "t.seal_depth", above=1.0)
+        corr = incident.IncidentCorrelator(
+            log=elog, history=hist, out_dir=str(tmp_path / "inc"),
+            window_s=10 * 24 * 3600.0)
+        sealed = []
+        eng = alerts.AlertEngine(
+            hist, [rule], log=elog, interval_s=999.0,
+            on_fire=lambda name, info, t: sealed.append(corr.seal(
+                reason=f"alert:{name}",
+                alert={"name": name, "info": info, "wall": t}, now=t)))
+        elog.emit("test.alpha", label="ctx")  # context before the alert
+        lag.set(5.0)
+        hist.sample_once(now=T0)
+        eng.evaluate(now=T0)
+        assert len(sealed) == 1
+        bundle = incident.load_bundle(sealed[0])
+        assert bundle["reason"] == "alert:seal_high"
+        assert bundle["alert"]["name"] == "seal_high"
+        types = [e["type"] for e in bundle["events"]]
+        # the window holds both the context event and the firing alert
+        assert "test.alpha" in types and "ops.alert" in types
+        assert types.index("test.alpha") < types.index("ops.alert")
+        assert "t.seal_depth" in bundle["history"]
+        with open(os.path.join(sealed[0], "timeline.txt")) as f:
+            tl = f.read()
+        assert "triggering alert: seal_high" in tl
+
+
+def test_ensure_default_gated_on_ops_enabled(tmp_path):
+    assert alerts.ensure_default() is None  # ops.enabled defaults off
+    global_config().set("ops.enabled", True)
+    events.reset_default(root=str(tmp_path / "spool"), enabled=True)
+    try:
+        eng = alerts.ensure_default()
+        assert eng is not None
+        assert alerts.ensure_default() is eng  # idempotent
+        assert alerts.active_alerts() == {}
+    finally:
+        alerts.shutdown_default()
+        events.reset_default(enabled=False)
+        global_config().unset("ops.enabled")
+    assert alerts.active_alerts() == {}
+
+
+# -- incident ordering and bundles --------------------------------------------
+
+class TestCausalOrder:
+    def test_mono_within_pid_wall_bracketed_across(self):
+        evs = [
+            {"type": "serving.brownout_rung", "wall": 10.00, "mono": 5.0,
+             "seq": 1, "pid": 1, "label": "a"},
+            {"type": "fleet.breaker", "wall": 10.05, "mono": 900.0,
+             "seq": 1, "pid": 2, "label": "c"},
+            {"type": "serving.brownout_rung", "wall": 10.20, "mono": 5.5,
+             "seq": 2, "pid": 1, "label": "a"},
+            {"type": "fleet.scale", "wall": 10.10, "mono": 901.0,
+             "seq": 2, "pid": 2, "label": "d"},
+        ]
+        ordered = incident.order_events(reversed(evs))
+        assert [(e["pid"], e["seq"]) for e in ordered] \
+            == [(1, 1), (2, 1), (2, 2), (1, 2)]
+
+    def test_ntp_step_cannot_reorder_one_pid(self):
+        # wall steps BACKWARD mid-incident; mono order must win in-pid
+        evs = [
+            {"type": "test.alpha", "wall": 100.0, "mono": 1.0, "seq": 1,
+             "pid": 7},
+            {"type": "test.beta", "wall": 40.0, "mono": 2.0, "seq": 2,
+             "pid": 7},
+        ]
+        ordered = incident.order_events(evs)
+        assert [e["type"] for e in ordered] == ["test.alpha", "test.beta"]
+
+    def test_render_timeline_offsets_and_fields(self):
+        evs = incident.order_events([
+            {"type": "test.alpha", "wall": 100.0, "mono": 1.0, "seq": 1,
+             "pid": 7, "label": "srv", "n": 3},
+            {"type": "test.beta", "wall": 101.5, "mono": 2.0, "seq": 2,
+             "pid": 7, "label": "", "detail": {"b": 1, "a": 2}},
+        ])
+        tl = incident.render_timeline(evs, reason="manual")
+        lines = tl.splitlines()
+        assert lines[0] == "incident: manual"
+        assert "t0 = 100.000" in lines[1]
+        assert "[7/srv]" in lines[2] and "n=3" in lines[2]
+        assert "+   1.500s" in lines[3] and '{"a": 2, "b": 1}' in lines[3]
+        assert incident.render_timeline([]).rstrip() \
+            == "(no events in window)"
+
+
+class TestIncidentBundle:
+    def test_scripted_brownout_breaker_scale_golden_order(self, elog, reg,
+                                                          tmp_path):
+        """The acceptance-shaped sequence: rung climb, breaker trip,
+        scale-out must come back from a sealed bundle in exactly that
+        causal order."""
+        events.event_type("serving.brownout_rung", "")
+        events.event_type("fleet.breaker", "")
+        events.event_type("fleet.scale", "")
+        elog.emit("serving.brownout_rung", label="a", level_from=0,
+                  level_to=2, pressure=0.91)
+        elog.emit("fleet.breaker", label="c", state="open",
+                  state_from="closed", reason="latency")
+        elog.emit("fleet.scale", label="fleet", direction="out")
+
+        health_ok = tmp_path / "a.health.json"
+        health_ok.write_text(json.dumps({"state": "running", "depth": 3}))
+        health_bad = tmp_path / "b.health.json"
+        health_bad.write_text("{torn")
+
+        hist = MetricHistory(reg, depth=16)
+        reg.counter("t.ctx_total").inc(4)
+        hist.sample_once()
+        corr = incident.IncidentCorrelator(
+            log=elog, history=hist, out_dir=str(tmp_path / "inc"),
+            window_s=3600.0,
+            health_paths=[str(health_ok), str(health_bad)])
+        bdir = corr.seal(reason="chaos-capstone")
+
+        bundle = incident.load_bundle(bdir)
+        types = [e["type"] for e in bundle["events"]]
+        assert types == ["serving.brownout_rung", "fleet.breaker",
+                         "fleet.scale"]
+        assert bundle["health"][str(health_ok)]["state"] == "running"
+        assert bundle["health"][str(health_bad)] is None  # frozen evidence
+        assert bundle["history"]["t.ctx_total"][""][0][1] == 4.0
+
+        with open(os.path.join(bdir, "timeline.txt")) as f:
+            tl = f.read()
+        assert tl.index("serving.brownout_rung") \
+            < tl.index("fleet.breaker") < tl.index("fleet.scale")
+        assert "level_to=2" in tl and "reason=latency" in tl
+
+        last = incident.last_incident()
+        assert last["path"] == bdir and last["reason"] == "chaos-capstone"
+        # sealing is itself an event a LATER timeline will show
+        assert [e["reason"] for e in elog.read(types=["ops.incident"])] \
+            == ["chaos-capstone"]
+
+    def test_cli_timeline_seal_show(self, elog, tmp_path, capsys):
+        elog.emit("test.alpha", label="x", n=1)
+        elog.emit("test.beta", label="y")
+        spool = elog.root
+        parts_before = sorted(os.listdir(spool))
+
+        assert ops_cli(["timeline", "--events", spool]) == 0
+        out = capsys.readouterr().out
+        assert "test.alpha" in out and "test.beta" in out
+        assert out.index("test.alpha") < out.index("test.beta")
+
+        out_dir = str(tmp_path / "cli_inc")
+        assert ops_cli(["seal", "--events", spool, "--out", out_dir,
+                        "--reason", "manual-probe",
+                        "--window-s", "3600"]) == 0
+        bdir = capsys.readouterr().out.strip()
+        assert os.path.isfile(os.path.join(bdir, "bundle.json"))
+        # the forensic reader never writes the spool it reads
+        assert sorted(os.listdir(spool)) == parts_before
+
+        assert ops_cli(["show", bdir]) == 0
+        assert "manual-probe" in capsys.readouterr().out
+        assert ops_cli(["show", bdir, "--json"]) == 0
+        assert json.loads(capsys.readouterr().out)["reason"] \
+            == "manual-probe"
+
+
+# -- retrofitted emitters -----------------------------------------------------
+
+def test_fault_fire_emits_event(tmp_path):
+    """`fault.fired` registers lazily and lands on the timeline when a
+    chaos site fires."""
+    faults.reset()
+    log = events.reset_default(root=str(tmp_path / "spool"), enabled=True)
+    try:
+        global_config().set("faults.plan", "train.step:1")
+        with pytest.raises(faults.FaultInjected):
+            faults.inject("train.step")
+        evs = log.read(types=["fault.fired"])
+        assert len(evs) == 1 and evs[0]["site"] == "train.step"
+    finally:
+        faults.reset()
+        global_config().unset("faults.plan")
+        events.reset_default(enabled=False)
+
+
+@pytest.mark.pod(budget_s=2.0)  # spawns nothing; marker satisfies the
+def test_supervisor_status_carries_alert_state():  # source-scan lint
+    from analytics_zoo_tpu.cluster.supervisor import FleetSupervisor
+    sup = FleetSupervisor.__new__(FleetSupervisor)
+    sup._procs, sup._draining = {}, set()
+    st = sup.status()
+    assert st["alerts"] == [] and st["instances"] == []
+    assert st["incident"] is None or isinstance(st["incident"], dict)
+
+
+# -- satellite f: histogram re-registration pinning ---------------------------
+
+class TestHistogramReRegistration:
+    def test_percentile_stable_across_idempotent_reregistration(self, reg):
+        """Fork-inherited slab pattern: a child (or a late importer)
+        re-registers the same histogram family idempotently. Percentile
+        and count must reflect ALL observations regardless of which
+        handle made or reads them — pinned here so a stale-handle
+        regression cannot land silently."""
+        h1 = reg.histogram("t.pin_seconds")
+        for v in (0.01,) * 20 + (0.5,) * 20:
+            h1.observe(v)
+        p50_before = h1.percentile(0.5)
+        count_before = h1.count()
+
+        h2 = reg.histogram("t.pin_seconds")  # idempotent re-registration
+        assert h2.count() == count_before
+        assert h2.percentile(0.5) == p50_before
+        assert h2.percentile(0.99) == h1.percentile(0.99)
+
+        # new observations through EITHER handle visible through both
+        h2.observe(10.0)
+        assert h1.count() == count_before + 1
+        assert h1.percentile(1.0) == h2.percentile(1.0)
+
+        # and the history sampler sees one merged series, not two
+        hist = MetricHistory(reg, depth=8)
+        hist.sample_once(now=T0)
+        assert hist.latest("t.pin_seconds")[1]["count"] == count_before + 1

@@ -335,3 +335,86 @@ class TestOverloadCapstone:
         # zero dropped, zero duplicated terminals across the whole ramp
         dupes = {u: c for u, c in posts.items() if c != 1}
         assert not dupes, f"duplicated terminals: {dupes}"
+
+    def test_ops_plane_incident_timeline(self, tmp_path):
+        """The observability acceptance scenario: the same 3x overload
+        phase with the ops plane enabled must yield an incident bundle
+        whose causally-ordered timeline contains the brownout rung climb,
+        the breaker trip on the slow instance and the recovery — with the
+        triggering burn-rate alert attached."""
+        from analytics_zoo_tpu.common import metrics
+        from analytics_zoo_tpu.ops import alerts, events, incident
+        from analytics_zoo_tpu.ops.history import MetricHistory
+
+        log = events.reset_default(root=str(tmp_path / "ops_spool"),
+                                   enabled=True)
+        hist = MetricHistory(metrics.default_registry(), depth=512,
+                             interval_s=0.05)
+        # the fleet's shed/expired fraction against placed traffic: at 3x
+        # offered load the admission controller sheds, and any shed
+        # fraction past 1% of the 99% objective burns > 1x
+        rule = alerts.BurnRateRule(
+            "capstone_shed_burn",
+            bad=("fleet.shed_total", "fleet.expired_total"),
+            total=("fleet.routed_total", "fleet.shed_total",
+                   "fleet.expired_total"),
+            objective=0.99, windows=((8.0, 1.0, 1.0),), min_total=5.0)
+        fired = []
+        engine = alerts.AlertEngine(
+            hist, [rule], interval_s=0.05,
+            on_fire=lambda name, info, t: fired.append(
+                {"name": name, "info": info, "wall": t}))
+        ladder = _Brownout("capstone")
+        try:
+            hist.start()
+            engine.start()
+            # the backlog pressure a real server would feed its ladder:
+            # two hot ticks climb to L2 before the fleet opens
+            ladder.tick(1.0)
+            ladder.tick(1.0)
+            self._run_phase(tmp_path, 3)
+            for _ in range(100):  # the engine thread evaluates at 50ms
+                if fired:
+                    break
+                time.sleep(0.05)
+            # workload drained: a full hold window of calm ticks per rung
+            # walks the ladder back down — the recovery side
+            for _ in range(8):
+                ladder.tick(0.0)
+        finally:
+            engine.stop()
+            hist.stop()
+        try:
+            assert fired, "burn-rate alert never fired during the ramp"
+            corr = incident.IncidentCorrelator(
+                log=log, history=hist,
+                out_dir=str(tmp_path / "incidents"), window_s=120.0)
+            bdir = corr.seal(reason=f"alert:{fired[0]['name']}",
+                             alert=fired[0])
+            bundle = incident.load_bundle(bdir)
+            assert bundle["alert"]["name"] == "capstone_shed_burn"
+            assert bundle["alert"]["info"]["rule"] == "burn_rate"
+            evs = bundle["events"]
+            climb = next(i for i, e in enumerate(evs)
+                         if e["type"] == "serving.brownout_rung"
+                         and e["level_to"] > e["level_from"])
+            trip = next(i for i, e in enumerate(evs)
+                        if e["type"] == "fleet.breaker"
+                        and e["state"] == "open" and e["label"] == "c")
+            alert_i = next(i for i, e in enumerate(evs)
+                           if e["type"] == "ops.alert"
+                           and e["state"] == "fire")
+            recovery = next(i for i, e in enumerate(evs)
+                            if e["type"] == "serving.brownout_rung"
+                            and e["level_to"] == 0)
+            assert climb < trip < recovery, (climb, trip, recovery)
+            assert climb < alert_i < recovery, (climb, alert_i, recovery)
+            # the sealed history carries the fleet series behind the burn
+            assert "fleet.routed_total" in bundle["history"]
+            with open(os.path.join(bdir, "timeline.txt")) as f:
+                tl = f.read()
+            assert "triggering alert: capstone_shed_burn" in tl
+            assert tl.index("serving.brownout_rung") \
+                < tl.index("fleet.breaker")
+        finally:
+            events.reset_default(enabled=False)

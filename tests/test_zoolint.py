@@ -22,9 +22,9 @@ from analytics_zoo_tpu.lint.passes import hot_path, jit_boundary
 
 REPO_ROOT = core.REPO_ROOT
 
-ALL_PASS_IDS = {"config-keys", "fault-sites", "hot-path-sync",
-                "jit-host-sync", "metric-names", "monotonic-clock",
-                "retry-discipline"}
+ALL_PASS_IDS = {"config-keys", "event-names", "fault-sites",
+                "hot-path-sync", "jit-host-sync", "metric-names",
+                "monotonic-clock", "retry-discipline"}
 
 
 def _seed(tmp_path, files):
@@ -269,6 +269,88 @@ def test_retry_discipline_accepts_jittered_bounded_retries(tmp_path):
         "            raise\n")})
     res = run_passes(proj, ids=["retry-discipline"])
     assert res.clean, "\n".join(f.text() for f in res.findings)
+
+
+# -- seeded violations: event-names ------------------------------------------
+
+def test_event_names_catches_seeded_violations(tmp_path):
+    """Every rule of the event-type contract fires on a seeded tree:
+    non-literal name, duplicate registration, convention breakage, and
+    (with no docs in the tree) undocumented types."""
+    proj = _seed(tmp_path, {"emitter.py": (
+        "from analytics_zoo_tpu.ops import events\n"
+        "\n"
+        "_NAME = 'ops' + '.computed'\n"
+        "_E_DYN = events.event_type(_NAME, 'computed name')\n"
+        "_E_A = events.event_type('serving.thing', 'owned here')\n"
+        "_E_B = events.event_type('serving.thing', 'owned here too')\n"
+        "_E_BAD = events.event_type('NoDotsOrCase', 'breaks convention')\n")})
+    res = run_passes(proj, ids=["event-names"])
+    msgs = "\n".join(f.message for f in res.findings)
+    assert "event type name must be one string literal" in msgs
+    assert "'serving.thing' registered at 2 sites" in msgs
+    assert "'NoDotsOrCase'" in msgs and "subsystem.noun" in msgs
+    assert "registered but undocumented" in msgs
+
+
+def test_event_names_resolves_receivers_not_strings(tmp_path):
+    """Only events-module aliases count: ``event_type`` on an unrelated
+    object is not a registration, and an ``ops_events`` alias is."""
+    proj = _seed(tmp_path, {"emitter.py": (
+        "from analytics_zoo_tpu.ops import events as ops_events\n"
+        "\n"
+        "\n"
+        "class _Factory:\n"
+        "    def event_type(self, name, help=''):\n"
+        "        return name\n"
+        "\n"
+        "\n"
+        "factory = _Factory()\n"
+        "factory.event_type('not.a_registration')\n"
+        "_E = ops_events.event_type('fleet.something', 'real one')\n")})
+    import analytics_zoo_tpu.lint.passes.event_names as event_names
+    regs, bad = event_names.registrations(proj)
+    assert bad == []
+    assert set(regs) == {"fleet.something"}
+
+
+def test_event_names_scanner_sees_known_transitions():
+    """The repo scanner must find the load-bearing event types — a
+    scanner matching nothing would always pass."""
+    import analytics_zoo_tpu.lint.passes.event_names as event_names
+    regs, bad = event_names.registrations()
+    assert bad == []
+    for expected in ("serving.brownout_rung", "fleet.breaker",
+                     "cluster.restart", "ops.alert", "ops.incident",
+                     "fault.fired"):
+        assert expected in regs, expected
+
+
+def test_event_names_documented_set_is_closed():
+    """docs/observability.md's event table covers every registered
+    type, and the doc mentions no phantom checks (lint self-clean rides
+    repo_result; this pins the docs half specifically)."""
+    import analytics_zoo_tpu.lint.passes.event_names as event_names
+    assert event_names.undocumented(event_names.registrations()[0]) == []
+
+
+def test_event_names_matches_runtime_registry():
+    """Source-scanned types must match runtime registration once the
+    emitting modules are imported (fault.fired registers lazily on first
+    fire, so it is exempt from the runtime side)."""
+    import analytics_zoo_tpu.cluster.supervisor  # noqa: F401
+    import analytics_zoo_tpu.online.promote  # noqa: F401
+    import analytics_zoo_tpu.serving.fleet  # noqa: F401
+    import analytics_zoo_tpu.serving.server  # noqa: F401
+    import analytics_zoo_tpu.lint.passes.event_names as event_names
+    from analytics_zoo_tpu.ops import events, incident  # noqa: F401
+
+    runtime = set(events.registered_types())
+    scanned = set(event_names.registrations()[0])
+    missing = scanned - runtime - {"fault.fired"}
+    assert not missing, (
+        f"scanned event_type registrations never ran (dead module-level "
+        f"code?): {sorted(missing)}")
 
 
 # -- suppression machinery ----------------------------------------------------
